@@ -1,0 +1,12 @@
+"""Data pipeline — the reference's DataSet/DataSetIterator + fetchers
+(ref: deeplearning4j-core datasets/, external nd4j DataSet)."""
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    ExistingDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)

@@ -1,0 +1,75 @@
+"""DataSet / MultiDataSet containers (the consumed nd4j surface,
+SURVEY.md §2.10).
+
+Arrays are host numpy until they cross into the jitted step — the
+engine moves them to device; no user-visible workspace management is
+needed (XLA buffer donation replaces the reference's MemoryWorkspace
+arenas, ref: nn/conf/WorkspaceMode.java).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        return (DataSet(self.features[:n_train], self.labels[:n_train],
+                        None if self.features_mask is None else self.features_mask[:n_train],
+                        None if self.labels_mask is None else self.labels_mask[:n_train]),
+                DataSet(self.features[n_train:], self.labels[n_train:],
+                        None if self.features_mask is None else self.features_mask[n_train:],
+                        None if self.labels_mask is None else self.labels_mask[n_train:]))
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        return DataSet(self.features[idx], self.labels[idx],
+                       None if self.features_mask is None else self.features_mask[idx],
+                       None if self.labels_mask is None else self.labels_mask[idx])
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            sl = slice(i, i + batch_size)
+            out.append(DataSet(
+                self.features[sl], self.labels[sl],
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl]))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([d.features for d in datasets]),
+            np.concatenate([d.labels for d in datasets]),
+            None if datasets[0].features_mask is None
+            else np.concatenate([d.features_mask for d in datasets]),
+            None if datasets[0].labels_mask is None
+            else np.concatenate([d.labels_mask for d in datasets]))
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output container (ref: nd4j MultiDataSet, used by
+    ComputationGraph.fit)."""
+
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

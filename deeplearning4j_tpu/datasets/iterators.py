@@ -1,0 +1,237 @@
+"""DataSet iterators, including async device prefetch.
+
+The reference wraps every training iterator in an
+``AsyncDataSetIterator`` — a background thread filling a BlockingQueue
+(ref: datasets/iterator/AsyncDataSetIterator.java:39-127).  Here the
+async iterator additionally stages host→device transfer so the TPU never
+waits on ETL (the reference's device-affinity prefetch, :108-109).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator contract (ref: nd4j DataSetIterator consumed throughout)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of pre-built minibatches (ref: ListDataSetIterator)."""
+
+    def __init__(self, data, batch: Optional[int] = None):
+        if isinstance(data, DataSet):
+            data = data.batch_by(batch) if batch else [data]
+        self._data: List[DataSet] = list(data)
+        self._i = 0
+
+    def next(self):
+        d = self._data[self._i]
+        self._i += 1
+        return d
+
+    def has_next(self):
+        return self._i < len(self._data)
+
+    def reset(self):
+        self._i = 0
+
+    def batch_size(self):
+        return self._data[0].num_examples() if self._data else 0
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap any python iterable of DataSets (ref: ExistingDataSetIterator)."""
+
+    def __init__(self, iterable_factory):
+        self._factory = iterable_factory
+        self._it = iter(iterable_factory())
+        self._peek = None
+        self._advance()
+
+    def _advance(self):
+        try:
+            self._peek = next(self._it)
+        except StopIteration:
+            self._peek = None
+
+    def next(self):
+        d = self._peek
+        self._advance()
+        return d
+
+    def has_next(self):
+        return self._peek is not None
+
+    def reset(self):
+        self._it = iter(self._factory())
+        self._advance()
+
+    def batch_size(self):
+        return self._peek.num_examples() if self._peek else 0
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat an underlying iterator N epochs (ref: MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, underlying: DataSetIterator):
+        self.epochs = epochs
+        self.underlying = underlying
+        self._epoch = 0
+
+    def next(self):
+        if not self.underlying.has_next():
+            self.underlying.reset()
+            self._epoch += 1
+        return self.underlying.next()
+
+    def has_next(self):
+        return self.underlying.has_next() or self._epoch < self.epochs - 1
+
+    def reset(self):
+        self.underlying.reset()
+        self._epoch = 0
+
+    def batch_size(self):
+        return self.underlying.batch_size()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample minibatches with replacement from one DataSet
+    (ref: SamplingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch: int, total_batches: int, seed: int = 0):
+        self.dataset = dataset
+        self.batch = batch
+        self.total = total_batches
+        self._rng = np.random.default_rng(seed)
+        self._count = 0
+
+    def next(self):
+        idx = self._rng.integers(0, self.dataset.num_examples(), self.batch)
+        self._count += 1
+        d = self.dataset
+        return DataSet(d.features[idx], d.labels[idx],
+                       None if d.features_mask is None else d.features_mask[idx],
+                       None if d.labels_mask is None else d.labels_mask[idx])
+
+    def has_next(self):
+        return self._count < self.total
+
+    def reset(self):
+        self._count = 0
+
+    def batch_size(self):
+        return self.batch
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue
+    (ref: AsyncDataSetIterator.java:39-127 — thread + BlockingQueue + poison
+    sentinel).  `device_put` stages arrays onto the accelerator so the
+    training loop overlaps ETL with compute."""
+
+    _SENTINEL = object()
+
+    def __init__(self, underlying: DataSetIterator, queue_size: int = 4,
+                 device_put: bool = False):
+        self.underlying = underlying
+        self.queue_size = queue_size
+        self.device_put = device_put
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._exhausted = False
+        self._started = False  # worker starts lazily on first use, so a
+        # reset() right after construction doesn't drain a prefetch pass
+
+    def _worker(self):
+        try:
+            while self.underlying.has_next():
+                d = self.underlying.next()
+                if self.device_put:
+                    import jax
+                    d = DataSet(jax.device_put(d.features), jax.device_put(d.labels),
+                                None if d.features_mask is None else jax.device_put(d.features_mask),
+                                None if d.labels_mask is None else jax.device_put(d.labels_mask))
+                self._queue.put(d)
+        finally:
+            self._queue.put(self._SENTINEL)
+
+    def _start(self):
+        self._exhausted = False
+        self._peek = None
+        self._started = True
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        self._advance()
+
+    def _ensure_started(self):
+        if not self._started:
+            self._start()
+
+    def _advance(self):
+        if self._exhausted:
+            self._peek = None
+            return
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._exhausted = True
+            self._peek = None
+        else:
+            self._peek = item
+
+    def next(self):
+        self._ensure_started()
+        d = self._peek
+        self._advance()
+        return d
+
+    def has_next(self):
+        self._ensure_started()
+        return self._peek is not None
+
+    def reset(self):
+        if not self._started:
+            return
+        if self._thread is not None and self._thread.is_alive():
+            # Drain so the worker can exit.
+            while not self._exhausted:
+                self._advance()
+            self._thread.join(timeout=5)
+        self.underlying.reset()
+        self._started = False
+
+    def batch_size(self):
+        return self.underlying.batch_size()

@@ -1,0 +1,142 @@
+"""Data normalizers (the consumed nd4j preprocessing surface:
+NormalizerStandardize / NormalizerMinMaxScaler / ImagePreProcessingScaler,
+persisted as normalizer.bin inside model checkpoints,
+ref: util/ModelSerializer.java:39-41)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+NORMALIZER_REGISTRY: dict[str, type] = {}
+
+
+def register_normalizer(cls):
+    NORMALIZER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Normalizer:
+    def fit(self, dataset: DataSet) -> "Normalizer":
+        raise NotImplementedError
+
+    def transform(self, dataset: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def transform_features(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "Normalizer":
+        d = dict(d)
+        cls = NORMALIZER_REGISTRY[d.pop("@class")]
+        return cls._from_dict(d)
+
+
+@register_normalizer
+class NormalizerStandardize(Normalizer):
+    """Zero-mean unit-variance per feature column."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, dataset):
+        f = dataset.features.reshape(dataset.features.shape[0], -1)
+        self.mean = f.mean(axis=0)
+        self.std = f.std(axis=0) + 1e-8
+        return self
+
+    def transform_features(self, x):
+        shape = x.shape
+        f = x.reshape(shape[0], -1)
+        return ((f - self.mean) / self.std).reshape(shape).astype(np.float32)
+
+    def transform(self, dataset):
+        return DataSet(self.transform_features(dataset.features), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+    def to_dict(self):
+        return {"@class": "NormalizerStandardize",
+                "mean": self.mean.tolist(), "std": self.std.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        n = cls()
+        n.mean = np.asarray(d["mean"], np.float32)
+        n.std = np.asarray(d["std"], np.float32)
+        return n
+
+
+@register_normalizer
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale features into [lo, hi] per column."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0):
+        self.lo = lo
+        self.hi = hi
+        self.min: Optional[np.ndarray] = None
+        self.max: Optional[np.ndarray] = None
+
+    def fit(self, dataset):
+        f = dataset.features.reshape(dataset.features.shape[0], -1)
+        self.min = f.min(axis=0)
+        self.max = f.max(axis=0)
+        return self
+
+    def transform_features(self, x):
+        shape = x.shape
+        f = x.reshape(shape[0], -1)
+        rng = np.maximum(self.max - self.min, 1e-8)
+        scaled = (f - self.min) / rng * (self.hi - self.lo) + self.lo
+        return scaled.reshape(shape).astype(np.float32)
+
+    def transform(self, dataset):
+        return DataSet(self.transform_features(dataset.features), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+    def to_dict(self):
+        return {"@class": "NormalizerMinMaxScaler", "lo": self.lo, "hi": self.hi,
+                "min": self.min.tolist(), "max": self.max.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        n = cls(d["lo"], d["hi"])
+        n.min = np.asarray(d["min"], np.float32)
+        n.max = np.asarray(d["max"], np.float32)
+        return n
+
+
+@register_normalizer
+class ImagePreProcessingScaler(Normalizer):
+    """Scale raw pixel values [0,maxval] → [lo,hi] (ref: nd4j
+    ImagePreProcessingScaler, used for MNIST/CIFAR pipelines)."""
+
+    def __init__(self, lo: float = 0.0, hi: float = 1.0, max_value: float = 255.0):
+        self.lo = lo
+        self.hi = hi
+        self.max_value = max_value
+
+    def fit(self, dataset):
+        return self
+
+    def transform_features(self, x):
+        return (x / self.max_value * (self.hi - self.lo) + self.lo).astype(np.float32)
+
+    def transform(self, dataset):
+        return DataSet(self.transform_features(dataset.features), dataset.labels,
+                       dataset.features_mask, dataset.labels_mask)
+
+    def to_dict(self):
+        return {"@class": "ImagePreProcessingScaler", "lo": self.lo,
+                "hi": self.hi, "max_value": self.max_value}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["lo"], d["hi"], d["max_value"])

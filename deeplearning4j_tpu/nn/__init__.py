@@ -1,0 +1,6 @@
+"""Core NN engine — the reference's `deeplearning4j-nn` re-realized TPU-first.
+
+Pure functional layers over param pytrees, one jitted+donated train step
+per model, config objects JSON-serializable for checkpoint parity
+(ref: nn/conf/NeuralNetConfiguration.java).
+"""

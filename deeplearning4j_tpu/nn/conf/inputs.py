@@ -1,0 +1,56 @@
+"""Input type inference — the reference's ``InputType`` system.
+
+``InputType.convolutional(h, w, c)`` etc. drive automatic nIn inference
+and preprocessor insertion between layer families
+(ref: nn/conf/inputs/InputType.java, nn/conf/layers/InputTypeUtil.java).
+
+Native data layouts (TPU-idiomatic, differing from the reference where
+noted): FF [N, C]; CNN NCHW [N, C, H, W]; RNN **[N, T, C]** (the
+reference uses [N, C, T]; time-last is hostile to XLA batched matmuls, so
+the native layout here is time-second with conversion utilities for
+reference-format data).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    kind: str  # 'ff' | 'rnn' | 'cnn' | 'cnnflat'
+    size: int = 0            # ff/rnn feature size
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: Optional[int] = None  # rnn, optional (None = variable)
+
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("ff", size=size)
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType("rnn", size=size, timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", height=height, width=width, channels=channels)
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnnflat", size=height * width * channels,
+                         height=height, width=width, channels=channels)
+
+    def flat_size(self) -> int:
+        if self.kind in ("ff", "rnn", "cnnflat"):
+            return self.size if self.kind != "cnnflat" else self.height * self.width * self.channels
+        return self.height * self.width * self.channels
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)

@@ -1,0 +1,619 @@
+"""Layer configuration types — the reference's ``nn/conf/layers`` surface.
+
+Each config is a dataclass that is simultaneously (a) the JSON-serializable
+hyperparameter record (parity with the reference's Jackson-polymorphic layer
+configs, ref: nn/conf/layers/*.java) and (b) the functional layer
+implementation: ``initialize`` builds the param/state pytrees,
+``forward`` is the pure apply.  Unlike the reference's Layer impl class
+hierarchy with mutable param views (ref: nn/layers/BaseLayer.java), there
+is no separate impl object — the whole forward pass composes into one
+traced function that XLA compiles and fuses.
+
+Custom layers register via ``@register_layer`` (the analog of the
+reference's classpath-scanned subtype registration,
+ref: nn/conf/NeuralNetConfiguration.java:340-367).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.ops import activations as act_ops
+from deeplearning4j_tpu.ops import convolution as conv_ops
+from deeplearning4j_tpu.ops import initializers
+from deeplearning4j_tpu.ops import losses as loss_ops
+from deeplearning4j_tpu.ops import normalization as norm_ops
+from deeplearning4j_tpu.ops import recurrent as rnn_ops
+
+LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def field(default=None, **kw):
+    return dataclasses.field(default=default, **kw)
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base hyperparameters every layer config can carry.
+
+    ``None`` means "inherit from the global NeuralNetConfiguration" —
+    mirroring the reference's global-conf-then-per-layer-override merge
+    (ref: NeuralNetConfiguration.Builder.layer handling).
+    ``dropout`` is the RETAIN probability as in the reference 0.8.x
+    (0.0 = disabled; ref: util/Dropout.java).
+    """
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: Optional[float] = None
+    dist: Optional[dict] = None
+    learning_rate: Optional[float] = None
+    bias_learning_rate: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None
+    updater: Optional[str] = None
+    momentum: Optional[float] = None
+    rho: Optional[float] = None
+    rms_decay: Optional[float] = None
+    adam_mean_decay: Optional[float] = None
+    adam_var_decay: Optional[float] = None
+    epsilon: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # ---- capability flags ----
+    def has_params(self) -> bool:
+        return True
+
+    def is_pretrain_layer(self) -> bool:
+        return False
+
+    # ---- functional API ----
+    def initialize(self, key, input_type: InputType, dtype=jnp.float32
+                   ) -> Tuple[dict, dict, InputType]:
+        raise NotImplementedError
+
+    def forward(self, params: dict, state: dict, x, *, train: bool, rng,
+                mask=None) -> Tuple[Any, dict, Any]:
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    # ---- shared helpers ----
+    def _act(self, x):
+        return act_ops.get(self.activation or "identity")(x)
+
+    def _maybe_dropout(self, x, train: bool, rng):
+        if train and self.dropout and 0.0 < self.dropout < 1.0 and rng is not None:
+            return norm_ops.dropout(x, self.dropout, rng)
+        return x
+
+    def _winit(self, key, shape, dtype, fan_in=None, fan_out=None):
+        return initializers.init(
+            key, self.weight_init or "xavier", shape, dtype,
+            fan_in=fan_in, fan_out=fan_out, distribution=self.dist)
+
+    def _binit(self, shape, dtype):
+        return jnp.full(shape, self.bias_init or 0.0, dtype)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Layer":
+        d = dict(d)
+        cls = LAYER_REGISTRY[d.pop("@class")]
+        return cls(**d)
+
+
+# ==========================================================================
+# Feed-forward layers
+# ==========================================================================
+
+@register_layer
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected: y = act(x @ W + b)
+    (ref: nn/conf/layers/DenseLayer.java; impl nn/layers/BaseLayer.java:373)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        params = {"W": self._winit(kW, (n_in, self.n_out), dtype),
+                  "b": self._binit((self.n_out,), dtype)}
+        return params, {}, InputType.feed_forward(self.n_out)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        return self._act(x @ params["W"] + params["b"]), state, mask
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+
+@dataclasses.dataclass
+class BaseOutputLayer(DenseLayer):
+    """Shared loss machinery for output layers
+    (ref: nn/layers/BaseOutputLayer computeScore)."""
+
+    loss: str = "mcxent"
+
+    def compute_score(self, labels, preout, mask=None):
+        """Per-example loss [N] from pre-activations (stable fused path)."""
+        return loss_ops.get(self.loss)(labels, preout,
+                                       self.activation or "softmax", mask)
+
+    def preoutput(self, params, x):
+        return x @ params["W"] + params["b"]
+
+
+@register_layer
+@dataclasses.dataclass
+class OutputLayer(BaseOutputLayer):
+    """Dense + loss head (ref: nn/conf/layers/OutputLayer.java)."""
+
+
+@register_layer
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Loss without params: activation + loss on raw input
+    (ref: nn/conf/layers/LossLayer.java)."""
+
+    loss: str = "mcxent"
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        return self._act(x), state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+    def compute_score(self, labels, preout, mask=None):
+        return loss_ops.get(self.loss)(labels, preout,
+                                       self.activation or "identity", mask)
+
+    def preoutput(self, params, x):
+        return x
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    """Pure activation (ref: nn/conf/layers/ActivationLayer.java)."""
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        return self._act(x), state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (ref: nn/conf/layers/DropoutLayer.java)."""
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        return self._maybe_dropout(x, train, rng), state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index → embedding row lookup; input is int indices [N] or one-hot
+    (ref: nn/layers/feedforward/embedding/EmbeddingLayer.java — mathematically
+    a dense layer with one-hot input; here a gather, which XLA lowers to a
+    dynamic-slice on TPU)."""
+
+    n_in: Optional[int] = None  # vocab size
+    n_out: int = 0
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.flat_size()
+        kW, _ = jax.random.split(key)
+        params = {"W": self._winit(kW, (n_in, self.n_out), dtype),
+                  "b": self._binit((self.n_out,), dtype)}
+        return params, {}, InputType.feed_forward(self.n_out)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            idx = x.reshape(x.shape[0]) if x.ndim > 1 else x
+            emb = params["W"][idx]
+        else:
+            # one-hot [N, vocab] input
+            emb = x @ params["W"]
+        return self._act(emb + params["b"]), state, mask
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+
+# ==========================================================================
+# Convolutional family (NCHW)
+# ==========================================================================
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2D convolution (ref: nn/conf/layers/ConvolutionLayer.java; impl
+    nn/layers/convolution/ConvolutionLayer.java — im2col+gemm replaced by a
+    single conv HLO on the MXU).  Weights OIHW [n_out, c_in, kh, kw]."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"  # 'truncate' | 'same'
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        c_in = self.n_in or input_type.channels
+        kh, kw = self.kernel
+        fan_in = c_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        kW, _ = jax.random.split(key)
+        params = {
+            "W": self._winit(kW, (self.n_out, c_in, kh, kw), dtype,
+                             fan_in=fan_in, fan_out=fan_out),
+            "b": self._binit((self.n_out,), dtype),
+        }
+        return params, {}, self.output_type(input_type)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        y = conv_ops.conv2d(x, params["W"], params["b"], self.stride,
+                            self.padding, self.dilation, self.convolution_mode)
+        return self._act(y), state, mask
+
+    def output_type(self, input_type):
+        oh, ow = conv_ops.conv2d_output_shape(
+            (input_type.height, input_type.width), self.kernel, self.stride,
+            self.padding, self.dilation, self.convolution_mode)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+
+@register_layer
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """Pooling (ref: nn/conf/layers/SubsamplingLayer.java)."""
+
+    pooling_type: str = "max"  # max | avg | sum | pnorm
+    kernel: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, self.output_type(input_type)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        y = conv_ops.pool2d(x, self.pooling_type, self.kernel, self.stride,
+                            self.padding, self.convolution_mode, self.pnorm)
+        return y, state, mask
+
+    def output_type(self, input_type):
+        oh, ow = conv_ops.conv2d_output_shape(
+            (input_type.height, input_type.width), self.kernel, self.stride,
+            self.padding, (1, 1), self.convolution_mode)
+        return InputType.convolutional(oh, ow, input_type.channels)
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """(ref: nn/conf/layers/ZeroPaddingLayer.java)"""
+
+    pad: Tuple[int, int, int, int] = (0, 0, 0, 0)  # top, bottom, left, right
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, self.output_type(input_type)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        t, b, l, r = self.pad
+        return conv_ops.zero_pad2d(x, t, b, l, r), state, mask
+
+    def output_type(self, input_type):
+        t, b, l, r = self.pad
+        return InputType.convolutional(input_type.height + t + b,
+                                       input_type.width + l + r,
+                                       input_type.channels)
+
+
+@register_layer
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """(ref: nn/conf/layers/BatchNormalization.java; impl
+    nn/layers/normalization/BatchNormalization.java:228 — BN applies NO
+    activation; activation defaults to identity here rather than
+    inheriting the global default).  Running statistics are carried in
+    the functional `state` pytree instead of mutated."""
+
+    activation: Optional[str] = "identity"
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+    n_features: Optional[int] = None
+
+    def _nfeat(self, input_type):
+        return self.n_features or (
+            input_type.channels if input_type.kind == "cnn" else input_type.flat_size())
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n = self._nfeat(input_type)
+        params = {} if self.lock_gamma_beta else {
+            "gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}
+        state = {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+        return params, state, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        n = state["mean"].shape[0]
+        gamma = params.get("gamma", jnp.ones((n,), x.dtype))
+        beta = params.get("beta", jnp.zeros((n,), x.dtype))
+        if train:
+            y, m, v = norm_ops.batch_norm_train(
+                x, gamma, beta, state["mean"], state["var"],
+                decay=self.decay, eps=self.eps)
+            return self._act(y), {"mean": m, "var": v}, mask
+        y = norm_ops.batch_norm_infer(x, gamma, beta, state["mean"],
+                                      state["var"], eps=self.eps)
+        return self._act(y), state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """(ref: nn/layers/normalization/LocalResponseNormalization.java:69)"""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        return norm_ops.local_response_norm(
+            x, k=self.k, n=self.n, alpha=self.alpha, beta=self.beta), state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Collapse spatial/time dims (ref: nn/layers/pooling/GlobalPoolingLayer.java);
+    mask-aware for variable-length RNN input (MaskedReductionUtil semantics)."""
+
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, self.output_type(input_type)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        if x.ndim == 4:   # CNN NCHW → pool over H,W
+            y = conv_ops.global_pool(x, self.pooling_type, (2, 3), self.pnorm)
+        elif x.ndim == 3:  # RNN [N, T, C] → pool over T, mask-aware
+            m = mask[..., None] if mask is not None else None
+            y = conv_ops.global_pool(x, self.pooling_type, (1,), self.pnorm, m)
+        else:
+            y = x
+        return y, state, None  # mask consumed
+
+    def output_type(self, input_type):
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        return input_type
+
+
+# ==========================================================================
+# Recurrent family  (native layout [N, T, C])
+# ==========================================================================
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTM(Layer):
+    """Peephole LSTM over the full sequence as one lax.scan
+    (ref: nn/conf/layers/GravesLSTM.java; impl
+    nn/layers/recurrent/LSTMHelpers.java:60-526)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.size
+        H = self.n_out
+        kW, kR, kP = jax.random.split(key, 3)
+        b = jnp.zeros((4 * H,), dtype)
+        # forget-gate block [H:2H] gets forget_gate_bias_init (ref default 1.0)
+        b = b.at[H:2 * H].set(self.forget_gate_bias_init)
+        params = {
+            "W": self._winit(kW, (n_in, 4 * H), dtype, fan_in=n_in, fan_out=4 * H),
+            "RW": self._winit(kR, (H, 4 * H), dtype, fan_in=H, fan_out=4 * H),
+            "b": b,
+            "pI": jnp.zeros((H,), dtype),
+            "pF": jnp.zeros((H,), dtype),
+            "pO": jnp.zeros((H,), dtype),
+        }
+        return params, {}, InputType.recurrent(H, input_type.timesteps)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        gate = act_ops.get(self.gate_activation)
+        cell = act_ops.get(self.activation or "tanh")
+        init = state.get("rnn_state") if state else None
+        hs, final = rnn_ops.lstm_scan(params, x, init, mask,
+                                      gate_act=gate, cell_act=cell)
+        new_state = dict(state) if state else {}
+        new_state["rnn_state"] = final  # for rnnTimeStep stateful inference
+        return hs, new_state, mask
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(Layer):
+    """Fwd + bwd peephole LSTMs with separate params; the two directions'
+    outputs are SUMMED, giving output size n_out (ref:
+    nn/layers/recurrent/GravesBidirectionalLSTM.java:204
+    ``fwdOutput.addi(backOutput)``)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    gate_activation: str = "sigmoid"
+    forget_gate_bias_init: float = 1.0
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        sub = GravesLSTM(n_in=self.n_in, n_out=self.n_out,
+                         activation=self.activation,
+                         weight_init=self.weight_init, dist=self.dist,
+                         gate_activation=self.gate_activation,
+                         forget_gate_bias_init=self.forget_gate_bias_init)
+        kf, kb = jax.random.split(key)
+        pf, _, out = sub.initialize(kf, input_type, dtype)
+        pb, _, _ = sub.initialize(kb, input_type, dtype)
+        params = {f"f_{k}": v for k, v in pf.items()}
+        params.update({f"b_{k}": v for k, v in pb.items()})
+        return params, {}, out
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        gate = act_ops.get(self.gate_activation)
+        cell = act_ops.get(self.activation or "tanh")
+        pf = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        pb = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        hf, _ = rnn_ops.lstm_scan(pf, x, None, mask, gate_act=gate, cell_act=cell)
+        hb, _ = rnn_ops.lstm_scan(pb, x, None, mask, reverse=True,
+                                  gate_act=gate, cell_act=cell)
+        return hf + hb, state, mask
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+
+@register_layer
+@dataclasses.dataclass
+class RnnOutputLayer(BaseOutputLayer):
+    """Per-timestep dense + loss over [N, T, C]
+    (ref: nn/conf/layers/RnnOutputLayer.java)."""
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.size
+        kW, _ = jax.random.split(key)
+        params = {"W": self._winit(kW, (n_in, self.n_out), dtype),
+                  "b": self._binit((self.n_out,), dtype)}
+        return params, {}, InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        return self._act(x @ params["W"] + params["b"]), state, mask
+
+    def compute_score(self, labels, preout, mask=None):
+        # labels/preout: [N, T, C]; mask [N, T].  Score per example sums
+        # over time (masked), matching reference RnnOutputLayer scoring.
+        m = mask[..., None] if mask is not None else None
+        return loss_ops.get(self.loss)(labels, preout,
+                                       self.activation or "softmax", m)
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+
+# ==========================================================================
+# Misc
+# ==========================================================================
+
+@register_layer
+@dataclasses.dataclass
+class FrozenLayerConf(Layer):
+    """Wraps another layer; gradients are zeroed by the engine
+    (ref: nn/layers/FrozenLayer.java — transfer learning)."""
+
+    inner: Optional[dict] = None  # serialized inner layer
+
+    def _inner(self) -> Layer:
+        return Layer.from_dict(self.inner)
+
+    def has_params(self):
+        return self._inner().has_params()
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return self._inner().initialize(key, input_type, dtype)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        # Frozen layers run in inference mode (no dropout) per the reference.
+        return self._inner().forward(params, state, x, train=False, rng=rng, mask=mask)
+
+    def output_type(self, input_type):
+        return self._inner().output_type(input_type)
+
+    @staticmethod
+    def wrap(layer: Layer) -> "FrozenLayerConf":
+        return FrozenLayerConf(inner=layer.to_dict())

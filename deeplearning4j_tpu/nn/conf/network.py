@@ -1,0 +1,353 @@
+"""Network configuration: builder DSL + MultiLayerConfiguration.
+
+Mirrors the reference's Jackson-serializable config stack
+(ref: nn/conf/NeuralNetConfiguration.java:539+ builder,
+nn/conf/MultiLayerConfiguration.java) — global hyperparameters with
+per-layer overrides, automatic nIn inference and preprocessor insertion
+from ``InputType`` (ref: nn/conf/layers/InputTypeUtil.java), JSON
+round-trip for checkpoint parity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BatchNormalization, Layer
+from deeplearning4j_tpu.nn.conf import preprocessors as pp
+
+
+@dataclasses.dataclass
+class GlobalConf:
+    """Global hyperparameters (the reference's NeuralNetConfiguration fields)."""
+
+    seed: int = 12345
+    iterations: int = 1
+    learning_rate: float = 1e-1
+    bias_learning_rate: Optional[float] = None
+    updater: str = "sgd"
+    momentum: float = 0.9
+    rho: float = 0.95
+    rms_decay: float = 0.95
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    epsilon: Optional[float] = None
+    activation: str = "sigmoid"
+    weight_init: str = "xavier"
+    bias_init: float = 0.0
+    dist: Optional[dict] = None
+    l1: float = 0.0
+    l2: float = 0.0
+    l1_bias: float = 0.0
+    l2_bias: float = 0.0
+    dropout: float = 0.0
+    use_regularization: bool = False
+    use_drop_connect: bool = False
+    minimize: bool = True
+    mini_batch: bool = True
+    optimization_algo: str = "stochastic_gradient_descent"
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    lr_policy: Optional[str] = None
+    lr_policy_decay_rate: Optional[float] = None
+    lr_policy_steps: Optional[float] = None
+    lr_policy_power: Optional[float] = None
+    learning_rate_schedule: Optional[dict] = None
+
+
+_MERGE_FIELDS = [
+    "activation", "weight_init", "bias_init", "dist", "learning_rate",
+    "bias_learning_rate", "l1", "l2", "l1_bias", "l2_bias", "dropout",
+    "updater", "momentum", "rho", "rms_decay", "adam_mean_decay",
+    "adam_var_decay", "epsilon", "gradient_normalization",
+    "gradient_normalization_threshold",
+]
+
+
+def merge_layer_conf(layer: Layer, g: GlobalConf) -> Layer:
+    """Fill a layer's unset (None) hyperparams from the global conf —
+    the reference's global-then-override merge."""
+    updates = {}
+    for f in _MERGE_FIELDS:
+        if getattr(layer, f, None) is None and hasattr(g, f):
+            updates[f] = getattr(g, f)
+    # L1/L2 are inert unless regularization is enabled (reference semantics:
+    # per-layer values are ignored too when the flag is off).
+    if not g.use_regularization:
+        for f in ("l1", "l2", "l1_bias", "l2_bias"):
+            updates[f] = 0.0
+    return dataclasses.replace(layer, **{k: v for k, v in updates.items()
+                                         if hasattr(layer, k)})
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """(ref: nn/conf/MultiLayerConfiguration.java)"""
+
+    layers: List[Layer]
+    global_conf: GlobalConf
+    input_type: Optional[InputType] = None
+    preprocessors: Dict[int, pp.InputPreProcessor] = dataclasses.field(default_factory=dict)
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "standard"  # 'standard' | 'truncatedbptt'
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    # ---- serde (checkpoint parity: configuration.json) ----
+    def to_dict(self) -> dict:
+        return {
+            "global": dataclasses.asdict(self.global_conf),
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
+            "backprop": self.backprop,
+            "pretrain": self.pretrain,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=[Layer.from_dict(ld) for ld in d["layers"]],
+            global_conf=GlobalConf(**d["global"]),
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            preprocessors={int(k): pp.InputPreProcessor.from_dict(v)
+                           for k, v in d.get("preprocessors", {}).items()},
+            backprop=d.get("backprop", True),
+            pretrain=d.get("pretrain", False),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()`` — the reference's
+    fluent DSL (ref: nn/conf/NeuralNetConfiguration.java Builder)."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._g = GlobalConf()
+
+    # Fluent setters — names follow the reference's builder methods.
+    def seed(self, s):
+        self._g.seed = int(s); return self
+
+    def iterations(self, n):
+        self._g.iterations = int(n); return self
+
+    def learning_rate(self, lr):
+        self._g.learning_rate = float(lr); return self
+
+    def bias_learning_rate(self, lr):
+        self._g.bias_learning_rate = float(lr); return self
+
+    def updater(self, u: str):
+        self._g.updater = u.lower(); return self
+
+    def momentum(self, m):
+        self._g.momentum = float(m); return self
+
+    def rho(self, r):
+        self._g.rho = float(r); return self
+
+    def rms_decay(self, r):
+        self._g.rms_decay = float(r); return self
+
+    def adam_mean_decay(self, b):
+        self._g.adam_mean_decay = float(b); return self
+
+    def adam_var_decay(self, b):
+        self._g.adam_var_decay = float(b); return self
+
+    def epsilon(self, e):
+        self._g.epsilon = float(e); return self
+
+    def activation(self, a: str):
+        self._g.activation = a; return self
+
+    def weight_init(self, w: str):
+        self._g.weight_init = w; return self
+
+    def bias_init(self, b):
+        self._g.bias_init = float(b); return self
+
+    def dist(self, d: dict):
+        self._g.dist = d; return self
+
+    def regularization(self, on: bool = True):
+        self._g.use_regularization = bool(on); return self
+
+    def l1(self, v):
+        self._g.l1 = float(v); return self
+
+    def l2(self, v):
+        self._g.l2 = float(v); return self
+
+    def drop_out(self, v):
+        self._g.dropout = float(v); return self
+
+    def minimize(self, on: bool = True):
+        self._g.minimize = bool(on); return self
+
+    def mini_batch(self, on: bool = True):
+        self._g.mini_batch = bool(on); return self
+
+    def optimization_algo(self, algo: str):
+        self._g.optimization_algo = algo.lower(); return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0):
+        self._g.gradient_normalization = mode
+        self._g.gradient_normalization_threshold = float(threshold)
+        return self
+
+    def learning_rate_policy(self, policy: str, decay_rate=None, steps=None,
+                             power=None, schedule: Optional[dict] = None):
+        self._g.lr_policy = policy
+        self._g.lr_policy_decay_rate = decay_rate
+        self._g.lr_policy_steps = steps
+        self._g.lr_policy_power = power
+        self._g.learning_rate_schedule = schedule
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self._g)
+
+
+class ListBuilder:
+    """(ref: NeuralNetConfiguration.ListBuilder / MultiLayerConfiguration.Builder)"""
+
+    def __init__(self, g: GlobalConf):
+        self._g = g
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._preprocs: Dict[int, pp.InputPreProcessor] = {}
+        self._backprop = True
+        self._pretrain = False
+        self._bp_type = "standard"
+        self._tbptt_f = 20
+        self._tbptt_b = 20
+
+    def layer(self, idx_or_layer, layer: Optional[Layer] = None) -> "ListBuilder":
+        if layer is None:
+            self._layers.append(idx_or_layer)
+        else:
+            idx = int(idx_or_layer)
+            while len(self._layers) <= idx:
+                self._layers.append(None)  # type: ignore
+            self._layers[idx] = layer
+        return self
+
+    def set_input_type(self, it: InputType) -> "ListBuilder":
+        self._input_type = it
+        return self
+
+    def input_pre_processor(self, idx: int, proc: pp.InputPreProcessor) -> "ListBuilder":
+        self._preprocs[idx] = proc
+        return self
+
+    def backprop(self, on: bool) -> "ListBuilder":
+        self._backprop = on
+        return self
+
+    def pretrain(self, on: bool) -> "ListBuilder":
+        self._pretrain = on
+        return self
+
+    def backprop_type(self, t: str) -> "ListBuilder":
+        self._bp_type = t.lower()
+        return self
+
+    def t_bptt_forward_length(self, n: int) -> "ListBuilder":
+        self._tbptt_f = int(n)
+        return self
+
+    def t_bptt_backward_length(self, n: int) -> "ListBuilder":
+        self._tbptt_b = int(n)
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        if any(l is None for l in self._layers):
+            raise ValueError("Gap in layer indices")
+        layers = [merge_layer_conf(l, self._g) for l in self._layers]
+        preprocs = dict(self._preprocs)
+        if self._input_type is not None:
+            layers, preprocs = _infer_shapes(layers, self._input_type, preprocs)
+        return MultiLayerConfiguration(
+            layers=layers, global_conf=self._g, input_type=self._input_type,
+            preprocessors=preprocs, backprop=self._backprop,
+            pretrain=self._pretrain, backprop_type=self._bp_type,
+            tbptt_fwd_length=self._tbptt_f, tbptt_back_length=self._tbptt_b)
+
+
+def _needs(layer: Layer) -> str:
+    """Which input family a layer consumes: 'ff' | 'cnn' | 'rnn' | 'any'."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    if isinstance(layer, (L.ConvolutionLayer, L.SubsamplingLayer,
+                          L.ZeroPaddingLayer, L.LocalResponseNormalization)):
+        return "cnn"
+    if isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM, L.RnnOutputLayer)):
+        return "rnn"
+    if isinstance(layer, (L.DenseLayer, L.EmbeddingLayer)):
+        return "ff"
+    return "any"
+
+
+def _adapter(cur: InputType, needed: str) -> Optional[pp.InputPreProcessor]:
+    if needed == "any" or cur.kind == needed or (needed == "ff" and cur.kind == "cnnflat"):
+        return None
+    if cur.kind == "cnn" and needed == "ff":
+        return pp.CnnToFeedForwardPreProcessor(cur.height, cur.width, cur.channels)
+    if cur.kind == "cnnflat" and needed == "cnn":
+        return pp.FeedForwardToCnnPreProcessor(cur.height, cur.width, cur.channels)
+    if cur.kind == "ff" and needed == "rnn":
+        return pp.FeedForwardToRnnPreProcessor(cur.timesteps)
+    if cur.kind == "rnn" and needed == "ff":
+        return pp.RnnToFeedForwardPreProcessor()
+    if cur.kind == "cnn" and needed == "rnn":
+        return pp.CnnToRnnPreProcessor()
+    if cur.kind == "rnn" and needed == "cnn":
+        raise ValueError("RnnToCnn requires explicit preprocessor with target shape")
+    raise ValueError(f"No automatic preprocessor from {cur.kind} to {needed}")
+
+
+def _infer_shapes(layers: List[Layer], input_type: InputType,
+                  preprocs: Dict[int, pp.InputPreProcessor]):
+    """Walk the stack inferring nIn and inserting preprocessors — the
+    reference's setInputType pass (MultiLayerConfiguration.Builder)."""
+    cur = input_type
+    out_layers = []
+    for i, layer in enumerate(layers):
+        if i not in preprocs:
+            adapter = _adapter(cur, _needs(layer))
+            if adapter is not None:
+                preprocs[i] = adapter
+        if i in preprocs:
+            cur = preprocs[i].output_type(cur)
+        updates = {}
+        if hasattr(layer, "n_in") and getattr(layer, "n_in") is None:
+            updates["n_in"] = cur.flat_size() if cur.kind != "cnn" else cur.channels
+        if isinstance(layer, BatchNormalization) and layer.n_features is None:
+            updates["n_features"] = cur.channels if cur.kind == "cnn" else cur.flat_size()
+        if updates:
+            layer = dataclasses.replace(layer, **updates)
+        out_layers.append(layer)
+        cur = layer.output_type(cur)
+    return out_layers, preprocs

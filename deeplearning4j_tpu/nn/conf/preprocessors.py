@@ -1,0 +1,158 @@
+"""Input preprocessors — shape adapters between layer families.
+
+(ref: nn/conf/preprocessor/{CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+ComposableInputPreProcessor}.java).  In the reference each carries a
+hand-written backprop; here they are pure reshapes under jax.grad.
+
+Note on RNN layout: native recurrent layout is [N, T, C] (reference is
+[N, C, T]); the Rnn* preprocessors reshape accordingly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+PREPROC_REGISTRY: dict[str, type] = {}
+
+
+def register_preproc(cls):
+    PREPROC_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class InputPreProcessor:
+    def __call__(self, x, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = PREPROC_REGISTRY[d.pop("@class")]
+        return cls(**d)
+
+
+@register_preproc
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, mask=None):
+        return x.reshape(x.shape[0], -1), mask
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(
+            input_type.height * input_type.width * input_type.channels)
+
+
+@register_preproc
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, mask=None):
+        if x.ndim == 4:
+            return x, mask
+        return x.reshape(x.shape[0], self.channels, self.height, self.width), mask
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preproc
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[N, T, C] → [N*T, C] (the reference flattens time into batch).
+    The known timestep count is propagated through the ff InputType so a
+    later FeedForwardToRnn adapter can restore the sequence shape."""
+
+    def __call__(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1]), (mask.reshape(-1) if mask is not None else None)
+
+    def output_type(self, input_type):
+        return InputType("ff", size=input_type.size, timesteps=input_type.timesteps)
+
+
+@register_preproc
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    timesteps: Optional[int] = None
+
+    def __call__(self, x, mask=None):
+        t = self.timesteps
+        if t is None:
+            raise ValueError("FeedForwardToRnnPreProcessor needs static timesteps")
+        return x.reshape(-1, t, x.shape[-1]), (mask.reshape(-1, t) if mask is not None else None)
+
+    def output_type(self, input_type):
+        return InputType.recurrent(input_type.flat_size(), self.timesteps)
+
+
+@register_preproc
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """NCHW [N,C,H,W] where N = batch*T → [batch, T, C*H*W]."""
+
+    timesteps: Optional[int] = None
+
+    def __call__(self, x, mask=None):
+        t = self.timesteps
+        flat = x.reshape(x.shape[0], -1)
+        return flat.reshape(-1, t, flat.shape[-1]), mask
+
+    def output_type(self, input_type):
+        return InputType.recurrent(
+            input_type.height * input_type.width * input_type.channels, self.timesteps)
+
+
+@register_preproc
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def __call__(self, x, mask=None):
+        return x.reshape(-1, self.channels, self.height, self.width), mask
+
+    def output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preproc
+@dataclasses.dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    parts: list = dataclasses.field(default_factory=list)  # serialized parts
+
+    def __call__(self, x, mask=None):
+        for d in self.parts:
+            x, mask = InputPreProcessor.from_dict(d)(x, mask)
+        return x, mask
+
+    def output_type(self, input_type):
+        for d in self.parts:
+            input_type = InputPreProcessor.from_dict(d).output_type(input_type)
+        return input_type
+
+    @staticmethod
+    def compose(*procs: InputPreProcessor) -> "ComposableInputPreProcessor":
+        return ComposableInputPreProcessor(parts=[p.to_dict() for p in procs])

@@ -1,0 +1,259 @@
+"""Evaluation metrics — classification, regression, ROC.
+
+(ref: eval/Evaluation.java:47, ConfusionMatrix.java, RegressionEvaluation.java,
+ROC.java, ROCBinary.java, ROCMultiClass.java, EvaluationBinary.java)
+
+Accumulation happens host-side in numpy (cheap vs. the model forward);
+the model forward producing predictions is the jitted path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """(ref: eval/ConfusionMatrix.java)"""
+
+    def __init__(self, n_classes: int):
+        self.matrix = np.zeros((n_classes, n_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    """Multi-class classification metrics (ref: eval/Evaluation.java)."""
+
+    def __init__(self, n_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None):
+        self.n_classes = n_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or n
+            self.confusion = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels: one-hot [N,C] (or [N,T,C] with mask [N,T]);
+        predictions: probabilities same shape."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # time series: flatten valid steps
+            if mask is not None:
+                m = np.asarray(mask).astype(bool).reshape(-1)
+            else:
+                m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
+            labels = labels.reshape(-1, labels.shape[-1])[m]
+            predictions = predictions.reshape(-1, predictions.shape[-1])[m]
+        self._ensure(labels.shape[-1])
+        a = np.argmax(labels, axis=-1)
+        p = np.argmax(predictions, axis=-1)
+        np.add.at(self.confusion.matrix, (a, p), 1)
+
+    # ---- metrics ----
+    def _tp(self):
+        return np.diag(self.confusion.matrix).astype(np.float64)
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        col = m.sum(axis=0).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(col > 0, self._tp() / col, np.nan)
+        return float(per[cls]) if cls is not None else float(np.nanmean(per))
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        row = m.sum(axis=1).astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(row > 0, self._tp() / row, np.nan)
+        return float(per[cls]) if cls is not None else float(np.nanmean(per))
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        fp = m[:, cls].sum() - m[cls, cls]
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def stats(self) -> str:
+        lines = [
+            "==========================Scores========================================",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "========================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class EvaluationBinary:
+    """Per-output independent binary metrics (ref: eval/EvaluationBinary.java)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+        self.tp = self.fp = self.tn = self.fn = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        pred = (np.asarray(predictions) >= self.threshold)
+        lab = labels >= 0.5
+        if mask is not None:
+            m = np.asarray(mask).astype(bool)
+        else:
+            m = np.ones_like(lab, dtype=bool)
+        if self.tp is None:
+            n = labels.shape[-1]
+            self.tp = np.zeros(n)
+            self.fp = np.zeros(n)
+            self.tn = np.zeros(n)
+            self.fn = np.zeros(n)
+        axes = tuple(range(labels.ndim - 1))
+        self.tp += np.sum(pred & lab & m, axis=axes)
+        self.fp += np.sum(pred & ~lab & m, axis=axes)
+        self.tn += np.sum(~pred & ~lab & m, axis=axes)
+        self.fn += np.sum(~pred & lab & m, axis=axes)
+
+    def accuracy(self, i: int) -> float:
+        tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
+        return float((self.tp[i] + self.tn[i]) / tot) if tot else 0.0
+
+    def precision(self, i: int) -> float:
+        d = self.tp[i] + self.fp[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def recall(self, i: int) -> float:
+        d = self.tp[i] + self.fn[i]
+        return float(self.tp[i] / d) if d else 0.0
+
+    def f1(self, i: int) -> float:
+        p, r = self.precision(i), self.recall(i)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+
+class RegressionEvaluation:
+    """Column-wise regression metrics (ref: eval/RegressionEvaluation.java)."""
+
+    def __init__(self, n_columns: Optional[int] = None):
+        self.n = 0
+        self.sum_abs = None
+        self.sum_sq = None
+        self.sum_label = None
+        self.sum_label_sq = None
+        self.sum_pred = None
+        self.sum_pred_sq = None
+        self.sum_label_pred = None
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        pred = np.asarray(predictions, np.float64)
+        labels = labels.reshape(-1, labels.shape[-1])
+        pred = pred.reshape(-1, pred.shape[-1])
+        if self.sum_abs is None:
+            c = labels.shape[-1]
+            for attr in ("sum_abs", "sum_sq", "sum_label", "sum_label_sq",
+                         "sum_pred", "sum_pred_sq", "sum_label_pred"):
+                setattr(self, attr, np.zeros(c))
+        err = pred - labels
+        self.n += labels.shape[0]
+        self.sum_abs += np.abs(err).sum(axis=0)
+        self.sum_sq += (err ** 2).sum(axis=0)
+        self.sum_label += labels.sum(axis=0)
+        self.sum_label_sq += (labels ** 2).sum(axis=0)
+        self.sum_pred += pred.sum(axis=0)
+        self.sum_pred_sq += (pred ** 2).sum(axis=0)
+        self.sum_label_pred += (labels * pred).sum(axis=0)
+
+    def mean_squared_error(self, col: int) -> float:
+        return float(self.sum_sq[col] / self.n)
+
+    def mean_absolute_error(self, col: int) -> float:
+        return float(self.sum_abs[col] / self.n)
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.sum_sq[col] / self.n))
+
+    def correlation_r2(self, col: int) -> float:
+        n = self.n
+        num = n * self.sum_label_pred[col] - self.sum_label[col] * self.sum_pred[col]
+        den = np.sqrt(n * self.sum_label_sq[col] - self.sum_label[col] ** 2) * \
+            np.sqrt(n * self.sum_pred_sq[col] - self.sum_pred[col] ** 2)
+        return float((num / den) ** 2) if den else 0.0
+
+
+class ROC:
+    """Binary ROC / AUC by threshold sweep (ref: eval/ROC.java)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.scores: List[np.ndarray] = []
+        self.labels: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        pred = np.asarray(predictions)
+        if labels.ndim > 1 and labels.shape[-1] == 2:
+            labels = labels[..., 1]
+            pred = pred[..., 1]
+        self.labels.append(labels.reshape(-1))
+        self.scores.append(pred.reshape(-1))
+
+    def roc_curve(self):
+        lab = np.concatenate(self.labels)
+        sc = np.concatenate(self.scores)
+        thresholds = np.linspace(0, 1, self.steps + 1)
+        pos = lab >= 0.5
+        n_pos = pos.sum()
+        n_neg = (~pos).sum()
+        tpr, fpr = [], []
+        for t in thresholds:
+            p = sc >= t
+            tpr.append((p & pos).sum() / n_pos if n_pos else 0.0)
+            fpr.append((p & ~pos).sum() / n_neg if n_neg else 0.0)
+        return np.array(fpr), np.array(tpr), thresholds
+
+    def auc(self) -> float:
+        fpr, tpr, _ = self.roc_curve()
+        order = np.argsort(fpr)
+        return float(np.trapezoid(tpr[order], fpr[order]))
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class (ref: eval/ROCMultiClass.java)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.per_class: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels).reshape(-1, np.asarray(labels).shape[-1])
+        pred = np.asarray(predictions).reshape(-1, labels.shape[-1])
+        for c in range(labels.shape[-1]):
+            self.per_class.setdefault(c, ROC(self.steps)).eval(
+                labels[:, c], pred[:, c])
+
+    def auc(self, cls: int) -> float:
+        return self.per_class[cls].auc()
+
+
+ROCBinary = ROC

@@ -1,0 +1,87 @@
+"""Training listeners — observability hooks.
+
+(ref: optimize/api/IterationListener.java, TrainingListener.java:73;
+impls optimize/listeners/{ScoreIterationListener, PerformanceListener,
+CollectScoresIterationListener}.java)
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int) -> None:
+        pass
+
+
+class TrainingListener(IterationListener):
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        pass
+
+    def on_gradient_calculation(self, model) -> None:
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (ref: ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.score())
+
+
+class PerformanceListener(IterationListener):
+    """samples/sec + batches/sec + ETL time per iteration
+    (ref: PerformanceListener.java:119-122)."""
+
+    def __init__(self, frequency: int = 1, report_etl: bool = True):
+        self.frequency = max(1, frequency)
+        self.report_etl = report_etl
+        self._last_time: Optional[float] = None
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            batch = getattr(model, "last_batch_size", 0)
+            rec = {
+                "iteration": iteration,
+                "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
+                "samples_per_sec": batch / dt if dt > 0 else float("inf"),
+                "etl_ms": getattr(model, "last_etl_time_ms", 0.0),
+            }
+            self.history.append(rec)
+            log.info("iteration %d: %.1f samples/sec, %.2f batches/sec, ETL %.1f ms",
+                     iteration, rec["samples_per_sec"], rec["batches_per_sec"],
+                     rec["etl_ms"])
+        self._last_time = now
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs (ref: CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(model.score())))

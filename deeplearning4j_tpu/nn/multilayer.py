@@ -1,0 +1,476 @@
+"""MultiLayerNetwork — the sequential-network engine.
+
+The reference's MultiLayerNetwork (ref: nn/multilayer/MultiLayerNetwork.java,
+2747 LoC) runs an eager per-op training loop: feedForwardToLayer →
+backprop → updater → params-=gradient, dispatching every op through nd4j
+(call stack SURVEY.md §3.1).  Here the ENTIRE update step — forward, loss,
+backward (jax.grad), gradient normalization, learning rule, param update —
+is traced once and compiled into a single XLA program with donated
+buffers, which is precisely the north star's "trace a full update step
+into one cached XLA computation".
+
+Public surface parity: init(), fit(iterator|DataSet|(x,y)),
+output(), predict(), score(), params()/set_params() (flat row-vector
+view parity), rnn_time_step(), tbptt via conf.backprop_type, listeners.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import params as param_util
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.listeners import IterationListener, TrainingListener
+from deeplearning4j_tpu.ops import updaters as upd_ops
+
+WEIGHT_KEYS = {"W", "RW", "f_W", "f_RW", "b_W", "b_RW"}
+BIAS_KEYS = {"b", "f_b", "b_b"}
+
+
+def _updater_for(layer: Layer) -> upd_ops.Updater:
+    name = (layer.updater or "sgd").lower()
+    hyper = {}
+    if name == "nesterovs":
+        hyper["momentum"] = layer.momentum if layer.momentum is not None else 0.9
+    elif name == "adadelta":
+        hyper["rho"] = layer.rho if layer.rho is not None else 0.95
+        if layer.epsilon is not None:
+            hyper["epsilon"] = layer.epsilon
+    elif name == "rmsprop":
+        hyper["rmsdecay"] = layer.rms_decay if layer.rms_decay is not None else 0.95
+        if layer.epsilon is not None:
+            hyper["epsilon"] = layer.epsilon
+    elif name in ("adam", "adamax"):
+        hyper["beta1"] = layer.adam_mean_decay if layer.adam_mean_decay is not None else 0.9
+        hyper["beta2"] = layer.adam_var_decay if layer.adam_var_decay is not None else 0.999
+        if layer.epsilon is not None:
+            hyper["epsilon"] = layer.epsilon
+    elif name == "adagrad" and layer.epsilon is not None:
+        hyper["epsilon"] = layer.epsilon
+    return upd_ops.make(name, **hyper)
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self.net_params: Optional[List[dict]] = None
+        self.net_state: Optional[List[dict]] = None
+        self.opt_states: Optional[List[Any]] = None
+        self.updaters = [_updater_for(l) for l in self.layers]
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[IterationListener] = []
+        self._score: float = float("nan")
+        self._key = jax.random.PRNGKey(conf.global_conf.seed)
+        self._step_fn = None
+        self._score_fn = None
+        self._output_fn = None
+        self.last_batch_size = 0
+        self.last_etl_time_ms = 0.0
+        self.frozen: List[bool] = [type(l).__name__ == "FrozenLayerConf"
+                                   for l in self.layers]
+
+    # ------------------------------------------------------------------
+    # Initialization
+    # ------------------------------------------------------------------
+    def init(self, params: Optional[List[dict]] = None) -> "MultiLayerNetwork":
+        """Build param/state pytrees (ref: MultiLayerNetwork.init :411)."""
+        cur = self._input_type_chain_start()
+        key = jax.random.PRNGKey(self.conf.global_conf.seed)
+        ps, ss = [], []
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur = self.conf.preprocessors[i].output_type(cur)
+            key, sub = jax.random.split(key)
+            p, s, cur = layer.initialize(sub, cur)
+            ps.append(p)
+            ss.append(s)
+        self.net_params = params if params is not None else ps
+        self.net_state = ss
+        self.opt_states = [self.updaters[i].init(self.net_params[i])
+                           for i in range(len(self.layers))]
+        return self
+
+    def _input_type_chain_start(self) -> InputType:
+        if self.conf.input_type is not None:
+            return self.conf.input_type
+        first = self.layers[0]
+        n_in = getattr(first, "n_in", None)
+        if n_in:
+            from deeplearning4j_tpu.nn.conf import layers as L
+            if isinstance(first, (L.GravesLSTM, L.GravesBidirectionalLSTM)):
+                return InputType.recurrent(n_in)
+            return InputType.feed_forward(n_in)
+        raise ValueError("Network needs conf.input_type or an explicit n_in on layer 0")
+
+    # ------------------------------------------------------------------
+    # Forward (pure, traceable)
+    # ------------------------------------------------------------------
+    def _forward(self, params, state, x, mask, train: bool, rng,
+                 stateful_rnn: bool = False):
+        """Full-stack activations.  Returns (out, new_states, out_mask)."""
+        new_states = []
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                x, mask = self.conf.preprocessors[i](x, mask)
+            s = state[i]
+            if not stateful_rnn and "rnn_state" in s:
+                s = {k: v for k, v in s.items() if k != "rnn_state"}
+            x, ns, mask = layer.forward(params[i], s, x, train=train,
+                                        rng=jax.random.fold_in(rng, i), mask=mask)
+            new_states.append(ns)
+        return x, new_states, mask
+
+    def _forward_to_preout(self, params, state, x, mask, train: bool, rng,
+                           stateful_rnn: bool = False):
+        """Forward to the output layer's PRE-activation (stable fused loss)."""
+        new_states = []
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers[:-1]):
+            if i in self.conf.preprocessors:
+                x, mask = self.conf.preprocessors[i](x, mask)
+            s = state[i]
+            if not stateful_rnn and "rnn_state" in s:
+                s = {k: v for k, v in s.items() if k != "rnn_state"}
+            x, ns, mask = layer.forward(params[i], s, x, train=train,
+                                        rng=jax.random.fold_in(rng, i), mask=mask)
+            new_states.append(ns)
+        last = self.layers[-1]
+        if (n - 1) in self.conf.preprocessors:
+            x, mask = self.conf.preprocessors[n - 1](x, mask)
+        if train:
+            x = last._maybe_dropout(x, True, jax.random.fold_in(rng, n - 1))
+        preout = last.preoutput(params[-1], x)
+        new_states.append(state[-1])
+        return preout, new_states, mask
+
+    def _reg_penalty(self, params):
+        total = 0.0
+        for layer, lp in zip(self.layers, params):
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            l1b = layer.l1_bias or 0.0
+            l2b = layer.l2_bias or 0.0
+            for k, v in lp.items():
+                if k in BIAS_KEYS:
+                    if l1b:
+                        total = total + l1b * jnp.sum(jnp.abs(v))
+                    if l2b:
+                        total = total + 0.5 * l2b * jnp.sum(v * v)
+                elif k in WEIGHT_KEYS:
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(v))
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(v * v)
+        return total
+
+    # ------------------------------------------------------------------
+    # The jitted train step — ONE XLA computation per step
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        g = self.conf.global_conf
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, (BaseOutputLayer, LossLayer)):
+            raise ValueError("Last layer must be an output/loss layer to fit()")
+
+        def step(params, state, opts, x, y, fmask, lmask, it, rng):
+            def loss_fn(p):
+                preout, new_states, m = self._forward_to_preout(
+                    p, state, x, fmask, True, rng,
+                    stateful_rnn=(self.conf.backprop_type == "truncatedbptt"))
+                lm = lmask if lmask is not None else (
+                    m if (m is not None and m.ndim == preout.ndim - 1) else None)
+                per_ex = out_layer.compute_score(y, preout, lm)
+                score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
+                score = score + self._reg_penalty(p)
+                if not g.minimize:
+                    score = -score
+                return score, new_states
+
+            (score, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+
+            new_params, new_opts = [], []
+            for i, layer in enumerate(self.layers):
+                gi = grads[i]
+                if not gi:
+                    new_params.append(params[i])
+                    new_opts.append(opts[i])
+                    continue
+                if self.frozen[i]:
+                    new_params.append(params[i])
+                    new_opts.append(opts[i])
+                    continue
+                gi = upd_ops.normalize_gradient(
+                    gi, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold or 1.0)
+                lr = upd_ops.schedule_lr(
+                    layer.learning_rate if layer.learning_rate is not None else g.learning_rate,
+                    g.lr_policy, it,
+                    decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                    power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
+                blr = layer.bias_learning_rate
+                upd, new_opt = self.updaters[i].apply(gi, opts[i], lr, it)
+                if blr is not None and blr != (layer.learning_rate or g.learning_rate):
+                    # bias LR override: rescale bias update (exact for linear-in-lr rules)
+                    base = layer.learning_rate if layer.learning_rate is not None else g.learning_rate
+                    scale = blr / base if base else 1.0
+                    upd = {k: (v * scale if k in BIAS_KEYS else v)
+                           for k, v in upd.items()}
+                new_params.append({k: params[i][k] - upd[k] for k in params[i]})
+                new_opts.append(new_opt)
+            return new_params, new_states, new_opts, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_score_fn(self):
+        out_layer = self.layers[-1]
+        g = self.conf.global_conf
+
+        def score_fn(params, state, x, y, fmask, lmask):
+            preout, _, m = self._forward_to_preout(params, state, x, fmask,
+                                                   False, jax.random.PRNGKey(0))
+            lm = lmask if lmask is not None else (
+                m if (m is not None and m.ndim == preout.ndim - 1) else None)
+            per_ex = out_layer.compute_score(y, preout, lm)
+            score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
+            return score + self._reg_penalty(params)
+
+        return jax.jit(score_fn)
+
+    def _build_output_fn(self):
+        def output_fn(params, state, x, fmask):
+            out, _, _ = self._forward(params, state, x, fmask, False,
+                                      jax.random.PRNGKey(0))
+            return out
+        return jax.jit(output_fn)
+
+    # ------------------------------------------------------------------
+    # Training API
+    # ------------------------------------------------------------------
+    def set_listeners(self, *listeners: IterationListener):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener: IterationListener):
+        self.listeners.append(listener)
+        return self
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(DataSetIterator) | fit(DataSet) | fit(x, y)
+        (ref: MultiLayerNetwork.fit :996)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator, DataSetIterator, ListDataSetIterator)
+
+        if labels is not None:
+            data = DataSet(np.asarray(data), np.asarray(labels))
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        assert isinstance(data, DataSetIterator)
+        if self.net_params is None:
+            self.init()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+
+        it = data
+        if it.async_supported() and not isinstance(it, AsyncDataSetIterator):
+            it = AsyncDataSetIterator(it, device_put=True)
+
+        for _ in range(epochs):
+            for lst in self.listeners:
+                if isinstance(lst, TrainingListener):
+                    lst.on_epoch_start(self)
+            it.reset()
+            t_etl = time.perf_counter()
+            while it.has_next():
+                ds = it.next()
+                self.last_etl_time_ms = (time.perf_counter() - t_etl) * 1e3
+                self._fit_batch(ds)
+                t_etl = time.perf_counter()
+            for lst in self.listeners:
+                if isinstance(lst, TrainingListener):
+                    lst.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, ds):
+        g = self.conf.global_conf
+        self.last_batch_size = ds.num_examples()
+        if self.conf.backprop_type == "truncatedbptt" and ds.features.ndim == 3:
+            self._fit_tbptt(ds)
+            return
+        for _ in range(max(1, g.iterations)):
+            self._key, sub = jax.random.split(self._key)
+            (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
+                self.net_params, self.net_state, self.opt_states,
+                ds.features, ds.labels, ds.features_mask, ds.labels_mask,
+                jnp.asarray(self.iteration, jnp.int32), sub)
+            self._strip_rnn_state()
+            self._score = score
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
+
+    def _fit_tbptt(self, ds):
+        """Truncated BPTT over time segments, carrying RNN state
+        (ref: MultiLayerNetwork.doTruncatedBPTT :1227)."""
+        T = ds.features.shape[1]  # native layout [N, T, C]
+        L = self.conf.tbptt_fwd_length
+        self.rnn_clear_previous_state()
+        for t0 in range(0, T, L):
+            seg = slice(t0, min(t0 + L, T))
+            f = ds.features[:, seg]
+            l = ds.labels[:, seg] if ds.labels.ndim == 3 else ds.labels
+            fm = ds.features_mask[:, seg] if ds.features_mask is not None else None
+            lm = ds.labels_mask[:, seg] if ds.labels_mask is not None else None
+            self._key, sub = jax.random.split(self._key)
+            (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
+                self.net_params, self.net_state, self.opt_states,
+                f, l, fm, lm, jnp.asarray(self.iteration, jnp.int32), sub)
+            self._score = score
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
+
+    def _strip_rnn_state(self):
+        """Drop per-batch RNN carry so standard training doesn't leak state
+        across minibatches (and jit sees a stable state structure)."""
+        if self.net_state is None:
+            return
+        self.net_state = [{k: v for k, v in s.items() if k != "rnn_state"}
+                          for s in self.net_state]
+
+    # ------------------------------------------------------------------
+    # Inference API
+    # ------------------------------------------------------------------
+    def output(self, x, train: bool = False, mask=None):
+        """(ref: MultiLayerNetwork.output :1668)"""
+        if self.net_params is None:
+            self.init()
+        if self._output_fn is None:
+            self._output_fn = self._build_output_fn()
+        return self._output_fn(self.net_params,
+                               [{k: v for k, v in s.items() if k != "rnn_state"}
+                                for s in self.net_state],
+                               jnp.asarray(x), mask)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions (ref: MultiLayerNetwork.predict :1456)."""
+        out = self.output(x)
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def feed_forward(self, x, train: bool = False, mask=None):
+        """All layer activations (ref: feedForward :696-788)."""
+        if self.net_params is None:
+            self.init()
+        acts = []
+        cur = jnp.asarray(x)
+        m = mask
+        self._key, sub = jax.random.split(self._key)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur, m = self.conf.preprocessors[i](cur, m)
+            s = {k: v for k, v in self.net_state[i].items() if k != "rnn_state"}
+            cur, _, m = layer.forward(self.net_params[i], s, cur, train=train,
+                                      rng=jax.random.fold_in(sub, i), mask=m)
+            acts.append(cur)
+        return acts
+
+    def score(self, dataset=None) -> float:
+        """Loss on a DataSet, or last training score
+        (ref: MultiLayerNetwork.score)."""
+        if dataset is None:
+            return float(self._score)
+        if self._score_fn is None:
+            self._score_fn = self._build_score_fn()
+        return float(self._score_fn(self.net_params, self.net_state,
+                                    dataset.features, dataset.labels,
+                                    dataset.features_mask, dataset.labels_mask))
+
+    def rnn_time_step(self, x):
+        """Stateful single/multi-step inference, carrying RNN state across
+        calls (ref: MultiLayerNetwork.rnnTimeStep :2383).  x: [N, T, C]."""
+        if self.net_params is None:
+            self.init()
+        x = jnp.asarray(x)
+        out, new_states, _ = self._forward(self.net_params, self.net_state, x,
+                                           None, False, jax.random.PRNGKey(0),
+                                           stateful_rnn=True)
+        # persist rnn carries (merge; BN stats unchanged in inference)
+        merged = []
+        for old, new in zip(self.net_state, new_states):
+            s = dict(old)
+            if "rnn_state" in new:
+                s["rnn_state"] = new["rnn_state"]
+            merged.append(s)
+        self.net_state = merged
+        return out
+
+    def rnn_clear_previous_state(self):
+        self._strip_rnn_state()
+
+    # ------------------------------------------------------------------
+    # Param view parity
+    # ------------------------------------------------------------------
+    def params(self) -> jnp.ndarray:
+        """Flat 1-D param vector (ref: Model.params() 1xN row view)."""
+        return param_util.flatten(self.net_params)
+
+    def set_params(self, flat) -> None:
+        self.net_params = param_util.unflatten(flat, self.net_params)
+
+    def num_params(self) -> int:
+        return param_util.num_params(self.net_params)
+
+    def get_layer_params(self, i: int) -> dict:
+        return self.net_params[i]
+
+    def updater_state_flat(self) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(self.opt_states)
+        if not leaves:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+    def set_updater_state_flat(self, flat) -> None:
+        leaves, treedef = jax.tree_util.tree_flatten(self.opt_states)
+        out, off = [], 0
+        flat = jnp.asarray(flat).reshape(-1)
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
+            off += n
+        self.opt_states = jax.tree_util.tree_unflatten(treedef, out)
+
+    # ------------------------------------------------------------------
+    def evaluate(self, iterator_or_dataset):
+        """Classification evaluation (ref: MultiLayerNetwork.evaluate)."""
+        from deeplearning4j_tpu.nn.evaluation import Evaluation
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        ev = Evaluation()
+        if isinstance(iterator_or_dataset, DataSet):
+            batches = [iterator_or_dataset]
+        else:
+            iterator_or_dataset.reset()
+            batches = iterator_or_dataset
+        for ds in batches:
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return ev
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self.net_params is not None:
+            net.init(params=jax.tree_util.tree_map(lambda a: a, self.net_params))
+            net.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
+            net.opt_states = jax.tree_util.tree_map(lambda a: a, self.opt_states)
+        return net

@@ -1,0 +1,61 @@
+"""Flat parameter view adapter.
+
+The reference stores ALL params as one 1xN row vector with per-layer
+views into it (ref: nn/api/Model.java:128 setParamsViewArray,
+MultiLayerNetwork.java:102 flattenedParams).  The native representation
+here is a pytree (list of per-layer dicts), but checkpoints, parameter
+averaging compat, and `params()`/`setParams()` parity need a canonical
+flattening order.  Order: layer index ascending, then within a layer the
+canonical key order below (W before b, matching
+DefaultParamInitializer / GravesLSTMParamInitializer orderings).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical within-layer ordering; unknown keys go last, alphabetically.
+PARAM_ORDER = ["W", "RW", "b", "pI", "pF", "pO", "gamma", "beta",
+               "f_W", "f_RW", "f_b", "f_pI", "f_pF", "f_pO",
+               "b_W", "b_RW", "b_b", "b_pI", "b_pF", "b_pO"]
+
+
+def ordered_keys(layer_params: dict) -> List[str]:
+    known = [k for k in PARAM_ORDER if k in layer_params]
+    rest = sorted(k for k in layer_params if k not in PARAM_ORDER)
+    return known + rest
+
+
+def num_params(params: List[dict]) -> int:
+    return sum(int(np.prod(v.shape)) for lp in params for v in lp.values())
+
+
+def flatten(params: List[dict]) -> jnp.ndarray:
+    """→ 1-D flat vector in canonical order (the reference's params())."""
+    flats = []
+    for lp in params:
+        for k in ordered_keys(lp):
+            flats.append(jnp.ravel(lp[k]))
+    if not flats:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(flats)
+
+
+def unflatten(flat, template: List[dict]) -> List[dict]:
+    """Inverse of flatten, shaped like `template` (the reference's setParams())."""
+    out = []
+    off = 0
+    flat = jnp.asarray(flat).reshape(-1)
+    for lp in template:
+        new = {}
+        for k in ordered_keys(lp):
+            n = int(np.prod(lp[k].shape))
+            new[k] = flat[off:off + n].reshape(lp[k].shape).astype(lp[k].dtype)
+            off += n
+        out.append(new)
+    if off != flat.shape[0]:
+        raise ValueError(f"Param count mismatch: template {off} vs flat {flat.shape[0]}")
+    return out

@@ -1,0 +1,9 @@
+"""Tensor/runtime substrate — the nd4j/libnd4j surface, TPU-natively.
+
+The reference consumes an external numerics stack (nd4j-api / libnd4j C++,
+SURVEY.md §2.10).  Here that layer is jax.numpy / XLA HLO: ops are pure
+functions, compiled and fused by XLA, with Pallas kernels where fusion
+needs help.
+"""
+
+from deeplearning4j_tpu.ops import activations, losses, initializers, updaters  # noqa: F401

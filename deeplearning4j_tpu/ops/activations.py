@@ -1,0 +1,136 @@
+"""Activation functions — the reference's ``IActivation`` surface.
+
+Covers the reference's ``nn/conf/layers`` activation strings (identity,
+cube, elu, hardsigmoid, hardtanh, leakyrelu, relu, rrelu, sigmoid,
+softmax, softplus, softsign, tanh, rationaltanh; ref: nd4j IActivation
+impls consumed by BaseLayer.activate).  Each is a pure jnp function so
+XLA fuses it into the surrounding matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+ActivationFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def identity(x):
+    return x
+
+
+def cube(x):
+    return x * x * x
+
+
+def elu(x):
+    return jax.nn.elu(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def softmax(x):
+    # Softmax over the feature axis (axis 1 for [N, C]; last axis generally).
+    return jax.nn.softmax(x, axis=-1)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def rationaltanh(x):
+    # Padé-style rational approximation of tanh used by the reference's
+    # ActivationRationalTanh: 1.7159 * tanh_approx(2x/3).
+    a = 2.0 * x / 3.0
+    approx = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a * a + 1.41645 * a * a * a * a))
+    return 1.7159 * approx
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+_REGISTRY: dict[str, ActivationFn] = {
+    "identity": identity,
+    "linear": identity,
+    "cube": cube,
+    "elu": elu,
+    "hardsigmoid": hardsigmoid,
+    "hardtanh": hardtanh,
+    "leakyrelu": leakyrelu,
+    "relu": relu,
+    "relu6": relu6,
+    "sigmoid": sigmoid,
+    "softmax": softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "tanh": tanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "selu": selu,
+    "swish": swish,
+    "gelu": gelu,
+}
+
+
+def get(name: str) -> ActivationFn:
+    """Look up an activation by its reference-compatible string name."""
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register(name: str, fn: ActivationFn) -> None:
+    """Register a custom activation (the reference supports custom IActivation)."""
+    _REGISTRY[name.lower()] = fn
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,127 @@
+"""Convolution and pooling primitives.
+
+The reference lowers conv through cuDNN or im2col+gemm
+(ref: nn/layers/convolution/ConvolutionLayer.java:171-212, im2col at
+Convolution.im2col).  On TPU the idiomatic lowering is a single
+``lax.conv_general_dilated`` HLO which XLA tiles directly onto the MXU —
+no im2col materialization, and elementwise bias+activation fuse into the
+same kernel.  Data layout is NCHW at the API surface (reference
+convention); weights are OIHW ([out, in, kh, kw], matching
+ConvolutionParamInitializer).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DIMNUMS = ("NCHW", "OIHW", "NCHW")
+
+
+def _same_pad(kernel: Sequence[int], stride: Sequence[int], pad: Sequence[int],
+              mode: str) -> list[Tuple[int, int]]:
+    if mode == "same":
+        return "SAME"
+    return [(pad[0], pad[0]), (pad[1], pad[1])]
+
+
+def conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0), dilation=(1, 1),
+           border_mode: str = "truncate", accum_dtype=jnp.float32):
+    """2D convolution, NCHW in / OIHW weights.
+
+    border_mode: 'truncate' (explicit pad, the reference's Truncate) or
+    'same' (the reference's ConvolutionMode.Same).
+    """
+    padding = _same_pad(w.shape[2:], stride, pad, "same" if border_mode == "same" else "explicit")
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=_DIMNUMS,
+        preferred_element_type=accum_dtype,
+    )
+    if b is not None:
+        y = y + b.reshape(1, -1, 1, 1)
+    return y.astype(x.dtype)
+
+
+def conv2d_output_shape(in_hw, kernel, stride, pad, dilation=(1, 1),
+                        border_mode: str = "truncate"):
+    if border_mode == "same":
+        return tuple(-(-d // s) for d, s in zip(in_hw, stride))
+    out = []
+    for d, k, s, p, dl in zip(in_hw, kernel, stride, pad, dilation):
+        eff_k = (k - 1) * dl + 1
+        out.append((d + 2 * p - eff_k) // s + 1)
+    return tuple(out)
+
+
+def pool2d(x, kind: str, kernel=(2, 2), stride=(2, 2), pad=(0, 0),
+           border_mode: str = "truncate", pnorm: int = 2):
+    """Pooling over NCHW spatial dims: max | avg | sum | pnorm.
+
+    Matches the reference's SubsamplingLayer pooling types
+    (ref: nn/layers/convolution/subsampling/SubsamplingLayer.java:76).
+    """
+    window = (1, 1, kernel[0], kernel[1])
+    strides = (1, 1, stride[0], stride[1])
+    if border_mode == "same":
+        padding = "SAME"
+    else:
+        padding = [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])]
+    kind = kind.lower()
+    if kind == "max":
+        neg_inf = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, neg_inf, lax.max, window, strides, padding)
+    if kind in ("avg", "mean"):
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if padding == "SAME":
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+            return summed / counts
+        return summed / (kernel[0] * kernel[1])
+    if kind == "sum":
+        return lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+    if kind == "pnorm":
+        p = float(pnorm)
+        summed = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding)
+        return summed ** (1.0 / p)
+    raise ValueError(f"Unknown pooling type '{kind}'")
+
+
+def zero_pad2d(x, pad_top, pad_bottom, pad_left, pad_right):
+    """ZeroPaddingLayer (ref: nn/conf/layers/ZeroPaddingLayer)."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_top, pad_bottom), (pad_left, pad_right)))
+
+
+def global_pool(x, kind: str, axes, pnorm: int = 2, mask=None):
+    """GlobalPoolingLayer semantics (ref: nn/layers/pooling/GlobalPoolingLayer.java).
+
+    axes: the dims to reduce (e.g. (2,3) for CNN NCHW, (2,) for RNN [N,C,T]).
+    mask: optional broadcastable mask (1=keep) for variable-length inputs —
+    matches MaskedReductionUtil semantics.
+    """
+    kind = kind.lower()
+    if mask is not None:
+        mask = mask.astype(x.dtype)
+        if kind == "max":
+            x = jnp.where(mask > 0, x, -jnp.inf)
+        else:
+            x = x * mask
+    if kind == "max":
+        return jnp.max(x, axis=axes)
+    if kind == "sum":
+        return jnp.sum(x, axis=axes)
+    if kind in ("avg", "mean"):
+        if mask is not None:
+            denom = jnp.sum(mask, axis=axes)
+            return jnp.sum(x, axis=axes) / jnp.maximum(denom, 1e-8)
+        return jnp.mean(x, axis=axes)
+    if kind == "pnorm":
+        p = float(pnorm)
+        return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+    raise ValueError(f"Unknown global pooling type '{kind}'")

@@ -1,0 +1,48 @@
+"""Precision policy for TPU execution.
+
+The reference runs float32 (or double for gradient checks,
+ref: gradientcheck/GradientCheckUtil.java:87-92).  On TPU the idiomatic
+policy is: parameters and activations bfloat16-capable with float32
+accumulation on the MXU (``preferred_element_type``), float32 master
+params/updater state, and float64 only on the CPU backend for gradient
+checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Mixed-precision policy applied by the training engine."""
+
+    param_dtype: jnp.dtype = jnp.float32   # master copy of params
+    compute_dtype: jnp.dtype = jnp.float32  # activations / matmul inputs
+    accum_dtype: jnp.dtype = jnp.float32    # MXU accumulation / reductions
+
+    def cast_to_compute(self, tree):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype) if hasattr(x, "astype") else x, tree
+        )
+
+
+FLOAT32 = Policy()
+# bfloat16 compute with f32 accumulation: the TPU-native fast path.
+BF16 = Policy(param_dtype=jnp.float32, compute_dtype=jnp.bfloat16, accum_dtype=jnp.float32)
+# float64: gradient-check precision, CPU backend only (TPU f64 is emulated).
+FLOAT64 = Policy(param_dtype=jnp.float64, compute_dtype=jnp.float64, accum_dtype=jnp.float64)
+
+_default_policy = FLOAT32
+
+
+def set_default_policy(policy: Policy) -> None:
+    global _default_policy
+    _default_policy = policy
+
+
+def default_policy() -> Policy:
+    return _default_policy

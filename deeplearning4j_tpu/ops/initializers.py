@@ -1,0 +1,88 @@
+"""Weight initialization — the reference's ``WeightInit`` schemes.
+
+Covers WeightInit.java:47-49 (ZERO, ONE, SIGMOID_UNIFORM, NORMAL,
+LECUN_NORMAL, UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN,
+XAVIER_LEGACY, RELU, RELU_UNIFORM, DISTRIBUTION, LECUN_UNIFORM) as pure
+functions of a jax PRNG key — the reference mutates a shared RNG; here
+every init is reproducible from a key (ref: WeightInitUtil.java).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int], fan_in=None, fan_out=None):
+    """fan_in/fan_out conventions: [nIn, nOut] for dense; OIHW
+    [cout, cin, kh, kw] for conv (the project-wide conv weight layout)."""
+    if fan_in is not None and fan_out is not None:
+        return fan_in, fan_out
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 4:
+        receptive = shape[2] * shape[3]
+        return shape[1] * receptive, shape[0] * receptive
+    n = 1
+    for s in shape:
+        n *= s
+    return n, n
+
+
+def init(key, name: str, shape, dtype=jnp.float32, fan_in=None, fan_out=None,
+         distribution=None):
+    """Draw an initial weight array per the named scheme."""
+    name = name.lower()
+    fi, fo = _fans(shape, fan_in, fan_out)
+    if name == "zero":
+        return jnp.zeros(shape, dtype)
+    if name in ("one", "ones"):
+        return jnp.ones(shape, dtype)
+    if name == "normal" or name == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fi, dtype))
+    if name == "uniform":
+        a = 1.0 / jnp.sqrt(jnp.asarray(fi, dtype))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier":
+        std = jnp.sqrt(2.0 / jnp.asarray(fi + fo, dtype))
+        return jax.random.normal(key, shape, dtype) * std
+    if name == "xavier_uniform":
+        a = jnp.sqrt(6.0 / jnp.asarray(fi + fo, dtype))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fi, dtype))
+    if name == "xavier_legacy":
+        std = jnp.sqrt(1.0 / jnp.asarray(fi + fo, dtype))
+        return jax.random.normal(key, shape, dtype) * std
+    if name == "relu":
+        return jax.random.normal(key, shape, dtype) * jnp.sqrt(2.0 / jnp.asarray(fi, dtype))
+    if name == "relu_uniform":
+        a = jnp.sqrt(6.0 / jnp.asarray(fi, dtype))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "sigmoid_uniform":
+        a = 4.0 * jnp.sqrt(6.0 / jnp.asarray(fi + fo, dtype))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "lecun_uniform":
+        a = jnp.sqrt(3.0 / jnp.asarray(fi, dtype))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit DISTRIBUTION requires a distribution spec")
+        return sample_distribution(key, distribution, shape, dtype)
+    raise ValueError(f"Unknown WeightInit scheme '{name}'")
+
+
+def sample_distribution(key, dist: dict, shape, dtype=jnp.float32):
+    """Reference Distribution configs: normal/gaussian, uniform, binomial."""
+    kind = dist.get("type", "normal").lower()
+    if kind in ("normal", "gaussian"):
+        return dist.get("mean", 0.0) + dist.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        return jax.random.uniform(key, shape, dtype, dist.get("lower", 0.0), dist.get("upper", 1.0))
+    if kind == "binomial":
+        n = dist.get("n", 1)
+        p = dist.get("p", 0.5)
+        return jax.random.binomial(key, n, p, shape).astype(dtype)
+    raise ValueError(f"Unknown distribution type '{kind}'")

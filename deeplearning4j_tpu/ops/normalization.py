@@ -1,0 +1,63 @@
+"""Normalization ops: batch normalization and local response normalization.
+
+The reference implements these as layers with optional cuDNN helpers
+(ref: nn/layers/normalization/BatchNormalization.java,
+LocalResponseNormalization.java:69, cuDNN helpers in deeplearning4j-cuda).
+On TPU both are plain HLO that XLA fuses; running statistics are carried
+functionally (state-in/state-out) rather than mutated.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
+                     decay: float = 0.9, eps: float = 1e-5):
+    """Training-mode batchnorm over feature axis 1 (dense [N,C] or conv NCHW).
+
+    Returns (y, new_running_mean, new_running_var).  `decay` matches the
+    reference's BatchNormalization.decay (momentum on running stats).
+    """
+    axes = (0,) if x.ndim == 2 else (0, 2, 3)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
+    xn = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    y = gamma.reshape(shape) * xn + beta.reshape(shape)
+    new_mean = decay * running_mean + (1 - decay) * mean
+    new_var = decay * running_var + (1 - decay) * var
+    return y, new_mean, new_var
+
+
+def batch_norm_infer(x, gamma, beta, running_mean, running_var, *, eps: float = 1e-5):
+    shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
+    xn = (x - running_mean.reshape(shape)) / jnp.sqrt(running_var.reshape(shape) + eps)
+    return gamma.reshape(shape) * xn + beta.reshape(shape)
+
+
+def local_response_norm(x, *, k: float = 2.0, n: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75):
+    """Across-channel LRN on NCHW (AlexNet-style), reference defaults
+    (ref: nn/conf/layers/LocalResponseNormalization k=2,n=5,alpha=1e-4,beta=0.75)."""
+    half = n // 2
+    sq = jnp.square(x)
+    # Sum over a window of `n` channels via padded cumulative trick.
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = [padded[:, i:i + x.shape[1]] for i in range(n)]
+    summed = sum(windows)
+    denom = jnp.power(k + alpha * summed, beta)
+    return x / denom
+
+
+def dropout(x, rate: float, rng, *, inverted: bool = True):
+    """Inverted dropout (ref: util/Dropout.java — DL4J's dropOut conf value is
+    the RETAIN probability; here `rate` is the retain probability too for parity)."""
+    import jax
+    if rate >= 1.0 or rate <= 0.0:
+        return x
+    keep = rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

@@ -1,0 +1,85 @@
+"""Recurrent primitives: peephole (Graves) LSTM cell and time-scan.
+
+The reference's GravesLSTM runs an eager per-timestep loop of gemms
+(ref: nn/layers/recurrent/LSTMHelpers.java:60-164 — the shared
+activateHelper/backpropGradientHelper).  TPU-natively the whole sequence
+is a single ``lax.scan`` whose body is one fused [N, nIn+nOut] x
+[nIn+nOut, 4*nOut] matmul on the MXU; backprop through time falls out of
+jax.grad over the scan instead of the reference's hand-written BPTT.
+
+Gate layout in the fused weight matrices is [input, forget, output, cell]
+blocks of width H (matches GravesLSTMParamInitializer's iFogOrdering).
+Peephole connections (the "Graves" part) are separate [H] vectors rather
+than the reference's trick of packing them as 3 extra recurrent-weight
+columns (ref: GravesLSTMParamInitializer RW shape [nOut, 4*nOut+3]).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class LSTMState(NamedTuple):
+    c: jnp.ndarray  # cell state  [N, H]
+    h: jnp.ndarray  # hidden/output state [N, H]
+
+
+def lstm_cell(params: dict, x_t: jnp.ndarray, state: LSTMState,
+              gate_act=jax.nn.sigmoid, cell_act=jnp.tanh,
+              peephole: bool = True) -> Tuple[LSTMState, jnp.ndarray]:
+    """One peephole-LSTM step.  params: W [nIn,4H], RW [H,4H], b [4H],
+    pI/pF/pO [H] (if peephole)."""
+    H = state.h.shape[-1]
+    z = x_t @ params["W"] + state.h @ params["RW"] + params["b"]
+    zi, zf, zo, zc = jnp.split(z, 4, axis=-1)
+    if peephole:
+        zi = zi + state.c * params["pI"]
+        zf = zf + state.c * params["pF"]
+    i = gate_act(zi)
+    f = gate_act(zf)
+    g = cell_act(zc)
+    c_new = f * state.c + i * g
+    if peephole:
+        zo = zo + c_new * params["pO"]
+    o = gate_act(zo)
+    h_new = o * cell_act(c_new)
+    return LSTMState(c_new, h_new), h_new
+
+
+def lstm_scan(params: dict, x: jnp.ndarray, init: Optional[LSTMState] = None,
+              mask: Optional[jnp.ndarray] = None, reverse: bool = False,
+              gate_act=jax.nn.sigmoid, cell_act=jnp.tanh,
+              peephole: bool = True) -> Tuple[jnp.ndarray, LSTMState]:
+    """Run the LSTM over a full sequence.
+
+    x: [N, T, nIn] (time-major internally for scan).  mask: [N, T] with 1 for
+    valid steps — masked steps carry state through unchanged, matching the
+    reference's variable-length masking semantics (Layer.feedForwardMaskArray).
+    Returns (outputs [N, T, H], final_state).
+    """
+    N, T, _ = x.shape
+    H = params["RW"].shape[0]
+    if init is None:
+        init = LSTMState(jnp.zeros((N, H), x.dtype), jnp.zeros((N, H), x.dtype))
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, N, nIn]
+    ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(carry: LSTMState, inp):
+        if ms is None:
+            x_t = inp
+            new, h = lstm_cell(params, x_t, carry, gate_act, cell_act, peephole)
+            return new, h
+        x_t, m_t = inp
+        new, h = lstm_cell(params, x_t, carry, gate_act, cell_act, peephole)
+        c = jnp.where(m_t > 0, new.c, carry.c)
+        hh = jnp.where(m_t > 0, new.h, carry.h)
+        return LSTMState(c, hh), hh * (m_t > 0)
+
+    inputs = xs if ms is None else (xs, ms)
+    final, hs = lax.scan(step, init, inputs, reverse=reverse)
+    return jnp.swapaxes(hs, 0, 1), final

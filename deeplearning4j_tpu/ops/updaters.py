@@ -1,0 +1,195 @@
+"""Learning rules (updaters), LR schedules, and gradient normalization.
+
+The reference applies per-param-block ``GradientUpdater`` rules in place on
+the flat gradient view and then does ``params -= gradient``
+(ref: nn/updater/UpdaterBlock.java:98-117,
+optimize/solvers/StochasticGradientDescent.java:60; enum
+nn/conf/Updater.java:9-10: SGD, ADAM, ADADELTA, NESTEROVS, ADAGRAD,
+RMSPROP, NONE).  Here each rule is a pure function over pytrees fused by
+XLA into the jitted train step: ``init(params) -> state``,
+``apply(grad, state, lr, t) -> (update, state)`` with
+``params_new = params - update``.
+
+LR schedules (ref: nn/conf/LearningRatePolicy.java) are pure functions of
+the iteration counter so they trace into the compiled step — no
+recompilation per iteration.  Gradient normalization
+(ref: nn/conf/GradientNormalization.java) operates per layer or per
+param-type on the gradient pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+tree_map = jax.tree_util.tree_map
+
+
+# --------------------------------------------------------------------------
+# LR schedules (LearningRatePolicy)
+# --------------------------------------------------------------------------
+
+def schedule_lr(base_lr, policy: Optional[str], iteration, *,
+                decay_rate=None, steps=None, power=None, schedule_map=None):
+    """Compute the effective LR at `iteration` (traced; policy is static).
+
+    Policies per the reference's LearningRatePolicy enum: None, Exponential
+    (lr*gamma^iter), Inverse (lr/(1+gamma*iter)^power), Poly
+    (lr*(1-iter/maxIter)^power), Sigmoid (lr/(1+exp(-gamma*(iter-steps)))),
+    Step (lr*gamma^floor(iter/steps)), TorchStep, Schedule (explicit map).
+    """
+    it = jnp.asarray(iteration, jnp.float32)
+    if policy is None or policy.lower() in ("none", "fixed"):
+        return jnp.asarray(base_lr, jnp.float32)
+    p = policy.lower()
+    if p == "exponential":
+        return base_lr * jnp.power(decay_rate, it)
+    if p == "inverse":
+        return base_lr / jnp.power(1.0 + decay_rate * it, power)
+    if p == "poly":
+        return base_lr * jnp.power(1.0 - it / jnp.maximum(steps, 1.0), power)
+    if p == "sigmoid":
+        return base_lr / (1.0 + jnp.exp(-decay_rate * (it - steps)))
+    if p == "step":
+        return base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == "torchstep":
+        return base_lr * jnp.power(decay_rate, jnp.floor(it / steps))
+    if p == "schedule":
+        # schedule_map: {iteration: lr}; piecewise-constant, traced via where-chain.
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for k in sorted(schedule_map or {}, key=float):
+            lr = jnp.where(it >= float(k), jnp.asarray(schedule_map[k], jnp.float32), lr)
+        return lr
+    raise ValueError(f"Unknown learning rate policy '{policy}'")
+
+
+# --------------------------------------------------------------------------
+# Updater rules
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """A learning rule over a single param pytree."""
+
+    name: str
+    hyper: dict
+
+    def init(self, params) -> Any:
+        n = self.name
+        zeros_like = lambda: tree_map(jnp.zeros_like, params)  # noqa: E731
+        if n in ("sgd", "none"):
+            return ()
+        if n == "nesterovs":
+            return {"v": zeros_like()}
+        if n == "adagrad":
+            return {"g2": zeros_like()}
+        if n == "rmsprop":
+            return {"g2": zeros_like()}
+        if n == "adadelta":
+            return {"g2": zeros_like(), "dx2": zeros_like()}
+        if n in ("adam", "adamax"):
+            return {"m": zeros_like(), "v": zeros_like()}
+        raise ValueError(f"Unknown updater '{n}'")
+
+    def apply(self, grads, state, lr, t):
+        """Return (update, new_state); caller does params -= update."""
+        n = self.name
+        h = self.hyper
+        if n == "none":
+            return tree_map(jnp.zeros_like, grads), state
+        if n == "sgd":
+            return tree_map(lambda g: lr * g, grads), state
+        if n == "nesterovs":
+            # v_new = mu*v - lr*g; update = mu*v_prev - (1+mu)*v_new, applied as
+            # params -= update (matches nd4j Nesterovs.getGradient).
+            mu = h.get("momentum", 0.9)
+            v_new = tree_map(lambda v, g: mu * v - lr * g, state["v"], grads)
+            upd = tree_map(lambda vp, vn: mu * vp - (1 + mu) * vn, state["v"], v_new)
+            return upd, {"v": v_new}
+        if n == "adagrad":
+            eps = h.get("epsilon", 1e-6)
+            g2 = tree_map(lambda a, g: a + g * g, state["g2"], grads)
+            upd = tree_map(lambda g, a: lr * g / (jnp.sqrt(a) + eps), grads, g2)
+            return upd, {"g2": g2}
+        if n == "rmsprop":
+            decay = h.get("rmsdecay", 0.95)
+            eps = h.get("epsilon", 1e-8)
+            g2 = tree_map(lambda a, g: decay * a + (1 - decay) * g * g, state["g2"], grads)
+            upd = tree_map(lambda g, a: lr * g / jnp.sqrt(a + eps), grads, g2)
+            return upd, {"g2": g2}
+        if n == "adadelta":
+            rho = h.get("rho", 0.95)
+            eps = h.get("epsilon", 1e-6)
+            g2 = tree_map(lambda a, g: rho * a + (1 - rho) * g * g, state["g2"], grads)
+            upd = tree_map(
+                lambda g, a, d: g * jnp.sqrt(d + eps) / jnp.sqrt(a + eps),
+                grads, g2, state["dx2"])
+            dx2 = tree_map(lambda d, u: rho * d + (1 - rho) * u * u, state["dx2"], upd)
+            return upd, {"g2": g2, "dx2": dx2}
+        if n == "adam":
+            b1 = h.get("beta1", 0.9)
+            b2 = h.get("beta2", 0.999)
+            eps = h.get("epsilon", 1e-8)
+            tf = jnp.asarray(t, jnp.float32) + 1.0
+            m = tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+            v = tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+            alpha = lr * jnp.sqrt(1 - jnp.power(b2, tf)) / (1 - jnp.power(b1, tf))
+            upd = tree_map(lambda m_, v_: alpha * m_ / (jnp.sqrt(v_) + eps), m, v)
+            return upd, {"m": m, "v": v}
+        if n == "adamax":
+            b1 = h.get("beta1", 0.9)
+            b2 = h.get("beta2", 0.999)
+            eps = h.get("epsilon", 1e-8)
+            tf = jnp.asarray(t, jnp.float32) + 1.0
+            m = tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+            v = tree_map(lambda v_, g: jnp.maximum(b2 * v_, jnp.abs(g)), state["v"], grads)
+            alpha = lr / (1 - jnp.power(b1, tf))
+            upd = tree_map(lambda m_, v_: alpha * m_ / (v_ + eps), m, v)
+            return upd, {"m": m, "v": v}
+        raise ValueError(f"Unknown updater '{n}'")
+
+
+def make(name: str, **hyper) -> Updater:
+    return Updater(name=name.lower(), hyper=hyper)
+
+
+# --------------------------------------------------------------------------
+# Gradient normalization (GradientNormalization.java)
+# --------------------------------------------------------------------------
+
+def _l2(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in leaves) + 1e-30)
+
+
+def normalize_gradient(grads, mode: Optional[str], threshold: float = 1.0):
+    """Apply the reference's gradient normalization to a per-layer grad dict.
+
+    grads: pytree for ONE layer ({param_name: array}).  Modes:
+    RenormalizeL2PerLayer, RenormalizeL2PerParamType,
+    ClipElementWiseAbsoluteValue, ClipL2PerLayer, ClipL2PerParamType.
+    """
+    if mode is None or mode == "None":
+        return grads
+    m = mode.lower()
+    if m == "renormalizel2perlayer":
+        norm = _l2(grads)
+        return tree_map(lambda g: g / norm, grads)
+    if m == "renormalizel2perparamtype":
+        return {k: v / _l2(v) for k, v in grads.items()}
+    if m == "clipelementwiseabsolutevalue":
+        return tree_map(lambda g: jnp.clip(g, -threshold, threshold), grads)
+    if m == "clipl2perlayer":
+        norm = _l2(grads)
+        scale = jnp.minimum(1.0, threshold / norm)
+        return tree_map(lambda g: g * scale, grads)
+    if m == "clipl2perparamtype":
+        out = {}
+        for k, v in grads.items():
+            norm = _l2(v)
+            out[k] = v * jnp.minimum(1.0, threshold / norm)
+        return out
+    raise ValueError(f"Unknown gradient normalization '{mode}'")

@@ -1,0 +1,12 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+multi-chip sharding tests run without TPU hardware (the cuDNN-vs-builtin
+cross-check pattern of the reference, SURVEY.md §4, becomes
+TPU-vs-CPU-interpreter: the same code paths compile on both)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

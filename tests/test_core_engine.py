@@ -1,0 +1,159 @@
+"""Core engine tests: config round-trip, fit on Iris/synthetic-MNIST,
+score decrease, evaluation — modeled on the reference's
+deeplearning4j-core test strategy (MultiLayerTest.java, BackPropMLPTest.java)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator, load_iris
+
+
+def iris_mlp_conf(updater="sgd", lr=0.1):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .learning_rate(lr)
+            .updater(updater)
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+class TestConfig:
+    def test_json_roundtrip(self):
+        conf = iris_mlp_conf()
+        j = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(j)
+        assert len(conf2.layers) == 2
+        assert conf2.layers[0].n_out == 16
+        assert conf2.layers[1].loss == "mcxent"
+        assert conf2.to_json() == j
+
+    def test_global_override_merge(self):
+        conf = (NeuralNetConfiguration.builder()
+                .learning_rate(0.5)
+                .updater("adam")
+                .activation("tanh")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(DenseLayer(n_out=8, activation="relu", learning_rate=0.1))
+                .layer(OutputLayer(n_out=3, loss="mcxent", activation="softmax"))
+                .build())
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[0].learning_rate == 0.5
+        assert conf.layers[1].activation == "relu"
+        assert conf.layers[1].learning_rate == 0.1
+        assert conf.layers[0].updater == "adam"
+
+    def test_input_type_inference_cnn(self):
+        conf = (NeuralNetConfiguration.builder()
+                .list()
+                .layer(ConvolutionLayer(n_out=6, kernel=(5, 5)))
+                .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        # conv: 28-5+1=24 → pool 12 → dense nIn = 12*12*6
+        assert conf.layers[0].n_in == 1
+        assert conf.layers[2].n_in == 12 * 12 * 6
+        assert 2 in conf.preprocessors  # CnnToFF inserted
+
+
+class TestTraining:
+    def test_iris_score_decreases(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        ds = load_iris().shuffle(0)
+        s0 = net.score(ds)
+        net.fit(IrisDataSetIterator(50), epochs=30)
+        s1 = net.score(ds)
+        assert s1 < s0 * 0.7, f"score did not decrease: {s0} -> {s1}"
+
+    def test_iris_accuracy(self):
+        from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        net = MultiLayerNetwork(iris_mlp_conf(updater="adam", lr=0.02)).init()
+        ds = load_iris().shuffle(0)
+        norm = NormalizerStandardize().fit(ds)
+        ds = norm.transform(ds)
+        net.fit(ListDataSetIterator(ds, 50), epochs=60)
+        ev = net.evaluate(ds)
+        assert ev.accuracy() > 0.9, ev.stats()
+
+    @pytest.mark.parametrize("updater", ["sgd", "adam", "nesterovs", "rmsprop",
+                                         "adagrad", "adadelta"])
+    def test_all_updaters_reduce_loss(self, updater):
+        lr = {"adadelta": 1.0, "adam": 0.05, "rmsprop": 0.01}.get(updater, 0.1)
+        net = MultiLayerNetwork(iris_mlp_conf(updater=updater, lr=lr)).init()
+        ds = load_iris().shuffle(1)
+        s0 = net.score(ds)
+        net.fit(IrisDataSetIterator(150), epochs=40)
+        assert net.score(ds) < s0
+
+    def test_param_flat_view_roundtrip(self):
+        net = MultiLayerNetwork(iris_mlp_conf()).init()
+        flat = net.params()
+        assert flat.shape == (4 * 16 + 16 + 16 * 3 + 3,)
+        net2 = MultiLayerNetwork(iris_mlp_conf()).init()
+        net2.set_params(flat)
+        np.testing.assert_allclose(np.asarray(net2.params()),
+                                   np.asarray(flat), rtol=1e-6)
+        out1 = net.output(load_iris().features[:5])
+        out2 = net2.output(load_iris().features[:5])
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), rtol=1e-5)
+
+
+class TestCnn:
+    def test_lenet_forward_shapes(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7)
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max"))
+                .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max"))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax"))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 1, 28, 28)).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (4, 10)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_cnn_with_batchnorm_trains(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3)
+                .learning_rate(0.05)
+                .updater("adam")
+                .list()
+                .layer(ConvolutionLayer(n_out=8, kernel=(3, 3), activation="identity"))
+                .layer(BatchNormalization(activation="relu"))
+                .layer(SubsamplingLayer())
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=10, activation="softmax"))
+                .set_input_type(InputType.convolutional(14, 14, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        x = rng.normal(size=(64, 1, 14, 14)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
+        ds = DataSet(x, y)
+        s0 = net.score(ds)
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        net.fit(ListDataSetIterator(ds, 32), epochs=20)
+        assert net.score(ds) < s0
+        # BN running stats must have moved
+        assert not np.allclose(np.asarray(net.net_state[1]["mean"]), 0.0)
