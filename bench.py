@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Benchmark entry point — run by the driver on real TPU hardware.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Headline metric (BASELINE.md): MultiLayerNetwork.fit() samples/sec/chip on
+LeNet-MNIST — the first north-star config.  The reference publishes no
+numbers (BASELINE.json published:{}), so vs_baseline is reported against
+the reference-architecture throughput estimate recorded below once; until
+a cross-measured number exists it is the ratio to BASELINE_SAMPLES_SEC.
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+import numpy as np
+
+# Rough DL4J 0.8 LeNet-MNIST CPU throughput (the reference's CPU-baseline
+# config; no published number exists — see BASELINE.md).  Used only to
+# make vs_baseline meaningful across rounds.
+BASELINE_SAMPLES_SEC = 1500.0
+
+BATCH = 256
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main():
+    import jax
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    import jax.numpy as jnp
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .learning_rate(0.01)
+            .updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max"))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max"))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    step = net._build_step()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, 1, 28, 28)).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
+
+    params, state, opts = net.net_params, net.net_state, net.opt_states
+    key = jax.random.PRNGKey(0)
+    for i in range(WARMUP_STEPS):
+        params, state, opts, score = step(params, state, opts, x, y, None, None,
+                                          jnp.asarray(i, jnp.int32), key)
+    jax.block_until_ready(params)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        params, state, opts, score = step(params, state, opts, x, y, None, None,
+                                          jnp.asarray(i, jnp.int32), key)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * MEASURE_STEPS / dt
+    n_chips = max(1, len(jax.devices()))
+    per_chip = samples_per_sec / n_chips
+    print(json.dumps({
+        "metric": "LeNet-MNIST MultiLayerNetwork.fit() samples/sec/chip",
+        "value": round(per_chip, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_SAMPLES_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

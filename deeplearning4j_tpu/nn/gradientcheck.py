@@ -1,0 +1,120 @@
+"""Numeric-vs-analytic gradient checking — the correctness backbone.
+
+(ref: gradientcheck/GradientCheckUtil.java:77 — perturbs each param ±ε in
+double precision and compares relative error; the reference's test suites
+in deeplearning4j-core/src/test/java/org/deeplearning4j/gradientcheck/
+are the model for tests/test_gradientcheck.py.)
+
+TPU f64 is emulated/slow, so checks run under the CPU backend with x64
+enabled (the cuDNN-vs-builtin cross-validation pattern of
+CuDNNGradientChecks.java becomes TPU-vs-CPU here: same code, two
+backends).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import params as param_util
+
+
+def check_gradients(net, x, y, *, epsilon: float = 1e-6,
+                    max_rel_error: float = 1e-3, min_abs_error: float = 1e-8,
+                    fmask=None, lmask=None, subset: Optional[int] = 128,
+                    seed: int = 0, print_results: bool = False) -> bool:
+    """Compare jax.grad of the training loss against central finite
+    differences, param by param (ref: GradientCheckUtil.checkGradients).
+
+    subset: max number of randomly-chosen scalar params to check per layer
+    (None = exhaustive, as the reference does).
+    Returns True if every checked param's relative error is within bounds.
+
+    float64 is enabled locally via the jax.experimental.enable_x64 context
+    (the reference forces double precision the same way,
+    GradientCheckUtil.java:87-92) so callers/tests don't leak x64 into the
+    rest of the process.
+    """
+    with jax.enable_x64(True):
+        return _check_gradients_x64(
+            net, x, y, epsilon=epsilon, max_rel_error=max_rel_error,
+            min_abs_error=min_abs_error, fmask=fmask, lmask=lmask,
+            subset=subset, seed=seed, print_results=print_results)
+
+
+def _check_gradients_x64(net, x, y, *, epsilon, max_rel_error, min_abs_error,
+                         fmask, lmask, subset, seed, print_results) -> bool:
+    if net.net_params is None:
+        net.init()
+    out_layer = net.layers[-1]
+    g = net.conf.global_conf
+    rng = jax.random.PRNGKey(seed)
+
+    params64 = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.net_params)
+    state64 = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.net_state)
+    x64 = jnp.asarray(np.asarray(x), jnp.float64)
+    y64 = jnp.asarray(np.asarray(y), jnp.float64)
+
+    def score(p):
+        preout, _, m = net._forward_to_preout(p, state64, x64, fmask, True, rng)
+        lm = lmask if lmask is not None else (
+            m if (m is not None and m.ndim == preout.ndim - 1) else None)
+        per_ex = out_layer.compute_score(y64, preout, lm)
+        s = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
+        return s + net._reg_penalty(p)
+
+    score_jit = jax.jit(score)
+    analytic = jax.grad(score)(params64)
+
+    nprng = np.random.default_rng(seed)
+    total_checked = 0
+    failures = []
+    for li, lp in enumerate(params64):
+        for k in param_util.ordered_keys(lp):
+            shape = lp[k].shape
+            # NB: reshape on an np.array-of-jax-array can silently COPY, so
+            # the flat buffer is the single mutable source of truth here.
+            flat = np.array(lp[k], dtype=np.float64).reshape(-1).copy()
+            an = np.asarray(analytic[li][k])
+            n = flat.size
+            idxs = (np.arange(n) if subset is None or n <= subset
+                    else nprng.choice(n, subset, replace=False))
+            for i in idxs:
+                orig = flat[i]
+                flat[i] = orig + epsilon
+                plus = float(score_jit(_with(params64, li, k, flat.reshape(shape))))
+                flat[i] = orig - epsilon
+                minus = float(score_jit(_with(params64, li, k, flat.reshape(shape))))
+                flat[i] = orig
+                numeric = (plus - minus) / (2 * epsilon)
+                a = an.reshape(-1)[i]
+                denom = max(abs(a), abs(numeric))
+                rel = abs(a - numeric) / denom if denom > 0 else 0.0
+                total_checked += 1
+                if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+                    failures.append((li, k, int(i), float(a), numeric, rel))
+
+    if print_results or failures:
+        print(f"Gradient check: {total_checked} params checked, "
+              f"{len(failures)} failures")
+        for li, k, i, a, num, rel in failures[:20]:
+            print(f"  layer {li} {k}[{i}]: analytic={a:.3e} numeric={num:.3e} "
+                  f"rel={rel:.3e}")
+    return not failures
+
+
+def _with(params, li, k, arr):
+    """Rebuild the param pytree with layer li's key k replaced by arr
+    (arr is the mutated numpy buffer; re-wrap to jnp)."""
+    out = []
+    for i, lp in enumerate(params):
+        if i == li:
+            lp = dict(lp)
+            lp[k] = jnp.asarray(arr)
+        out.append(lp)
+    return out

@@ -102,10 +102,12 @@ class MultiLayerNetwork:
     def _input_type_chain_start(self) -> InputType:
         if self.conf.input_type is not None:
             return self.conf.input_type
+        from deeplearning4j_tpu.nn.conf import layers as L
         first = self.layers[0]
+        if isinstance(first, L.FrozenLayerConf):
+            first = first._inner()
         n_in = getattr(first, "n_in", None)
         if n_in:
-            from deeplearning4j_tpu.nn.conf import layers as L
             if isinstance(first, (L.GravesLSTM, L.GravesBidirectionalLSTM)):
                 return InputType.recurrent(n_in)
             return InputType.feed_forward(n_in)
@@ -176,6 +178,11 @@ class MultiLayerNetwork:
     # The jitted train step — ONE XLA computation per step
     # ------------------------------------------------------------------
     def _build_step(self):
+        return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
+
+    def _build_step_raw(self):
+        """The pure (un-jitted) train step — ParallelWrapper re-jits it with
+        mesh shardings or vmaps it for parameter-averaging compat."""
         g = self.conf.global_conf
         out_layer = self.layers[-1]
         if not isinstance(out_layer, (BaseOutputLayer, LossLayer)):
@@ -229,7 +236,7 @@ class MultiLayerNetwork:
                 new_opts.append(new_opt)
             return new_params, new_states, new_opts, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return step
 
     def _build_score_fn(self):
         out_layer = self.layers[-1]
@@ -467,10 +474,18 @@ class MultiLayerNetwork:
         return ev
 
     def clone(self) -> "MultiLayerNetwork":
+        # Arrays must be COPIED, not aliased: the jitted step donates its
+        # input buffers, so a clone sharing buffers with a live net would be
+        # invalidated by the next fit() step on either of them.
         import copy
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self.net_params is not None:
-            net.init(params=jax.tree_util.tree_map(lambda a: a, self.net_params))
-            net.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
-            net.opt_states = jax.tree_util.tree_map(lambda a: a, self.opt_states)
+            copy_tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: jnp.array(a, copy=True), t)
+            # assign directly — no init(): avoids sampling a fresh random
+            # initialization that would be immediately discarded
+            net.net_params = copy_tree(self.net_params)
+            net.net_state = copy_tree(self.net_state)
+            net.opt_states = copy_tree(self.opt_states)
+        net.iteration = self.iteration
         return net

@@ -1,0 +1,102 @@
+"""Model checkpointing — the reference's ModelSerializer zip format.
+
+(ref: util/ModelSerializer.java:39-41,52-120) — a zip container holding
+{configuration.json, coefficients.bin (flat param vector),
+updaterState.bin (flat updater state), normalizer.bin} — kept
+byte-layout-compatible in spirit: coefficients are the canonical flat
+view (deeplearning4j_tpu.nn.params ordering), stored little-endian
+float32, so checkpoints survive process/version changes.  ModelGuesser
+sniffing (ref: deeplearning4j-core ModelGuesser.java) is `load_model`,
+which detects the model type from the config JSON.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Optional, Union
+
+import numpy as np
+
+CONFIG_NAME = "configuration.json"
+COEFFICIENTS_NAME = "coefficients.bin"
+UPDATER_NAME = "updaterState.bin"
+NORMALIZER_NAME = "normalizer.bin"
+
+
+def _write_array(zf: zipfile.ZipFile, name: str, arr) -> None:
+    zf.writestr(name, np.asarray(arr, dtype=np.float32).tobytes())
+
+
+def _read_array(zf: zipfile.ZipFile, name: str) -> np.ndarray:
+    return np.frombuffer(zf.read(name), dtype=np.float32)
+
+
+def write_model(model, path: Union[str, Path], save_updater: bool = True,
+                normalizer=None) -> None:
+    """(ref: ModelSerializer.writeModel)"""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    conf_dict = model.conf.to_dict()
+    conf_dict["@model"] = type(model).__name__
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(CONFIG_NAME, json.dumps(conf_dict, indent=2))
+        _write_array(zf, COEFFICIENTS_NAME, model.params())
+        if save_updater and model.opt_states is not None:
+            _write_array(zf, UPDATER_NAME, model.updater_state_flat())
+        if normalizer is not None:
+            zf.writestr(NORMALIZER_NAME, json.dumps(normalizer.to_dict()))
+
+
+def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = True):
+    """(ref: ModelSerializer.restoreMultiLayerNetwork)"""
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf_dict = json.loads(zf.read(CONFIG_NAME))
+        conf_dict.pop("@model", None)
+        conf = MultiLayerConfiguration.from_dict(conf_dict)
+        net = MultiLayerNetwork(conf).init()
+        net.set_params(_read_array(zf, COEFFICIENTS_NAME))
+        if load_updater and UPDATER_NAME in zf.namelist():
+            net.set_updater_state_flat(_read_array(zf, UPDATER_NAME))
+    return net
+
+
+def restore_computation_graph(path: Union[str, Path], load_updater: bool = True):
+    """(ref: ModelSerializer.restoreComputationGraph)"""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+
+    with zipfile.ZipFile(path, "r") as zf:
+        conf_dict = json.loads(zf.read(CONFIG_NAME))
+        conf_dict.pop("@model", None)
+        conf = ComputationGraphConfiguration.from_dict(conf_dict)
+        net = ComputationGraph(conf).init()
+        net.set_params(_read_array(zf, COEFFICIENTS_NAME))
+        if load_updater and UPDATER_NAME in zf.namelist():
+            net.set_updater_state_flat(_read_array(zf, UPDATER_NAME))
+    return net
+
+
+def restore_normalizer(path: Union[str, Path]):
+    """(ref: ModelSerializer.restoreNormalizerFromFile)"""
+    from deeplearning4j_tpu.datasets.normalizers import Normalizer
+    with zipfile.ZipFile(path, "r") as zf:
+        if NORMALIZER_NAME not in zf.namelist():
+            return None
+        return Normalizer.from_dict(json.loads(zf.read(NORMALIZER_NAME)))
+
+
+def load_model(path: Union[str, Path]):
+    """Sniff the model type from the checkpoint and restore it
+    (ref: deeplearning4j-core util/ModelGuesser.java)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        conf_dict = json.loads(zf.read(CONFIG_NAME))
+    kind = conf_dict.get("@model")
+    if kind == "ComputationGraph" or "vertices" in conf_dict:
+        return restore_computation_graph(path)
+    return restore_multi_layer_network(path)

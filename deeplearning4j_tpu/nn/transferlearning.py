@@ -1,0 +1,199 @@
+"""Transfer learning — clone + fine-tune + freeze + replace outputs.
+
+(ref: nn/transferlearning/TransferLearning.java:34 — Builder with
+fineTuneConfiguration / setFeatureExtractor (freeze up to layer N) /
+removeOutputLayer / addLayer / nOutReplace; FineTuneConfiguration.java;
+TransferLearningHelper.java — featurization by running frozen layers once)
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import List, Optional
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.layers import FrozenLayerConf, Layer
+from deeplearning4j_tpu.nn.conf.network import (
+    GlobalConf, MultiLayerConfiguration, merge_layer_conf)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+@dataclasses.dataclass
+class FineTuneConfiguration:
+    """Hyperparameter overrides applied to every non-frozen layer
+    (ref: nn/transferlearning/FineTuneConfiguration.java)."""
+
+    learning_rate: Optional[float] = None
+    updater: Optional[str] = None
+    momentum: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+
+    def apply_to_global(self, g: GlobalConf) -> GlobalConf:
+        g = copy.deepcopy(g)
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is not None and hasattr(g, f.name):
+                setattr(g, f.name, v)
+        if self.l1 is not None or self.l2 is not None:
+            g.use_regularization = True
+        return g
+
+    def apply_to_layer(self, layer: Layer) -> Layer:
+        updates = {}
+        for f in ("learning_rate", "updater", "momentum", "l1", "l2", "dropout"):
+            v = getattr(self, f)
+            if v is not None and hasattr(layer, f):
+                updates[f] = v
+        return dataclasses.replace(layer, **updates) if updates else layer
+
+
+class TransferLearningBuilder:
+    """(ref: TransferLearning.Builder)"""
+
+    def __init__(self, net: MultiLayerNetwork):
+        self._net = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._freeze_until: Optional[int] = None
+        self._n_out_replace: dict = {}
+        self._remove_from: Optional[int] = None
+        self._added: List[Layer] = []
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, layer_idx: int):
+        """Freeze layers [0..layer_idx] (ref: setFeatureExtractor)."""
+        self._freeze_until = layer_idx
+        return self
+
+    def n_out_replace(self, layer_idx: int, n_out: int,
+                      weight_init: Optional[str] = None):
+        """Replace layer's nOut (and reinit it + nIn of the next layer)."""
+        self._n_out_replace[layer_idx] = (n_out, weight_init)
+        return self
+
+    def remove_output_layer(self):
+        return self.remove_layers_from_output(1)
+
+    def remove_layers_from_output(self, n: int):
+        self._remove_from = len(self._net.layers) - n
+        return self
+
+    def add_layer(self, layer: Layer):
+        self._added.append(layer)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        import jax.numpy as jnp
+        src = self._net
+        conf = copy.deepcopy(src.conf)
+        layers = list(conf.layers)
+        # copy arrays: the new net's donated train step must not invalidate
+        # the source net's buffers (donation aliasing)
+        params = ([{k: jnp.array(v, copy=True) for k, v in p.items()}
+                   for p in src.net_params] if src.net_params else None)
+
+        if self._remove_from is not None:
+            layers = layers[:self._remove_from]
+            if params:
+                params = params[:self._remove_from]
+
+        g = conf.global_conf
+        if self._fine_tune:
+            g = self._fine_tune.apply_to_global(g)
+
+        reinit: set = set()
+        for idx, (n_out, winit) in self._n_out_replace.items():
+            layers[idx] = dataclasses.replace(layers[idx], n_out=n_out,
+                                              **({"weight_init": winit} if winit else {}))
+            reinit.add(idx)
+            if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                layers[idx + 1] = dataclasses.replace(layers[idx + 1], n_out=getattr(layers[idx + 1], "n_out"), n_in=n_out)
+                reinit.add(idx + 1)
+
+        for layer in self._added:
+            layers.append(merge_layer_conf(layer, g))
+            if params is not None:
+                params.append(None)  # initialize below
+            reinit.add(len(layers) - 1)
+
+        if self._fine_tune:
+            layers = [l if (self._freeze_until is not None and i <= self._freeze_until)
+                      else self._fine_tune.apply_to_layer(l)
+                      for i, l in enumerate(layers)]
+
+        if self._freeze_until is not None:
+            layers = [FrozenLayerConf.wrap(l) if (i <= self._freeze_until and
+                                                  not isinstance(l, FrozenLayerConf))
+                      else l for i, l in enumerate(layers)]
+
+        new_conf = MultiLayerConfiguration(
+            layers=layers, global_conf=g, input_type=conf.input_type,
+            preprocessors=conf.preprocessors, backprop=conf.backprop,
+            pretrain=conf.pretrain, backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length)
+        net = MultiLayerNetwork(new_conf)
+        net.init()
+        if params is not None:
+            # keep source weights wherever shape-compatible and not re-initialized
+            kept = []
+            for i, (old, fresh) in enumerate(zip(params, net.net_params)):
+                if i in reinit or old is None:
+                    kept.append(fresh)
+                elif all(k in old and old[k].shape == fresh[k].shape for k in fresh):
+                    kept.append({k: old[k] for k in fresh})
+                else:
+                    kept.append(fresh)
+            net.net_params = kept
+            net.opt_states = [net.updaters[i].init(net.net_params[i])
+                              for i in range(len(net.layers))]
+        return net
+
+
+class TransferLearning:
+    """Entry point mirroring the reference's nested Builder API."""
+
+    Builder = TransferLearningBuilder
+
+
+class TransferLearningHelper:
+    """Featurization helper: run the frozen bottom once per dataset, train
+    only the unfrozen top (ref: nn/transferlearning/TransferLearningHelper.java)."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: int):
+        self.full = net
+        self.frozen_until = frozen_until
+
+    def featurize(self, dataset):
+        """Run inputs through the frozen layers → features for the top."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        import numpy as np
+        acts = self.full.feed_forward(dataset.features, train=False)
+        feat = np.asarray(acts[self.frozen_until])
+        return DataSet(feat, dataset.labels, dataset.features_mask,
+                       dataset.labels_mask)
+
+    def unfrozen_network(self) -> MultiLayerNetwork:
+        """A network of only the unfrozen top layers (shares weights)."""
+        conf = copy.deepcopy(self.full.conf)
+        top_layers = conf.layers[self.frozen_until + 1:]
+        preprocs = {i - (self.frozen_until + 1): p
+                    for i, p in conf.preprocessors.items()
+                    if i > self.frozen_until}
+        new_conf = MultiLayerConfiguration(
+            layers=top_layers, global_conf=conf.global_conf,
+            input_type=None, preprocessors=preprocs)
+        import jax.numpy as jnp
+        net = MultiLayerNetwork(new_conf)
+        net.init(params=[{k: jnp.array(v, copy=True) for k, v in p.items()}
+                         for p in self.full.net_params[self.frozen_until + 1:]])
+        return net

@@ -29,12 +29,16 @@ def _same_pad(kernel: Sequence[int], stride: Sequence[int], pad: Sequence[int],
 
 
 def conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0), dilation=(1, 1),
-           border_mode: str = "truncate", accum_dtype=jnp.float32):
+           border_mode: str = "truncate", accum_dtype=None):
     """2D convolution, NCHW in / OIHW weights.
 
     border_mode: 'truncate' (explicit pad, the reference's Truncate) or
-    'same' (the reference's ConvolutionMode.Same).
+    'same' (the reference's ConvolutionMode.Same).  MXU accumulation is
+    float32 for low-precision inputs (bf16 compute / f32 accumulate);
+    float64 inputs (gradient checks on CPU) accumulate in f64.
     """
+    if accum_dtype is None:
+        accum_dtype = jnp.promote_types(x.dtype, jnp.float32)
     padding = _same_pad(w.shape[2:], stride, pad, "same" if border_mode == "same" else "explicit")
     y = lax.conv_general_dilated(
         x, w,

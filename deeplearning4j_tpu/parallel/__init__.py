@@ -1,0 +1,14 @@
+"""Multi-device / multi-host training & inference on the TPU mesh.
+
+The reference's three distribution tiers — ParallelWrapper threads with
+host-staged parameter averaging (ref: parallelism/ParallelWrapper.java:218),
+the Aeron parameter server (ref: ParameterServerTrainer.java), and Spark
+parameter averaging (ref: ParameterAveragingTrainingMaster.java) — all
+collapse into ONE TPU-native answer here: shardings over a
+``jax.sharding.Mesh`` with XLA collectives (psum over ICI; multi-slice
+GSPMD over DCN), inside the single jitted train step.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -1,0 +1,86 @@
+"""ParallelInference — a batching inference front-end.
+
+(ref: parallelism/ParallelInference.java:32-370 — requests queue into a
+BlockingQueue, BatchedInferenceObservable merges concurrent requests up
+to ``batchLimit`` into a single ``output()`` call.)  One jitted forward
+on the TPU serves all callers; dynamic batching amortizes dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class _Request:
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class ParallelInference:
+    INFERENCE_MODE_BATCHED = "batched"
+    INFERENCE_MODE_SEQUENTIAL = "sequential"
+
+    def __init__(self, model, batch_limit: int = 32, queue_limit: int = 64,
+                 inference_mode: str = "batched", workers: int = 1):
+        self.model = model
+        self.batch_limit = batch_limit
+        self.inference_mode = inference_mode
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = threading.Event()
+        self._threads = [threading.Thread(target=self._worker, daemon=True)
+                         for _ in range(max(1, workers))]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch: List[_Request] = [first]
+            if self.inference_mode == self.INFERENCE_MODE_BATCHED:
+                total = first.x.shape[0]
+                while total < self.batch_limit:
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    batch.append(nxt)
+                    total += nxt.x.shape[0]
+            try:
+                x = np.concatenate([r.x for r in batch]) if len(batch) > 1 else batch[0].x
+                out = np.asarray(self.model.output(x))
+                off = 0
+                for r in batch:
+                    n = r.x.shape[0]
+                    r.result = out[off:off + n]
+                    off += n
+            except BaseException as e:  # propagate to all waiters
+                for r in batch:
+                    r.error = e
+            finally:
+                for r in batch:
+                    r.event.set()
+
+    def output(self, x, timeout: Optional[float] = 60.0) -> np.ndarray:
+        """Blocking call, safe from many threads; requests are batched."""
+        req = _Request(np.asarray(x))
+        self._queue.put(req)
+        if not req.event.wait(timeout):
+            raise TimeoutError("ParallelInference request timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def shutdown(self):
+        self._shutdown.set()
+        for t in self._threads:
+            t.join(timeout=2)

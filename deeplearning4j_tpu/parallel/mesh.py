@@ -1,0 +1,79 @@
+"""Device mesh construction and sharding policy.
+
+Replaces the reference's AffinityManager device placement (SURVEY.md
+§2.10) with explicit ``jax.sharding.Mesh`` axes.  Axis names follow the
+scaling-book convention: 'data' (dp), 'fsdp' (zero-style param sharding),
+'model' (tp), 'seq' (sp), 'expert' (ep) — a config picks which are used;
+unused axes have size 1 so one code path serves every layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "fsdp", "model", "seq", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """How many devices along each named axis (product must divide the
+    device count; -1 on 'data' means 'all remaining')."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> Tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.model * self.seq * self.expert
+        data = self.data
+        if data == -1:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{fixed} != {n_devices} devices")
+        return (data, self.fsdp, self.model, self.seq, self.expert)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    shape = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over data(+fsdp) — the standard input layout."""
+    return NamedSharding(mesh, P(("data", "fsdp")))
+
+
+def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...]) -> NamedSharding:
+    """FSDP-style param sharding: shard the largest divisible axis over
+    'fsdp' (no-op when fsdp=1); replicate over 'data'."""
+    fsdp = mesh.shape["fsdp"]
+    if fsdp == 1:
+        return NamedSharding(mesh, P())
+    best = None
+    for i, d in enumerate(arr_shape):
+        if d % fsdp == 0 and (best is None or d > arr_shape[best]):
+            best = i
+    if best is None:
+        return NamedSharding(mesh, P())
+    spec = [None] * len(arr_shape)
+    spec[best] = "fsdp"
+    return NamedSharding(mesh, P(*spec))

@@ -1,12 +1,22 @@
 """Test configuration: force the CPU backend with 8 virtual devices so
 multi-chip sharding tests run without TPU hardware (the cuDNN-vs-builtin
 cross-check pattern of the reference, SURVEY.md §4, becomes
-TPU-vs-CPU-interpreter: the same code paths compile on both)."""
+TPU-vs-CPU-interpreter: the same code paths compile on both).
+
+NB: this machine's sitecustomize registers the axon TPU plugin and calls
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start,
+which overrides the JAX_PLATFORMS env var — so the config must be
+re-updated after importing jax, not just via env.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()

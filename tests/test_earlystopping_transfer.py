@@ -1,0 +1,101 @@
+"""Early stopping + transfer learning tests
+(ref: TestEarlyStopping.java, TransferLearning tests in deeplearning4j-core)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, FrozenLayerConf, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper,
+)
+
+
+def _iris_data():
+    ds = load_iris().shuffle(0)
+    norm = NormalizerStandardize().fit(ds)
+    return norm.transform(ds)
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(lr).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(DenseLayer(n_in=16, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        data = _iris_data()
+        train, test = data.split_test_and_train(100)
+        net = _net()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(test),
+            model_saver=InMemoryModelSaver(),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            iteration_termination_conditions=[InvalidScoreIterationTerminationCondition()])
+        result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(train, 32)).fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.total_epochs == 5
+        assert result.best_model is not None
+        assert result.best_model_score < 2.0
+
+    def test_score_improvement_termination(self):
+        data = _iris_data()
+        train, test = data.split_test_and_train(100)
+        net = _net(lr=0.0)  # lr=0 → no improvement → stops fast
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(test),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50)])
+        result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(train, 32)).fit()
+        assert result.total_epochs < 50
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_output(self):
+        data = _iris_data()
+        src = _net()
+        src.fit(ListDataSetIterator(data, 50), epochs=10)
+        frozen_w_before = np.asarray(src.net_params[0]["W"])
+
+        net2 = (TransferLearning.Builder(src)
+                .fine_tune_configuration(FineTuneConfiguration(learning_rate=0.01))
+                .set_feature_extractor(0)
+                .n_out_replace(2, 3, weight_init="xavier")
+                .build())
+        assert isinstance(net2.layers[0], FrozenLayerConf)
+        # bottom weights carried over
+        np.testing.assert_allclose(np.asarray(net2.net_params[0]["W"]),
+                                   frozen_w_before)
+        net2.fit(ListDataSetIterator(data, 50), epochs=5)
+        # frozen layer unchanged after training
+        np.testing.assert_allclose(np.asarray(net2.net_params[0]["W"]),
+                                   frozen_w_before)
+        # unfrozen layers moved
+        assert not np.allclose(np.asarray(net2.net_params[2]["W"]),
+                               np.asarray(src.net_params[2]["W"]))
+
+    def test_helper_featurize(self):
+        data = _iris_data()
+        src = _net()
+        src.fit(ListDataSetIterator(data, 50), epochs=3)
+        helper = TransferLearningHelper(src, frozen_until=0)
+        feat = helper.featurize(data)
+        assert feat.features.shape == (150, 16)
+        top = helper.unfrozen_network()
+        out = top.output(feat.features[:4])
+        assert out.shape == (4, 3)

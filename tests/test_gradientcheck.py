@@ -1,0 +1,150 @@
+"""Gradient checks — modeled on the reference's gradientcheck suites
+(GradientCheckTests.java, CNNGradientCheckTest.java, BNGradientCheckTest.java,
+GradientCheckTestsMasking.java).  Runs in float64 on the CPU backend."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM,
+    LocalResponseNormalization, OutputLayer, RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _data(n=8, features=4, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, features))
+    y = np.eye(classes)[rng.integers(0, classes, n)]
+    return x, y
+
+
+@pytest.mark.parametrize("activation,loss,out_act", [
+    ("tanh", "mcxent", "softmax"),
+    ("relu", "mse", "identity"),
+    ("sigmoid", "xent", "sigmoid"),
+    ("elu", "mcxent", "softmax"),
+    ("softplus", "l2", "tanh"),
+])
+def test_mlp_gradients(activation, loss, out_act):
+    x, y = _data()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=6, activation=activation))
+            .layer(OutputLayer(n_in=6, n_out=3, activation=out_act, loss=loss))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=None)
+
+
+def test_mlp_with_l1_l2_gradients():
+    x, y = _data(seed=1)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).regularization(True).l1(0.01).l2(0.02)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_in=6, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=None)
+
+
+def test_cnn_gradients():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 1, 8, 8))
+    y = np.eye(3)[rng.integers(0, 3, 4)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3), activation="tanh"))
+            .layer(SubsamplingLayer(pooling_type="max"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=64)
+
+
+def test_cnn_batchnorm_lrn_gradients():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(4, 2, 6, 6))
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel=(3, 3), activation="identity"))
+            .layer(BatchNormalization())
+            .layer(ActivationLayer(activation="relu"))
+            .layer(LocalResponseNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(6, 6, 2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=48)
+
+
+def test_lstm_gradients():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(3, 5, 4))  # [N, T, C]
+    y = np.eye(3)[rng.integers(0, 3, (3, 5))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11)
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=5, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=5, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=64)
+
+
+def test_bidirectional_lstm_gradients():
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(2, 4, 3))
+    y = np.eye(2)[rng.integers(0, 2, (2, 4))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(13)
+            .list()
+            .layer(GravesBidirectionalLSTM(n_in=3, n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=48)
+
+
+def test_lstm_masking_gradients():
+    """Masked timesteps must not contribute gradient
+    (ref: GradientCheckTestsMasking.java)."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(3, 5, 4))
+    y = np.eye(3)[rng.integers(0, 3, (3, 5))]
+    fmask = np.ones((3, 5))
+    fmask[0, 3:] = 0
+    fmask[2, 2:] = 0
+    conf = (NeuralNetConfiguration.builder()
+            .seed(17)
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=4, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=4, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, fmask=fmask, lmask=fmask, subset=48)
+
+
+def test_global_pooling_gradients():
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(3, 6, 4))
+    y = np.eye(2)[rng.integers(0, 2, 3)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(19)
+            .list()
+            .layer(GravesLSTM(n_in=4, n_out=5, activation="tanh"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_in=5, n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=48)

@@ -1,0 +1,76 @@
+"""Checkpoint zip round-trip tests — the reference's serialization
+regression suite pattern (regressiontest/RegressionTest*.java,
+ModelSerializer round-trips)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn import serialization
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(updater="adam"):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.05).updater(updater)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_zip_roundtrip_params_and_config(tmp_path):
+    net = _net()
+    ds = load_iris()
+    net.fit(ds)
+    path = tmp_path / "model.zip"
+    serialization.write_model(net, path)
+    net2 = serialization.restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(net2.params()),
+                               np.asarray(net.params()), rtol=1e-6)
+    o1 = np.asarray(net.output(ds.features[:10]))
+    o2 = np.asarray(net2.output(ds.features[:10]))
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+
+
+def test_updater_state_resume(tmp_path):
+    """Training resumed from checkpoint must match uninterrupted training
+    (Adam moments preserved)."""
+    ds = load_iris().shuffle(3)
+    netA = _net()
+    netA.fit(ds)
+    netA.fit(ds)
+
+    netB = _net()
+    netB.fit(ds)
+    path = tmp_path / "ckpt.zip"
+    serialization.write_model(netB, path)
+    netC = serialization.restore_multi_layer_network(path)
+    netC.iteration = netB.iteration
+    netC.fit(ds)
+    np.testing.assert_allclose(np.asarray(netC.params()),
+                               np.asarray(netA.params()), rtol=1e-4, atol=1e-6)
+
+
+def test_normalizer_in_zip(tmp_path):
+    net = _net()
+    ds = load_iris()
+    norm = NormalizerStandardize().fit(ds)
+    path = tmp_path / "m.zip"
+    serialization.write_model(net, path, normalizer=norm)
+    norm2 = serialization.restore_normalizer(path)
+    np.testing.assert_allclose(norm2.mean, norm.mean)
+    np.testing.assert_allclose(
+        norm2.transform(ds).features, norm.transform(ds).features)
+
+
+def test_model_guesser(tmp_path):
+    net = _net()
+    path = tmp_path / "m.zip"
+    serialization.write_model(net, path)
+    loaded = serialization.load_model(path)
+    assert isinstance(loaded, MultiLayerNetwork)
