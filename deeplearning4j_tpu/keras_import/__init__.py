@@ -1,0 +1,6 @@
+"""Keras model import (ref: deeplearning4j-modelimport, 5.4k LoC:
+keras/KerasModelImport.java:48-231, KerasModel.java:59,377-480,
+KerasSequentialModel.java:143-222, per-type keras/layers/Keras*.java,
+Hdf5Archive.java — JavaCPP-HDF5 replaced by h5py)."""
+
+from deeplearning4j_tpu.keras_import.importer import KerasModelImport  # noqa: F401

@@ -1,0 +1,470 @@
+"""Keras HDF5 → framework model import.
+
+(ref: keras/KerasModelImport.java public API — importKerasSequentialModelAndWeights,
+importKerasModelAndWeights, importKerasSequentialConfiguration;
+KerasLayer.java:44 layer mapping; KerasModel.java:377-480 weight copying)
+
+Supports Sequential models saved as .h5 (Keras 1/2 "layer_names" layout
+and Keras 3 nested-group layout).  Layer coverage mirrors the reference's
+keras/layers/Keras{Dense, Convolution, Pooling, Lstm, BatchNormalization,
+Embedding, Dropout, Activation, Flatten}.java.
+
+Weight layout conversions:
+- Dense kernel: keras [in, out] == native [in, out] (no transpose)
+- Conv2D kernel: keras HWIO [kh, kw, in, out] → native OIHW
+- LSTM: keras [in, 4H] kernel / [H, 4H] recurrent, gate order i,f,c,o →
+  native gate order i,f,o,c; peepholes zero (vanilla LSTM == Graves LSTM
+  with zero peepholes)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+_ACT_MAP = {
+    "relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
+    "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
+    "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "silu": "swish",
+    "gelu": "gelu", "leaky_relu": "leakyrelu", "relu6": "relu6",
+}
+
+_LOSS_MAP = {
+    "categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "mean_absolute_percentage_error": "mape",
+    "mean_squared_logarithmic_error": "msle",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "poisson": "poisson", "cosine_proximity": "cosine_proximity",
+    "kullback_leibler_divergence": "kl_divergence",
+}
+
+
+def _act(cfg: dict) -> str:
+    a = cfg.get("activation", "linear")
+    if isinstance(a, dict):  # keras 3 serialized activation
+        a = a.get("config", {}).get("name", a.get("class_name", "linear"))
+    return _ACT_MAP.get(str(a).lower(), "identity")
+
+
+def _pair(v) -> tuple:
+    if isinstance(v, (list, tuple)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class KerasLayerMapper:
+    """Maps one Keras layer config dict → framework layer conf (or None for
+    structural layers like Flatten/InputLayer)."""
+
+    def map(self, class_name: str, cfg: dict, is_output: bool,
+            loss: Optional[str]) -> Optional[L.Layer]:
+        name = cfg.get("name")
+        if class_name in ("InputLayer", "Flatten", "Reshape"):
+            return None
+        if class_name == "Dense":
+            act = _act(cfg)
+            if is_output:
+                return L.OutputLayer(
+                    name=name, n_out=cfg["units"], activation=act,
+                    loss=loss or ("mcxent" if act == "softmax" else "mse"))
+            return L.DenseLayer(name=name, n_out=cfg["units"], activation=act)
+        if class_name in ("Conv2D", "Convolution2D"):
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            return L.ConvolutionLayer(
+                name=name, n_out=cfg["filters"] if "filters" in cfg else cfg["nb_filter"],
+                kernel=_pair(cfg.get("kernel_size",
+                                     (cfg.get("nb_row", 3), cfg.get("nb_col", 3)))),
+                stride=_pair(cfg.get("strides", (1, 1))),
+                convolution_mode="same" if pad == "same" else "truncate",
+                activation=_act(cfg))
+        if class_name in ("MaxPooling2D", "AveragePooling2D"):
+            kind = "max" if class_name.startswith("Max") else "avg"
+            pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+            pool = _pair(cfg.get("pool_size", (2, 2)))
+            return L.SubsamplingLayer(
+                name=name, pooling_type=kind, kernel=pool,
+                stride=_pair(cfg.get("strides") or pool),
+                convolution_mode="same" if pad == "same" else "truncate")
+        if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+                          "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+            kind = "max" if "Max" in class_name else "avg"
+            return L.GlobalPoolingLayer(name=name, pooling_type=kind)
+        if class_name == "Dropout":
+            # keras rate = DROP probability; native dropout = RETAIN prob
+            return L.DropoutLayer(name=name, dropout=1.0 - cfg.get("rate", 0.5))
+        if class_name == "Activation":
+            return L.ActivationLayer(name=name, activation=_act(cfg))
+        if class_name == "BatchNormalization":
+            return L.BatchNormalization(
+                name=name, decay=cfg.get("momentum", 0.99),
+                eps=cfg.get("epsilon", 1e-3))
+        if class_name == "Embedding":
+            return L.EmbeddingLayer(
+                name=name, n_in=cfg.get("input_dim"),
+                n_out=cfg.get("output_dim"), activation="identity")
+        if class_name == "LSTM":
+            return L.GravesLSTM(
+                name=name, n_out=cfg["units"],
+                activation=_ACT_MAP.get(str(cfg.get("activation", "tanh")), "tanh"),
+                gate_activation=_ACT_MAP.get(
+                    str(cfg.get("recurrent_activation", "sigmoid")), "sigmoid"),
+                forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0)
+        if class_name == "ZeroPadding2D":
+            p = cfg.get("padding", (1, 1))
+            if isinstance(p, (list, tuple)) and isinstance(p[0], (list, tuple)):
+                return L.ZeroPaddingLayer(name=name, pad=(p[0][0], p[0][1],
+                                                          p[1][0], p[1][1]))
+            ph, pw = _pair(p)
+            return L.ZeroPaddingLayer(name=name, pad=(ph, ph, pw, pw))
+        raise ValueError(
+            f"Unsupported Keras layer type '{class_name}' "
+            f"(ref parity: KerasLayer.java supported set)")
+
+
+def _input_type_from_shape(shape) -> Optional[InputType]:
+    dims = [d for d in shape if d is not None]
+    if len(dims) == 3:
+        # keras channels_last [H, W, C] → native NCHW InputType
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    return None
+
+
+class KerasModelImport:
+    """(ref: keras/KerasModelImport.java)"""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path, enforce_training_config=False
+                                                  ) -> MultiLayerNetwork:
+        import h5py
+        with h5py.File(path, "r") as f:
+            model_config = json.loads(f.attrs["model_config"])
+            training_config = (json.loads(f.attrs["training_config"])
+                               if "training_config" in f.attrs else {})
+            net = KerasModelImport._build_sequential(model_config, training_config)
+            KerasModelImport._load_weights(net, f)
+        return net
+
+    @staticmethod
+    def import_keras_model_and_weights(path):
+        """Functional-API model → ComputationGraph
+        (ref: KerasModelImport.importKerasModelAndWeights → KerasModel)."""
+        import h5py
+        with h5py.File(path, "r") as f:
+            model_config = json.loads(f.attrs["model_config"])
+            training_config = (json.loads(f.attrs["training_config"])
+                               if "training_config" in f.attrs else {})
+            if model_config.get("class_name") == "Sequential":
+                net = KerasModelImport._build_sequential(model_config,
+                                                         training_config)
+            else:
+                net = KerasModelImport._build_functional(model_config,
+                                                         training_config)
+            KerasModelImport._load_weights(net, f)
+        return net
+
+    @staticmethod
+    def import_keras_sequential_configuration(path_or_json) -> MultiLayerNetwork:
+        if isinstance(path_or_json, str) and path_or_json.lstrip().startswith("{"):
+            model_config = json.loads(path_or_json)
+        else:
+            with open(path_or_json) as fh:
+                model_config = json.load(fh)
+        return KerasModelImport._build_sequential(model_config, {})
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _build_sequential(model_config: dict, training_config: dict
+                          ) -> MultiLayerNetwork:
+        if model_config.get("class_name") != "Sequential":
+            raise ValueError("Use import_keras_model_and_weights for functional models")
+        cfg = model_config["config"]
+        layer_dicts = cfg["layers"] if isinstance(cfg, dict) else cfg
+        loss = training_config.get("loss")
+        if isinstance(loss, dict):
+            loss = next(iter(loss.values()), None)
+        if isinstance(loss, dict):  # keras3 serialized loss object
+            loss = loss.get("config", {}).get("name")
+        loss = _LOSS_MAP.get(str(loss).lower()) if loss else None
+
+        mapper = KerasLayerMapper()
+        input_type = None
+        mapped: List[L.Layer] = []
+        keras_names: List[Optional[str]] = []  # keras layer name per mapped layer
+        for i, ld in enumerate(layer_dicts):
+            cls = ld["class_name"]
+            lcfg = ld.get("config", {})
+            if input_type is None:
+                shape = (lcfg.get("batch_input_shape")
+                         or lcfg.get("batch_shape") or lcfg.get("input_shape"))
+                if shape:
+                    it = _input_type_from_shape(shape[1:] if shape[0] is None
+                                                else shape)
+                    input_type = it
+            is_output = (i == len(layer_dicts) - 1)
+            layer = mapper.map(cls, lcfg, is_output, loss)
+            if layer is not None:
+                mapped.append(layer)
+                keras_names.append(lcfg.get("name"))
+                # Keras LSTM(return_sequences=False) — the default — keeps
+                # only the last timestep (ref: KerasLstm last-step handling)
+                if cls == "LSTM" and not lcfg.get("return_sequences", False):
+                    mapped.append(L.LastTimeStepLayer())
+                    keras_names.append(None)
+        if not isinstance(mapped[-1], (L.OutputLayer, L.RnnOutputLayer, L.LossLayer)):
+            # ensure trailing loss head for .fit parity: wrap as LossLayer
+            mapped.append(L.LossLayer(loss=loss or "mse", activation="identity"))
+            keras_names.append(None)
+
+        b = NeuralNetConfiguration.builder().list()
+        for layer in mapped:
+            b.layer(layer)
+        if input_type is not None:
+            b.set_input_type(input_type)
+        net = MultiLayerNetwork(b.build())
+        net.keras_layer_names = keras_names
+        return net
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _inbound_names(layer_dict: dict) -> List[str]:
+        """Upstream layer names from inbound_nodes (keras 2 nested-list and
+        keras 3 keras_history formats)."""
+        names: List[str] = []
+
+        def walk(obj):
+            if isinstance(obj, dict):
+                hist = obj.get("config", {}).get("keras_history") \
+                    if obj.get("class_name") == "__keras_tensor__" else None
+                if hist:
+                    names.append(hist[0])
+                    return
+                for val in obj.values():
+                    walk(val)
+            elif isinstance(obj, (list, tuple)):
+                if (len(obj) >= 3 and isinstance(obj[0], str)
+                        and isinstance(obj[1], int) and isinstance(obj[2], int)):
+                    names.append(obj[0])  # keras2 [name, node, tensor, {}]
+                    return
+                for val in obj:
+                    walk(val)
+
+        walk(layer_dict.get("inbound_nodes", []))
+        return names
+
+    @staticmethod
+    def _build_functional(model_config: dict, training_config: dict):
+        """Functional-API config → ComputationGraph (ref: KerasModel.java:59)."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ElementWiseVertex, GraphBuilder, MergeVertex)
+        from deeplearning4j_tpu.nn.conf.network import GlobalConf
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        cfg = model_config["config"]
+        layer_dicts = cfg["layers"]
+        def _spec_names(specs) -> List[str]:
+            # [["a",0,0],["b",0,0]] (multi) or ["a",0,0] (single, keras3)
+            if specs and isinstance(specs[0], str):
+                return [specs[0]]
+            return [s[0] for s in specs]
+
+        input_names = _spec_names(cfg.get("input_layers", []))
+        output_names = _spec_names(cfg.get("output_layers", []))
+
+        loss = training_config.get("loss")
+        if isinstance(loss, dict):
+            loss = next(iter(loss.values()), None)
+        if isinstance(loss, dict):
+            loss = loss.get("config", {}).get("name")
+        loss = _LOSS_MAP.get(str(loss).lower()) if loss else None
+
+        mapper = KerasLayerMapper()
+        b = GraphBuilder(GlobalConf()).add_inputs(*input_names)
+        alias: Dict[str, str] = {}  # keras name → effective vertex name
+        input_types: Dict[str, InputType] = {}
+
+        for ld in layer_dicts:
+            cls = ld["class_name"]
+            lcfg = ld.get("config", {})
+            name = lcfg.get("name", ld.get("name"))
+            ins = [alias.get(i, i) for i in KerasModelImport._inbound_names(ld)]
+            if cls == "InputLayer":
+                shape = lcfg.get("batch_shape") or lcfg.get("batch_input_shape")
+                if shape:
+                    it = _input_type_from_shape(shape[1:])
+                    if it:
+                        input_types[name] = it
+                alias[name] = name
+                continue
+            if cls in ("Add", "Average", "Maximum", "Subtract", "Multiply"):
+                op = {"Add": "add", "Average": "average", "Maximum": "max",
+                      "Subtract": "subtract", "Multiply": "product"}[cls]
+                b.add_vertex(name, ElementWiseVertex(op=op), *ins)
+                alias[name] = name
+                continue
+            if cls in ("Concatenate", "Merge"):
+                b.add_vertex(name, MergeVertex(), *ins)
+                alias[name] = name
+                continue
+            if cls in ("Flatten", "Reshape"):
+                # structural only: dense-after-cnn flattening is auto-inserted
+                alias[name] = ins[0]
+                continue
+            is_output = name in output_names
+            layer = mapper.map(cls, lcfg, is_output, loss)
+            if layer is None:
+                alias[name] = ins[0]
+                continue
+            b.add_layer(name, layer, *ins)
+            alias[name] = name
+            if cls == "LSTM" and not lcfg.get("return_sequences", False):
+                from deeplearning4j_tpu.nn.conf.graph_conf import LastTimeStepVertex
+                b.add_vertex(f"{name}-last", LastTimeStepVertex(), name)
+                alias[name] = f"{name}-last"
+
+        b.set_outputs(*[alias.get(n, n) for n in output_names])
+        if input_types:
+            b.set_input_types(*[input_types[n] for n in input_names])
+        return ComputationGraph(b.build())
+
+    @staticmethod
+    def _find_weights(h5file, keras_name: str) -> Dict[str, np.ndarray]:
+        """Locate a layer's weight datasets in keras2 or keras3 layouts."""
+        import h5py
+        root = h5file["model_weights"] if "model_weights" in h5file else h5file
+        if keras_name not in root:
+            return {}
+        found: Dict[str, np.ndarray] = {}
+
+        def walk(group):
+            for k in group:
+                item = group[k]
+                if isinstance(item, h5py.Group):
+                    walk(item)
+                else:
+                    base = k.split(":")[0]
+                    found.setdefault(base, np.asarray(item))
+
+        walk(root[keras_name])
+        return found
+
+    @staticmethod
+    def _map_layer_weights(layer: L.Layer, w: Dict[str, np.ndarray],
+                           p: dict, state: dict, flatten_proc=None):
+        """Convert one keras layer's weight dict into native param/state
+        dicts (layout conversions per the module docstring)."""
+        p = dict(p)
+        if isinstance(layer, L.ConvolutionLayer):
+            kern = w.get("kernel", w.get("param_0"))
+            p["W"] = np.transpose(kern, (3, 2, 0, 1))  # HWIO → OIHW
+            if "bias" in w or "param_1" in w:
+                p["b"] = w.get("bias", w.get("param_1"))
+        elif isinstance(layer, L.BatchNormalization):
+            if "gamma" in w:
+                p["gamma"] = w["gamma"]
+            if "beta" in w:
+                p["beta"] = w["beta"]
+            state = dict(state)
+            if "moving_mean" in w:
+                state["mean"] = np.asarray(w["moving_mean"])
+            if "moving_variance" in w:
+                state["var"] = np.asarray(w["moving_variance"])
+        elif isinstance(layer, L.GravesLSTM):
+            kern = w.get("kernel", w.get("param_0"))
+            rec = w.get("recurrent_kernel", w.get("param_1"))
+            bias = w.get("bias", w.get("param_2"))
+            H = layer.n_out
+
+            def reorder(m):  # keras gate order i,f,c,o → native i,f,o,c
+                i, fgt, c, o = np.split(np.asarray(m), 4, axis=-1)
+                return np.concatenate([i, fgt, o, c], axis=-1)
+
+            p["W"] = reorder(kern)
+            p["RW"] = reorder(rec)
+            if bias is not None:
+                p["b"] = reorder(bias.reshape(1, -1)).reshape(-1)
+            p["pI"] = np.zeros(H, np.float32)
+            p["pF"] = np.zeros(H, np.float32)
+            p["pO"] = np.zeros(H, np.float32)
+        elif isinstance(layer, (L.DenseLayer, L.EmbeddingLayer)):
+            kern = np.asarray(w.get("kernel", w.get("embeddings",
+                                                    w.get("param_0"))))
+            # Dense directly after a conv flatten: keras flattened HWC, the
+            # native CnnToFeedForward flattens CHW — permute kernel rows
+            # (the reference permutes identically, KerasModel.java weight copy).
+            from deeplearning4j_tpu.nn.conf.preprocessors import (
+                CnnToFeedForwardPreProcessor)
+            if (isinstance(layer, L.DenseLayer)
+                    and isinstance(flatten_proc, CnnToFeedForwardPreProcessor)):
+                H, W, C = (flatten_proc.height, flatten_proc.width,
+                           flatten_proc.channels)
+                if kern.shape[0] == H * W * C:
+                    hwc = kern.reshape(H, W, C, -1)
+                    kern = np.transpose(hwc, (2, 0, 1, 3)).reshape(H * W * C, -1)
+            p["W"] = kern
+            if "bias" in w or "param_1" in w:
+                p["b"] = np.asarray(w.get("bias", w.get("param_1")))
+        p = {k: jnp.asarray(np.asarray(v), jnp.float32) for k, v in p.items()}
+        state = {k: jnp.asarray(np.asarray(v), jnp.float32)
+                 for k, v in state.items()}
+        return p, state
+
+    @staticmethod
+    def _load_weights(net, h5file) -> None:
+        net.init()
+        if isinstance(net, MultiLayerNetwork):
+            for li, (layer, kname) in enumerate(zip(net.layers,
+                                                    net.keras_layer_names)):
+                if kname is None or not layer.has_params():
+                    continue
+                w = KerasModelImport._find_weights(h5file, kname)
+                if not w:
+                    continue
+                p, s = KerasModelImport._map_layer_weights(
+                    layer, w, net.net_params[li], net.net_state[li],
+                    flatten_proc=net.conf.preprocessors.get(li))
+                net.net_params[li] = p
+                net.net_state[li] = s
+            return
+        # ComputationGraph: vertices are named by their keras layer names
+        from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+        from deeplearning4j_tpu.nn.conf.graph_conf import PreprocessorVertex
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToFeedForwardPreProcessor, InputPreProcessor)
+        for name in net.order:
+            v = net.conf.vertices[name]
+            if not isinstance(v, LayerVertex) or not v.has_params():
+                continue
+            w = KerasModelImport._find_weights(h5file, name)
+            if not w:
+                continue
+            layer = v.layer_conf()
+            # find upstream flatten (auto-inserted "-cnn2ff" or explicit)
+            flatten_proc = None
+            ups = net.conf.vertex_inputs[name]
+            if ups:
+                uv = net.conf.vertices.get(ups[0])
+                if isinstance(uv, PreprocessorVertex):
+                    proc = InputPreProcessor.from_dict(uv.preprocessor)
+                    if isinstance(proc, CnnToFeedForwardPreProcessor):
+                        flatten_proc = proc
+            p, s = KerasModelImport._map_layer_weights(
+                layer, w, net.net_params[name], net.net_state[name],
+                flatten_proc=flatten_proc)
+            net.net_params[name] = p
+            net.net_state[name] = s

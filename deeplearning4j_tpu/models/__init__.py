@@ -1,0 +1,11 @@
+"""Model zoo — the north-star benchmark configs (BASELINE.md):
+LeNet-MNIST, VGG16, ResNet-50, GravesLSTM char-RNN.
+
+The reference ships these as dl4j-examples recipes / keras-imported
+models; here they are first-class builders over the same config DSL.
+"""
+
+from deeplearning4j_tpu.models.lenet import lenet  # noqa: F401
+from deeplearning4j_tpu.models.vgg import vgg16  # noqa: F401
+from deeplearning4j_tpu.models.resnet import resnet50  # noqa: F401
+from deeplearning4j_tpu.models.charrnn import char_rnn  # noqa: F401

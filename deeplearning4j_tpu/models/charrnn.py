@@ -1,0 +1,107 @@
+"""GravesLSTM character RNN — the north-star char-RNN config
+(dl4j-examples GravesLSTMCharModellingExample: 2xLSTM(200) + RnnOutput,
+TBPTT 50)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def char_rnn(vocab_size: int, hidden: int = 200, layers: int = 2,
+             learning_rate: float = 0.1, tbptt_length: int = 50,
+             seed: int = 12345) -> MultiLayerNetwork:
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .learning_rate(learning_rate)
+         .updater("rmsprop")
+         .weight_init("xavier")
+         .list())
+    n_in = vocab_size
+    for _ in range(layers):
+        b.layer(GravesLSTM(n_in=n_in, n_out=hidden, activation="tanh"))
+        n_in = hidden
+    b.layer(RnnOutputLayer(n_in=hidden, n_out=vocab_size,
+                           activation="softmax", loss="mcxent"))
+    conf = (b.backprop_type("truncatedbptt")
+            .t_bptt_forward_length(tbptt_length)
+            .t_bptt_backward_length(tbptt_length)
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+class CharacterIterator:
+    """Text → one-hot char sequences for char-RNN training
+    (ref: dl4j-examples CharacterIterator)."""
+
+    def __init__(self, text: str, seq_length: int = 100, batch: int = 32,
+                 seed: int = 0):
+        chars = sorted(set(text))
+        self.char_to_idx = {c: i for i, c in enumerate(chars)}
+        self.idx_to_char = {i: c for i, c in enumerate(chars)}
+        self.vocab_size = len(chars)
+        self.seq_length = seq_length
+        self.batch = batch
+        self.data = np.asarray([self.char_to_idx[c] for c in text], np.int32)
+        self._rng = np.random.default_rng(seed)
+        self.n_batches_per_epoch = max(
+            1, (len(self.data) - seq_length - 1) // (batch * seq_length))
+        self._count = 0
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def next(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        starts = self._rng.integers(0, len(self.data) - self.seq_length - 1,
+                                    self.batch)
+        xs = np.stack([self.data[s:s + self.seq_length] for s in starts])
+        ys = np.stack([self.data[s + 1:s + self.seq_length + 1] for s in starts])
+        eye = np.eye(self.vocab_size, dtype=np.float32)
+        self._count += 1
+        return DataSet(eye[xs], eye[ys])
+
+    def has_next(self):
+        return self._count < self.n_batches_per_epoch
+
+    def reset(self):
+        self._count = 0
+
+    def batch_size(self):
+        return self.batch
+
+    def async_supported(self):
+        return True
+
+
+def sample_text(net: MultiLayerNetwork, iterator: CharacterIterator,
+                seed_text: str, length: int = 200,
+                temperature: float = 1.0, rng_seed: int = 0) -> str:
+    """Autoregressive sampling via rnn_time_step stateful inference
+    (ref: dl4j-examples sampleCharactersFromNetwork)."""
+    rng = np.random.default_rng(rng_seed)
+    eye = np.eye(iterator.vocab_size, dtype=np.float32)
+    net.rnn_clear_previous_state()
+    idxs = [iterator.char_to_idx[c] for c in seed_text]
+    x = eye[np.asarray(idxs)][None]  # [1, T, V]
+    out = np.asarray(net.rnn_time_step(x))[0, -1]
+    result = list(seed_text)
+    for _ in range(length):
+        logits = np.log(np.maximum(out, 1e-9)) / temperature
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        nxt = int(rng.choice(iterator.vocab_size, p=p))
+        result.append(iterator.idx_to_char[nxt])
+        out = np.asarray(net.rnn_time_step(eye[np.asarray([nxt])][None]))[0, -1]
+    return "".join(result)

@@ -1,0 +1,32 @@
+"""LeNet-5 for MNIST — the dl4j-examples LenetMnistExample recipe
+(conv5x5x20 → maxpool → conv5x5x50 → maxpool → dense500 → softmax10)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def lenet(height: int = 28, width: int = 28, channels: int = 1,
+          n_classes: int = 10, learning_rate: float = 0.01,
+          updater: str = "adam", seed: int = 12345) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .learning_rate(learning_rate)
+            .updater(updater)
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+    return MultiLayerNetwork(conf)

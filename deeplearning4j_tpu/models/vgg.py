@@ -1,0 +1,45 @@
+"""VGG16 — the north-star VGG16-CIFAR10 / ImageNet config
+(ref: modelimport keras/trainedmodels/TrainedModels.java VGG16; the
+standard 13-conv + 3-dense topology, Simonyan & Zisserman 2014)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+# (n_convs, channels) per VGG16 block
+_BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+
+def vgg16(height: int = 224, width: int = 224, channels: int = 3,
+          n_classes: int = 1000, learning_rate: float = 0.01,
+          updater: str = "nesterovs", seed: int = 12345,
+          fc_size: int = 4096, dropout: Optional[float] = None) -> MultiLayerNetwork:
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .learning_rate(learning_rate)
+         .updater(updater)
+         .weight_init("relu")
+         .list())
+    for n_convs, ch in _BLOCKS:
+        for _ in range(n_convs):
+            b.layer(ConvolutionLayer(n_out=ch, kernel=(3, 3), stride=(1, 1),
+                                     padding=(1, 1), activation="relu"))
+        b.layer(SubsamplingLayer(pooling_type="max", kernel=(2, 2), stride=(2, 2)))
+    b.layer(DenseLayer(n_out=fc_size, activation="relu", dropout=dropout))
+    b.layer(DenseLayer(n_out=fc_size, activation="relu", dropout=dropout))
+    b.layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+    conf = (b.set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+def vgg16_cifar10(learning_rate: float = 0.01, seed: int = 12345) -> MultiLayerNetwork:
+    """The VGG16-CIFAR10 north-star recipe (32x32x3, 10 classes, smaller FC)."""
+    return vgg16(32, 32, 3, 10, learning_rate=learning_rate, seed=seed,
+                 fc_size=512)

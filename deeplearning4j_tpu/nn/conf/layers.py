@@ -586,6 +586,29 @@ class RnnOutputLayer(BaseOutputLayer):
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
 
+@register_layer
+@dataclasses.dataclass
+class LastTimeStepLayer(Layer):
+    """[N,T,C] → [N,C] at the last unmasked timestep (sequential-network
+    analog of the reference's rnn/LastTimeStepVertex.java; used e.g. for
+    Keras LSTM(return_sequences=False) import)."""
+
+    def has_params(self):
+        return False
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        return {}, {}, self.output_type(input_type)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        if mask is None:
+            return x[:, -1], state, None
+        idx = jnp.maximum(jnp.sum(mask > 0, axis=1).astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), idx], state, None
+
+    def output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
 # ==========================================================================
 # Misc
 # ==========================================================================

@@ -1,0 +1,419 @@
+"""ComputationGraph — arbitrary-DAG networks with multi-input/multi-output.
+
+(ref: nn/graph/ComputationGraph.java (2897 LoC): topologicalOrder :122,
+init :312, fit(MultiDataSetIterator) :828, feedForward :1212,
+calcBackpropGradients :1421).  As with MultiLayerNetwork, the eager
+vertex-by-vertex dispatch becomes one traced function over the topological
+order, compiled once by XLA; gradients come from jax.value_and_grad over
+the summed output-layer losses instead of the reference's hand-scheduled
+reverse pass.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn import params as param_util
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration, GraphVertexConf, LayerVertex)
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, LossLayer
+from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.ops import updaters as upd_ops
+from deeplearning4j_tpu.nn.multilayer import (
+    BIAS_KEYS, WEIGHT_KEYS, _updater_for)
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.order = conf.topological_order()
+        self.net_params: Optional[Dict[str, dict]] = None
+        self.net_state: Optional[Dict[str, dict]] = None
+        self.opt_states: Optional[Dict[str, Any]] = None
+        self.updaters: Dict[str, upd_ops.Updater] = {}
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[IterationListener] = []
+        self._score = float("nan")
+        self._key = jax.random.PRNGKey(conf.global_conf.seed)
+        self._step_fn = None
+        self._output_fn = None
+        self._score_fn = None
+        self.last_batch_size = 0
+        self.last_etl_time_ms = 0.0
+
+    # ------------------------------------------------------------------
+    def init(self, params: Optional[Dict[str, dict]] = None) -> "ComputationGraph":
+        conf = self.conf
+        types: Dict[str, Any] = {}
+        if conf.input_types:
+            types.update(dict(zip(conf.network_inputs, conf.input_types)))
+        key = jax.random.PRNGKey(conf.global_conf.seed)
+        ps: Dict[str, dict] = {}
+        ss: Dict[str, dict] = {}
+        for name in self.order:
+            v = conf.vertices[name]
+            in_names = conf.vertex_inputs[name]
+            in_types = [types.get(i) for i in in_names]
+            if any(t is None for t in in_types):
+                # inputs without declared types: best effort via layer n_in
+                if isinstance(v, LayerVertex):
+                    lc = v.layer_conf()
+                    n_in = getattr(lc, "n_in", None)
+                    if n_in:
+                        from deeplearning4j_tpu.nn.conf.inputs import InputType
+                        from deeplearning4j_tpu.nn.conf import layers as L
+                        if isinstance(lc, (L.GravesLSTM, L.GravesBidirectionalLSTM,
+                                           L.RnnOutputLayer)):
+                            in_types = [InputType.recurrent(n_in)]
+                        else:
+                            in_types = [InputType.feed_forward(n_in)]
+                    else:
+                        raise ValueError(
+                            f"Vertex '{name}': set_input_types() required or "
+                            f"explicit n_in on the layer")
+                else:
+                    raise ValueError(
+                        f"Vertex '{name}': upstream type unknown — call "
+                        f"set_input_types() on the GraphBuilder")
+            key, sub = jax.random.split(key)
+            p, s, out_t = v.initialize(sub, in_types)
+            ps[name] = p
+            ss[name] = s
+            types[name] = out_t
+        self.net_params = params if params is not None else ps
+        self.net_state = ss
+        self.updaters = {name: _updater_for(self._vertex_layer(name))
+                         if isinstance(conf.vertices[name], LayerVertex)
+                         else upd_ops.make("sgd")
+                         for name in self.order}
+        self.opt_states = {name: self.updaters[name].init(self.net_params[name])
+                           for name in self.order}
+        return self
+
+    def _vertex_layer(self, name: str):
+        return self.conf.vertices[name].layer_conf()
+
+    def _output_layer_confs(self) -> Dict[str, Any]:
+        out = {}
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if isinstance(v, LayerVertex):
+                lc = v.layer_conf()
+                if isinstance(lc, (BaseOutputLayer, LossLayer)):
+                    out[name] = lc
+        return out
+
+    # ------------------------------------------------------------------
+    def _forward_all(self, params, state, inputs: Dict[str, Any],
+                     masks: Dict[str, Any], train: bool, rng,
+                     preout_for: Sequence[str] = ()):
+        """Activate every vertex in topological order.  For vertices named
+        in `preout_for` (output layers), record PRE-activations instead."""
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            DuplicateToTimeSeriesVertex, LastTimeStepVertex)
+        acts: Dict[str, Any] = dict(inputs)
+        out_masks: Dict[str, Any] = dict(masks)
+        new_states: Dict[str, dict] = {}
+        preouts: Dict[str, Any] = {}
+        for vi, name in enumerate(self.order):
+            v = self.conf.vertices[name]
+            in_names = self.conf.vertex_inputs[name]
+            ins = [acts[i] for i in in_names]
+            ms = [out_masks.get(i) for i in in_names]
+            # named-input semantics (ref: rnn/LastTimeStepVertex.java takes
+            # its mask from a NAMED network input; DuplicateToTimeSeries
+            # takes T from a named reference sequence)
+            if isinstance(v, LastTimeStepVertex) and v.mask_input:
+                ms = [out_masks.get(v.mask_input)]
+            if isinstance(v, DuplicateToTimeSeriesVertex) and v.ts_input \
+                    and len(ins) == 1:
+                ins = ins + [acts[v.ts_input]]
+                ms = ms + [out_masks.get(v.ts_input)]
+            r = jax.random.fold_in(rng, vi)
+            if name in preout_for:
+                lc = v.layer_conf()
+                x = ins[0]
+                if train:
+                    x = lc._maybe_dropout(x, True, r)
+                pre = lc.preoutput(params[name], x)
+                preouts[name] = pre
+                new_states[name] = state[name]
+                acts[name] = lc._act(pre)
+                out_masks[name] = ms[0] if ms else None
+            else:
+                y, ns, m = v.forward(params[name], state[name], ins,
+                                     train=train, rng=r, masks=ms)
+                acts[name] = y
+                new_states[name] = ns
+                out_masks[name] = m
+        return acts, preouts, new_states, out_masks
+
+    def _reg_penalty(self, params):
+        total = 0.0
+        for name in self.order:
+            v = self.conf.vertices[name]
+            if not isinstance(v, LayerVertex):
+                continue
+            layer = v.layer_conf()
+            lp = params[name]
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            for k, val in lp.items():
+                if k in WEIGHT_KEYS:
+                    if l1:
+                        total = total + l1 * jnp.sum(jnp.abs(val))
+                    if l2:
+                        total = total + 0.5 * l2 * jnp.sum(val * val)
+                elif k in BIAS_KEYS:
+                    if layer.l1_bias:
+                        total = total + layer.l1_bias * jnp.sum(jnp.abs(val))
+                    if layer.l2_bias:
+                        total = total + 0.5 * layer.l2_bias * jnp.sum(val * val)
+        return total
+
+    # ------------------------------------------------------------------
+    def _build_step_raw(self):
+        g = self.conf.global_conf
+        out_confs = self._output_layer_confs()
+        if not out_confs:
+            raise ValueError("ComputationGraph.fit() needs >=1 output layer "
+                             "vertex (OutputLayer/LossLayer)")
+        out_names = list(out_confs)
+        # labels/masks arrive ordered by conf.network_outputs — index by that
+        # position, NOT by position in the (filtered) out_confs dict
+        out_pos = {n: self.conf.network_outputs.index(n) for n in out_names}
+
+        def step(params, state, opts, xs, ys, fmasks, lmasks, it, rng):
+            def loss_fn(p):
+                inputs = dict(zip(self.conf.network_inputs, xs))
+                masks = dict(zip(self.conf.network_inputs, fmasks)) \
+                    if fmasks is not None else {}
+                acts, preouts, new_states, out_masks = self._forward_all(
+                    p, state, inputs, masks, True, rng, preout_for=out_names)
+                score = 0.0
+                for name in out_names:
+                    oi = out_pos[name]
+                    lc = out_confs[name]
+                    y = ys[oi]
+                    lm = lmasks[oi] if lmasks is not None else None
+                    if lm is None:
+                        m = out_masks.get(name)
+                        pre = preouts[name]
+                        lm = m if (m is not None and m.ndim == pre.ndim - 1) else None
+                    if lm is not None and preouts[name].ndim == 3:
+                        lm = lm[..., None] if lm.ndim == 2 else lm
+                    per_ex = lc.compute_score(y, preouts[name], lm)
+                    score = score + (jnp.mean(per_ex) if g.mini_batch
+                                     else jnp.sum(per_ex))
+                score = score + self._reg_penalty(p)
+                return score, new_states
+
+            (score, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opts = {}, {}
+            for name in self.order:
+                gi = grads[name]
+                if not gi:
+                    new_params[name] = params[name]
+                    new_opts[name] = opts[name]
+                    continue
+                v = self.conf.vertices[name]
+                layer = v.layer_conf() if isinstance(v, LayerVertex) else None
+                if layer is not None:
+                    gi = upd_ops.normalize_gradient(
+                        gi, layer.gradient_normalization,
+                        layer.gradient_normalization_threshold or 1.0)
+                    lr_base = (layer.learning_rate
+                               if layer.learning_rate is not None
+                               else g.learning_rate)
+                else:
+                    lr_base = g.learning_rate
+                lr = upd_ops.schedule_lr(
+                    lr_base, g.lr_policy, it,
+                    decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                    power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
+                upd, new_opt = self.updaters[name].apply(gi, opts[name], lr, it)
+                new_params[name] = {k: params[name][k] - upd[k]
+                                    for k in params[name]}
+                new_opts[name] = new_opt
+            return new_params, new_states, new_opts, score
+
+        return step
+
+    def _build_step(self):
+        return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(MultiDataSet | DataSet | iterator | (features, labels))
+        (ref: ComputationGraph.fit :828)."""
+        if labels is not None:
+            data = MultiDataSet([np.asarray(data)], [np.asarray(labels)])
+        if isinstance(data, DataSet):
+            data = MultiDataSet([data.features], [data.labels],
+                                [data.features_mask], [data.labels_mask])
+        if isinstance(data, MultiDataSet):
+            batches = [data]
+            for _ in range(epochs):
+                for mds in batches:
+                    self._fit_batch(mds)
+            return self
+        # iterator of DataSet or MultiDataSet
+        for _ in range(epochs):
+            data.reset()
+            for item in data:
+                if isinstance(item, DataSet):
+                    item = MultiDataSet([item.features], [item.labels],
+                                        [item.features_mask], [item.labels_mask])
+                self._fit_batch(item)
+        return self
+
+    def _fit_batch(self, mds: MultiDataSet):
+        if self.net_params is None:
+            self.init()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        self.last_batch_size = mds.num_examples()
+        xs = tuple(jnp.asarray(f) for f in mds.features)
+        ys = tuple(jnp.asarray(l) for l in mds.labels)
+        fm = (tuple(None if m is None else jnp.asarray(m)
+                    for m in mds.features_masks)
+              if mds.features_masks is not None else None)
+        lm = (tuple(None if m is None else jnp.asarray(m)
+                    for m in mds.labels_masks)
+              if mds.labels_masks is not None else None)
+        self._key, sub = jax.random.split(self._key)
+        (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
+            self.net_params, self.net_state, self.opt_states, xs, ys, fm, lm,
+            jnp.asarray(self.iteration, jnp.int32), sub)
+        self._strip_rnn_state()
+        self._score = score
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
+
+    def _strip_rnn_state(self):
+        if self.net_state is None:
+            return
+        self.net_state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
+                          for n, s in self.net_state.items()}
+
+    # ------------------------------------------------------------------
+    def output(self, *inputs, train: bool = False):
+        """Multi-output inference in topological order
+        (ref: ComputationGraph feedForward/outputs)."""
+        if self.net_params is None:
+            self.init()
+        if self._output_fn is None:
+            def out_fn(params, state, xs):
+                ins = dict(zip(self.conf.network_inputs, xs))
+                acts, _, _, _ = self._forward_all(params, state, ins, {},
+                                                  False, jax.random.PRNGKey(0))
+                return tuple(acts[n] for n in self.conf.network_outputs)
+            self._output_fn = jax.jit(out_fn)
+        state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
+                 for n, s in self.net_state.items()}
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        return self._output_fn(self.net_params, state, xs)
+
+    def score(self, data: Optional[Union[DataSet, MultiDataSet]] = None) -> float:
+        if data is None:
+            return float(self._score)
+        if isinstance(data, DataSet):
+            data = MultiDataSet([data.features], [data.labels])
+        if self._score_fn is None:
+            out_confs = self._output_layer_confs()
+            out_pos = {n: self.conf.network_outputs.index(n) for n in out_confs}
+            g = self.conf.global_conf
+
+            def score_fn(params, state, xs, ys):
+                inputs = dict(zip(self.conf.network_inputs, xs))
+                _, preouts, _, _ = self._forward_all(
+                    params, state, inputs, {}, False, jax.random.PRNGKey(0),
+                    preout_for=list(out_confs))
+                total = 0.0
+                for name, lc in out_confs.items():
+                    per_ex = lc.compute_score(ys[out_pos[name]], preouts[name],
+                                              None)
+                    total = total + (jnp.mean(per_ex) if g.mini_batch
+                                     else jnp.sum(per_ex))
+                return total + self._reg_penalty(params)
+
+            self._score_fn = jax.jit(score_fn)
+        xs = tuple(jnp.asarray(f) for f in data.features)
+        ys = tuple(jnp.asarray(l) for l in data.labels)
+        return float(self._score_fn(self.net_params, self.net_state, xs, ys))
+
+    def evaluate(self, iterator_or_dataset, output_idx: int = 0):
+        from deeplearning4j_tpu.nn.evaluation import Evaluation
+        ev = Evaluation()
+        if isinstance(iterator_or_dataset, (DataSet, MultiDataSet)):
+            batches = [iterator_or_dataset]
+        else:
+            iterator_or_dataset.reset()
+            batches = list(iterator_or_dataset)
+        for ds in batches:
+            if isinstance(ds, DataSet):
+                feats, labels = [ds.features], [ds.labels]
+            else:
+                feats, labels = ds.features, ds.labels
+            outs = self.output(*feats)
+            ev.eval(labels[output_idx], np.asarray(outs[output_idx]))
+        return ev
+
+    # ------------------------------------------------------------------
+    def params(self) -> jnp.ndarray:
+        """Canonical flat view: vertices in topological order."""
+        plist = [self.net_params[n] for n in self.order]
+        return param_util.flatten(plist)
+
+    def set_params(self, flat) -> None:
+        plist = [self.net_params[n] for n in self.order]
+        new = param_util.unflatten(flat, plist)
+        self.net_params = {n: new[i] for i, n in enumerate(self.order)}
+
+    def num_params(self) -> int:
+        return param_util.num_params([self.net_params[n] for n in self.order])
+
+    def updater_state_flat(self) -> jnp.ndarray:
+        leaves = jax.tree_util.tree_leaves(
+            [self.opt_states[n] for n in self.order])
+        if not leaves:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+    def set_updater_state_flat(self, flat) -> None:
+        ordered = [self.opt_states[n] for n in self.order]
+        leaves, treedef = jax.tree_util.tree_flatten(ordered)
+        out, off = [], 0
+        flat = jnp.asarray(flat).reshape(-1)
+        for l in leaves:
+            size = int(np.prod(l.shape))
+            out.append(flat[off:off + size].reshape(l.shape).astype(l.dtype))
+            off += size
+        restored = jax.tree_util.tree_unflatten(treedef, out)
+        self.opt_states = {n: restored[i] for i, n in enumerate(self.order)}
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def clone(self) -> "ComputationGraph":
+        import copy
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        if self.net_params is not None:
+            copy_tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+                lambda a: jnp.array(a, copy=True), t)
+            net.init()
+            net.net_params = copy_tree(self.net_params)
+            net.net_state = copy_tree(self.net_state)
+            net.opt_states = copy_tree(self.opt_states)
+        net.iteration = self.iteration
+        return net

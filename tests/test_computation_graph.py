@@ -1,0 +1,187 @@
+"""ComputationGraph tests — DAG topologies, vertices, multi-output
+(ref: deeplearning4j-core graph tests, GradientCheckTestsComputationGraph.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration, ElementWiseVertex, GraphBuilder, L2NormalizeVertex,
+    LastTimeStepVertex, MergeVertex, ScaleVertex, StackVertex, SubsetVertex,
+    UnstackVertex,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.network import GlobalConf
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _g(**kw):
+    g = GlobalConf(seed=7, learning_rate=0.05, updater="adam")
+    for k, v in kw.items():
+        setattr(g, k, v)
+    return g
+
+
+def test_linear_graph_equals_mln_shapes():
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                          loss="mcxent"), "dense")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (8, 3)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_graph_trains_on_iris():
+    ds = NormalizerStandardize().fit(load_iris()).transform(load_iris())
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                          loss="mcxent"), "d1")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    s0 = net.score(ds)
+    for _ in range(40):
+        net.fit(ds)
+    assert net.score(ds) < s0 * 0.5
+    ev = net.evaluate(ds)
+    assert ev.accuracy() > 0.9
+
+
+def test_merge_and_elementwise_vertices():
+    """Two towers merged + residual add (ref: MergeVertex/ElementWiseVertex)."""
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("a", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+            .add_layer("b", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_vertex("merged", MergeVertex(), "a", "b")
+            .add_layer("c", DenseLayer(n_in=16, n_out=8, activation="relu"), "merged")
+            .add_vertex("residual", ElementWiseVertex(op="add"), "a", "c")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss="mcxent"), "residual")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(1).normal(size=(6, 4)).astype(np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (6, 3)
+    y = np.eye(3, dtype=np.float32)[np.random.default_rng(2).integers(0, 3, 6)]
+    mds = MultiDataSet([x], [y])
+    s0 = net.score(mds)
+    for _ in range(30):
+        net.fit(mds)
+    assert net.score(mds) < s0
+
+
+def test_multi_input_multi_output():
+    rng = np.random.default_rng(3)
+    x1 = rng.normal(size=(8, 4)).astype(np.float32)
+    x2 = rng.normal(size=(8, 6)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    y2 = rng.normal(size=(8, 2)).astype(np.float32)
+    conf = (GraphBuilder(_g())
+            .add_inputs("inA", "inB")
+            .add_layer("dA", DenseLayer(n_in=4, n_out=8, activation="relu"), "inA")
+            .add_layer("dB", DenseLayer(n_in=6, n_out=8, activation="relu"), "inB")
+            .add_vertex("m", MergeVertex(), "dA", "dB")
+            .add_layer("cls", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                          loss="mcxent"), "m")
+            .add_layer("reg", OutputLayer(n_in=16, n_out=2, activation="identity",
+                                          loss="mse"), "m")
+            .set_outputs("cls", "reg")
+            .build())
+    net = ComputationGraph(conf).init()
+    out_cls, out_reg = net.output(x1, x2)
+    assert out_cls.shape == (8, 3) and out_reg.shape == (8, 2)
+    mds = MultiDataSet([x1, x2], [y1, y2])
+    s0 = net.score(mds)
+    for _ in range(30):
+        net.fit(mds)
+    assert net.score(mds) < s0
+
+
+def test_stack_unstack_subset_scale_vertices():
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_vertex("scaled", ScaleVertex(scale=2.0), "in")
+            .add_vertex("sub", SubsetVertex(from_idx=0, to_idx=1), "scaled")
+            .add_layer("out", OutputLayer(n_in=2, n_out=2, activation="softmax",
+                                          loss="mcxent"), "sub")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.ones((4, 4), np.float32)
+    (out,) = net.output(x)
+    assert out.shape == (4, 2)
+
+
+def test_rnn_graph_last_time_step():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 6, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+    conf = (GraphBuilder(_g())
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=5, n_out=8, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(), "lstm")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                          loss="mcxent"), "last")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    (out,) = net.output(x)
+    assert out.shape == (4, 2)
+    mds = MultiDataSet([x], [y])
+    s0 = net.score(mds)
+    for _ in range(25):
+        net.fit(mds)
+    assert net.score(mds) < s0
+
+
+def test_graph_json_roundtrip_and_checkpoint(tmp_path):
+    from deeplearning4j_tpu.nn import serialization
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+            .add_vertex("n", L2NormalizeVertex(), "d")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss="mcxent"), "n")
+            .set_outputs("out")
+            .build())
+    j = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    net = ComputationGraph(conf).init()
+    ds = load_iris()
+    net.fit(ds)
+    path = tmp_path / "graph.zip"
+    serialization.write_model(net, path)
+    net2 = serialization.load_model(path)
+    assert isinstance(net2, ComputationGraph)
+    (o1,) = net.output(ds.features[:5])
+    (o2,) = net2.output(ds.features[:5])
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-5)
+
+
+def test_input_type_inference_in_graph():
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    net = ComputationGraph(conf).init()
+    assert net.num_params() == 4 * 16 + 16 + 16 * 3 + 3

@@ -1,0 +1,158 @@
+"""Keras import equivalence tests — generate real Keras h5 fixtures and
+assert output equivalence (the reference's modelimport test pattern:
+fixture HDF5 + import equivalence checks, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from deeplearning4j_tpu.keras_import import KerasModelImport  # noqa: E402
+
+
+def _save(model, tmp_path, name):
+    path = str(tmp_path / name)
+    model.save(path)
+    return path
+
+
+def test_mlp_import_equivalence(tmp_path):
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((6,)),
+        layers.Dense(12, activation="relu"),
+        layers.Dense(4, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "mlp.h5")
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(0).normal(size=(5, 6)).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_cnn_import_equivalence(tmp_path):
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((8, 8, 3)),
+        layers.Conv2D(4, (3, 3), activation="relu"),
+        layers.MaxPooling2D((2, 2)),
+        layers.Flatten(),
+        layers.Dense(10, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "cnn.h5")
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    rng = np.random.default_rng(1)
+    x_keras = rng.normal(size=(3, 8, 8, 3)).astype(np.float32)  # NHWC
+    x_native = np.transpose(x_keras, (0, 3, 1, 2))  # NCHW
+    expected = km.predict(x_keras, verbose=0)
+    got = np.asarray(net.output(x_native))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_lstm_import_equivalence(tmp_path):
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((7, 5)),
+        layers.LSTM(6, return_sequences=True),
+    ])
+    path = _save(km, tmp_path, "lstm.h5")
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(2).normal(size=(2, 7, 5)).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    # native LSTM output is layer 0 activation (LossLayer appended after)
+    got = np.asarray(net.feed_forward(x)[0])
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_batchnorm_dropout_import(tmp_path):
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((10,)),
+        layers.Dense(8, activation="relu"),
+        layers.BatchNormalization(),
+        layers.Dropout(0.25),
+        layers.Dense(3, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="adam")
+    # perturb BN running stats so the import actually carries them
+    x_fit = np.random.default_rng(3).normal(size=(64, 10)).astype(np.float32)
+    y_fit = np.eye(3, dtype=np.float32)[np.random.default_rng(4).integers(0, 3, 64)]
+    km.fit(x_fit, y_fit, epochs=1, verbose=0)
+    path = _save(km, tmp_path, "bn.h5")
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(5).normal(size=(4, 10)).astype(np.float32)
+    expected = km.predict(x, verbose=0)  # inference: dropout off, BN running stats
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_imported_model_can_train(tmp_path):
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((4,)),
+        layers.Dense(8, activation="tanh"),
+        layers.Dense(3, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "train.h5")
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    ds = NormalizerStandardize().fit(load_iris()).transform(load_iris())
+    s0 = net.score(ds)
+    net.fit(ListDataSetIterator(ds, 50), epochs=10)
+    assert net.score(ds) < s0
+
+
+def test_unsupported_layer_error():
+    from deeplearning4j_tpu.keras_import.importer import KerasLayerMapper
+    with pytest.raises(ValueError, match="Unsupported Keras layer"):
+        KerasLayerMapper().map("SomeExoticLayer", {}, False, None)
+
+
+def test_lstm_return_sequences_false_import(tmp_path):
+    """The default keras LSTM classifier topology (return_sequences=False)."""
+    from keras import layers
+    km = keras.Sequential([
+        layers.Input((7, 5)),
+        layers.LSTM(6),
+        layers.Dense(3, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "lstm_cls.h5")
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = np.random.default_rng(6).normal(size=(4, 7, 5)).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    got = np.asarray(net.output(x))
+    assert got.shape == expected.shape == (4, 3)
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_functional_model_import(tmp_path):
+    """Functional API with a residual Add → ComputationGraph import."""
+    from keras import layers
+    inp = keras.Input((8,), name="inp")
+    d1 = layers.Dense(8, activation="relu", name="d1")(inp)
+    d2 = layers.Dense(8, activation="relu", name="d2")(d1)
+    added = layers.Add(name="res")([d1, d2])
+    out = layers.Dense(4, activation="softmax", name="head")(added)
+    km = keras.Model(inp, out)
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "func.h5")
+
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    assert isinstance(net, ComputationGraph)
+    x = np.random.default_rng(7).normal(size=(5, 8)).astype(np.float32)
+    expected = km.predict(x, verbose=0)
+    (got,) = net.output(x)
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-3, atol=1e-4)

@@ -1,0 +1,88 @@
+"""Model zoo smoke tests: each north-star config builds, runs forward,
+and takes a training step at reduced size."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models import char_rnn, lenet, resnet50, vgg16
+from deeplearning4j_tpu.models.charrnn import CharacterIterator, sample_text
+from deeplearning4j_tpu.models.resnet import resnet18
+from deeplearning4j_tpu.models.vgg import vgg16_cifar10
+
+
+def test_lenet_builds_and_trains():
+    net = lenet(learning_rate=0.001).init()
+    assert net.num_params() == 431080
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 1, 28, 28)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(10):
+        net.fit(ds)
+    assert net.score(ds) < s0
+
+
+def test_vgg16_structure():
+    net = vgg16(32, 32, 3, 10, fc_size=64)
+    net.init()
+    # 13 conv + 5 pool + 2 dense + 1 output = 21 layers
+    assert len(net.layers) == 21
+    x = np.zeros((2, 3, 32, 32), np.float32)
+    out = net.output(x)
+    assert out.shape == (2, 10)
+
+
+def test_vgg16_cifar10_trains():
+    net = vgg16_cifar10().init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 3, 32, 32)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(3):
+        net.fit(ds)
+    assert np.isfinite(net.score(ds))
+
+
+def test_resnet18_builds_and_trains():
+    net = resnet18(16, 16, 3, 4).init()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 3, 16, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+    (out,) = net.output(x)
+    assert out.shape == (4, 4)
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    mds = MultiDataSet([x], [y])
+    s0 = net.score(mds)
+    for _ in range(3):
+        net.fit(mds)
+    assert np.isfinite(net.score(mds))
+
+
+def test_resnet50_structure():
+    net = resnet50(64, 64, 3, 10)
+    net.init()
+    # 3+4+6+3 bottlenecks, each 3 convs + stem + 4 projections = 53 convs
+    n_convs = sum(1 for n in net.order if n.endswith("_conv"))
+    assert n_convs == 53
+    assert net.num_params() > 23_000_000
+
+
+def test_char_rnn_tbptt_and_sampling():
+    text = ("the quick brown fox jumps over the lazy dog. " * 40)
+    it = CharacterIterator(text, seq_length=64, batch=8)
+    net = char_rnn(it.vocab_size, hidden=32, layers=1, tbptt_length=16)
+    net.init()
+    s_first = None
+    for _ in range(8):
+        it.reset()
+        for ds in it:
+            net.fit(ds)
+            if s_first is None:
+                s_first = net.score()
+    assert net.score() < s_first
+    out = sample_text(net, it, "the ", length=50)
+    assert len(out) == 54
+    assert all(c in it.char_to_idx for c in out)
