@@ -1,0 +1,21 @@
+"""Embedding models: SequenceVectors, Word2Vec, ParagraphVectors, GloVe.
+
+TPU-native re-realization of the reference's embedding stack
+(ref: models/sequencevectors/SequenceVectors.java, models/word2vec/,
+models/paragraphvectors/, models/glove/).  The reference's hot loop is a
+fused native op per (center, context) pair batched 4096-at-a-time into
+libnd4j (ref: models/embeddings/learning/impl/elements/SkipGram.java:271
+``AggregateSkipGram``).  Here the equivalent is a single jitted XLA
+program per batch of pairs: gather rows → dense sigmoid/GEMM math on the
+MXU → scatter-add updates, with buffers donated so XLA updates in place.
+"""
+
+from deeplearning4j_tpu.embeddings.lookup import InMemoryLookupTable  # noqa: F401
+from deeplearning4j_tpu.embeddings.sequencevectors import (  # noqa: F401
+    SequenceVectors,
+    VectorsConfiguration,
+)
+from deeplearning4j_tpu.embeddings.word2vec import Word2Vec  # noqa: F401
+from deeplearning4j_tpu.embeddings.paragraphvectors import ParagraphVectors  # noqa: F401
+from deeplearning4j_tpu.embeddings.glove import Glove  # noqa: F401
+from deeplearning4j_tpu.embeddings.serializer import WordVectorSerializer  # noqa: F401

@@ -1,0 +1,202 @@
+"""GloVe — co-occurrence weighted least squares with AdaGrad.
+
+Mirrors the reference (ref: models/glove/Glove.java:1-429 +
+glove/count/* co-occurrence accumulation; GloVe objective
+f(X_ij)·(w_i·w̃_j + b_i + b̃_j − log X_ij)² with per-weight AdaGrad).
+TPU-first: the co-occurrence map is built on host, then shuffled into
+fixed-size (i, j, X_ij) batches; ONE jitted XLA program per batch does
+gather → residual → AdaGrad scatter-add on both vector tables.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.embeddings.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.embeddings.sequencevectors import VectorsConfiguration
+from deeplearning4j_tpu.embeddings.word_vectors import WordVectorsMixin
+from deeplearning4j_tpu.text.sequence import Sequence, VocabWord
+from deeplearning4j_tpu.text.sentence_iterators import SentenceIterator
+from deeplearning4j_tpu.text.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+from deeplearning4j_tpu.text.vocab import VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wt, b, bt, hw, hwt, hb, hbt,
+                rows, cols, logx, fx, lr, valid):
+    """AdaGrad step on a batch of co-occurrence cells.
+
+    w/wt: (V,D) main/context vectors; b/bt: (V,) biases;
+    h*: AdaGrad accumulators (donated alongside).
+    """
+    wi = jnp.take(w, rows, axis=0)        # (B, D)
+    wj = jnp.take(wt, cols, axis=0)
+    diff = (jnp.einsum("bd,bd->b", wi, wj) + jnp.take(b, rows)
+            + jnp.take(bt, cols) - logx)
+    fdiff = fx * diff * valid             # (B,)
+
+    gw = fdiff[:, None] * wj
+    gwt = fdiff[:, None] * wi
+    gb = fdiff
+    # AdaGrad: accumulate squared grads, scale update
+    hw_new = jnp.take(hw, rows, axis=0) + gw * gw
+    hwt_new = jnp.take(hwt, cols, axis=0) + gwt * gwt
+    hb_new = jnp.take(hb, rows) + gb * gb
+    hbt_new = jnp.take(hbt, cols) + gb * gb
+
+    w = w.at[rows].add(-lr * gw / jnp.sqrt(hw_new + 1e-8), mode="drop")
+    wt = wt.at[cols].add(-lr * gwt / jnp.sqrt(hwt_new + 1e-8), mode="drop")
+    b = b.at[rows].add(-lr * gb / jnp.sqrt(hb_new + 1e-8), mode="drop")
+    bt = bt.at[cols].add(-lr * gb / jnp.sqrt(hbt_new + 1e-8), mode="drop")
+    hw = hw.at[rows].add(gw * gw, mode="drop")
+    hwt = hwt.at[cols].add(gwt * gwt, mode="drop")
+    hb = hb.at[rows].add(gb * gb, mode="drop")
+    hbt = hbt.at[cols].add(gb * gb, mode="drop")
+    loss = 0.5 * jnp.sum(fdiff * diff)
+    return w, wt, b, bt, hw, hwt, hb, hbt, loss
+
+
+class Glove(WordVectorsMixin):
+
+    def __init__(self, conf: Optional[VectorsConfiguration] = None,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, shuffle: bool = True):
+        self.conf = conf or VectorsConfiguration(learning_rate=0.05)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.vocab = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._sentences: Optional[SentenceIterator] = None
+        self._tf: TokenizerFactory = DefaultTokenizerFactory()
+
+    class Builder:
+        def __init__(self):
+            self.conf = VectorsConfiguration(learning_rate=0.05)
+            self._x_max = 100.0
+            self._alpha = 0.75
+            self._symmetric = True
+            self._shuffle = True
+            self._sentences = None
+            self._tf = DefaultTokenizerFactory()
+
+        def iterate(self, s):              self._sentences = s; return self
+        def tokenizer_factory(self, tf):   self._tf = tf; return self
+        def layer_size(self, n):           self.conf.layer_size = n; return self
+        def learning_rate(self, lr):       self.conf.learning_rate = lr; return self
+        def epochs(self, n):               self.conf.epochs = n; return self
+        def window_size(self, n):          self.conf.window = n; return self
+        def min_word_frequency(self, n):   self.conf.min_word_frequency = n; return self
+        def batch_size(self, n):           self.conf.batch_size = n; return self
+        def seed(self, n):                 self.conf.seed = n; return self
+        def x_max(self, x):                self._x_max = x; return self
+        def alpha(self, a):                self._alpha = a; return self
+        def symmetric(self, b):            self._symmetric = b; return self
+        def shuffle(self, b):              self._shuffle = b; return self
+
+        def build(self) -> "Glove":
+            g = Glove(self.conf, self._x_max, self._alpha, self._symmetric,
+                      self._shuffle)
+            g._sentences = self._sentences
+            g._tf = self._tf
+            return g
+
+    # -- pipeline ----------------------------------------------------------
+    def _token_stream(self):
+        self._sentences.reset()
+        for sentence in self._sentences:
+            yield [t for t in self._tf.create(sentence).get_tokens() if t]
+
+    def _build_vocab(self):
+        def seqs():
+            for toks in self._token_stream():
+                s = Sequence()
+                for t in toks:
+                    s.add_element(VocabWord(t))
+                yield s
+        ctor = VocabConstructor(self.conf.min_word_frequency,
+                                build_huffman=False)
+        ctor.add_source(seqs())
+        self.vocab = ctor.build_joint_vocabulary()
+        self.lookup_table = InMemoryLookupTable(
+            self.vocab, self.conf.layer_size, seed=self.conf.seed,
+            use_hs=False, negative=0)
+        self.lookup_table.reset_weights()
+
+    def _cooccurrences(self) -> Dict[Tuple[int, int], float]:
+        """Distance-weighted counts (ref: glove/count/* — 1/d weighting)."""
+        co: Dict[Tuple[int, int], float] = defaultdict(float)
+        win = self.conf.window
+        for toks in self._token_stream():
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = [i for i in ids if i >= 0]
+            for i, wi in enumerate(ids):
+                for off in range(1, win + 1):
+                    j = i + off
+                    if j >= len(ids):
+                        break
+                    inc = 1.0 / off
+                    co[(wi, ids[j])] += inc
+                    if self.symmetric:
+                        co[(ids[j], wi)] += inc
+        return co
+
+    def fit(self) -> float:
+        assert self._sentences is not None
+        self._build_vocab()
+        co = self._cooccurrences()
+        if not co:
+            return 0.0
+        entries = np.array([(i, j, x) for (i, j), x in co.items()],
+                           np.float64)
+        rows_all = entries[:, 0].astype(np.int32)
+        cols_all = entries[:, 1].astype(np.int32)
+        xs_all = entries[:, 2].astype(np.float32)
+
+        V, D = self.vocab.num_words(), self.conf.layer_size
+        rng = np.random.default_rng(self.conf.seed)
+        w = self.lookup_table.syn0
+        wt = jnp.asarray((rng.random((V, D), dtype=np.float32) - 0.5) / D)
+        b = jnp.zeros((V,), jnp.float32)
+        bt = jnp.zeros((V,), jnp.float32)
+        hw = jnp.full((V, D), 1e-8, jnp.float32)
+        hwt = jnp.full((V, D), 1e-8, jnp.float32)
+        hb = jnp.full((V,), 1e-8, jnp.float32)
+        hbt = jnp.full((V,), 1e-8, jnp.float32)
+
+        B = min(self.conf.batch_size, max(len(xs_all), 1))
+        lr = jnp.float32(self.conf.learning_rate)
+        last_loss = 0.0
+        for _epoch in range(self.conf.epochs):
+            order = (rng.permutation(len(xs_all)) if self.shuffle
+                     else np.arange(len(xs_all)))
+            total, count = 0.0, 0
+            for start in range(0, len(order), B):
+                sel = order[start:start + B]
+                n = len(sel)
+                r = np.zeros(B, np.int32)
+                c = np.zeros(B, np.int32)
+                x = np.ones(B, np.float32)
+                valid = np.zeros(B, np.float32)
+                r[:n], c[:n], x[:n] = rows_all[sel], cols_all[sel], xs_all[sel]
+                valid[:n] = 1.0
+                fx = np.minimum((x / self.x_max) ** self.alpha, 1.0)
+                (w, wt, b, bt, hw, hwt, hb, hbt, loss) = _glove_step(
+                    w, wt, b, bt, hw, hwt, hb, hbt,
+                    jnp.asarray(r), jnp.asarray(c),
+                    jnp.asarray(np.log(x)), jnp.asarray(fx.astype(np.float32)),
+                    lr, jnp.asarray(valid))
+                total += float(loss)
+                count += n
+            last_loss = total / max(count, 1)
+        # final embedding = w + wt (GloVe convention)
+        self.lookup_table.syn0 = w + wt
+        return last_loss

@@ -1,0 +1,79 @@
+"""Weight lookup table holding syn0 / syn1 / syn1neg on device.
+
+Mirrors the reference's ``InMemoryLookupTable`` (ref: models/embeddings/
+inmemory/InMemoryLookupTable.java — syn0 init U(-0.5,0.5)/D per word2vec
+convention, syn1 zeros for hierarchical softmax, syn1neg zeros lazily for
+negative sampling, plus the unigram^0.75 negative-sampling distribution
+from makeTable).  Tables are jnp arrays living on the default device; the
+negative-sampling distribution is kept as a host-side cdf sampled with
+``np.searchsorted`` instead of the reference's 100M-entry lookup table.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.text.vocab import AbstractCache
+
+
+class InMemoryLookupTable:
+
+    def __init__(self, vocab: AbstractCache, vector_length: int,
+                 seed: int = 12345, use_hs: bool = True,
+                 negative: float = 0.0, dtype=jnp.float32):
+        self.vocab = vocab
+        self.vector_length = int(vector_length)
+        self.seed = int(seed)
+        self.use_hs = bool(use_hs)
+        self.negative = float(negative)
+        self.dtype = dtype
+        self.syn0: Optional[jnp.ndarray] = None
+        self.syn1: Optional[jnp.ndarray] = None
+        self.syn1neg: Optional[jnp.ndarray] = None
+        self._neg_cdf: Optional[np.ndarray] = None
+
+    def reset_weights(self, reset: bool = True) -> None:
+        v = self.vocab.num_words()
+        d = self.vector_length
+        if reset or self.syn0 is None:
+            rng = np.random.default_rng(self.seed)
+            # word2vec init: (rand - 0.5) / layer_size
+            syn0 = (rng.random((v, d), dtype=np.float32) - 0.5) / d
+            self.syn0 = jnp.asarray(syn0, self.dtype)
+            # syn1 rows = inner Huffman nodes (v-1); keep >=1 row so the
+            # kernels' gathers stay shape-stable when HS is off.
+            n_inner = max(v - 1, 1)
+            self.syn1 = jnp.zeros((n_inner if self.use_hs else 1, d),
+                                  self.dtype)
+            self.syn1neg = jnp.zeros((v if self.negative > 0 else 1, d),
+                                     self.dtype)
+
+    # -- negative sampling -------------------------------------------------
+    def neg_sampler(self) -> np.ndarray:
+        """Cumulative unigram^0.75 distribution over vocab indices."""
+        if self._neg_cdf is None:
+            freqs = np.array(
+                [max(e.element_frequency, 1.0)
+                 for e in self.vocab.vocab_words()], np.float64) ** 0.75
+            self._neg_cdf = np.cumsum(freqs / freqs.sum())
+        return self._neg_cdf
+
+    def sample_negatives(self, rng: np.random.Generator, shape) -> np.ndarray:
+        cdf = self.neg_sampler()
+        return np.searchsorted(cdf, rng.random(shape)).astype(np.int32)
+
+    # -- vector access -----------------------------------------------------
+    def vector(self, label: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(label)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def get_weights(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def set_weights(self, w) -> None:
+        self.syn0 = jnp.asarray(w, self.dtype)

@@ -1,0 +1,169 @@
+"""ParagraphVectors (doc2vec) — PV-DM / PV-DBOW.
+
+Mirrors the reference (ref: models/paragraphvectors/ParagraphVectors.java
+— label-aware sequences trained with learning/impl/sequence/{DBOW,DM}.java;
+``inferVector`` trains a fresh vector against frozen tables).  Document
+labels live in the same lookup table as words, exactly as the reference
+stores labels in the shared vocab/lookup.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.embeddings import kernels
+from deeplearning4j_tpu.embeddings.sequencevectors import VectorsConfiguration
+from deeplearning4j_tpu.embeddings.word2vec import Word2Vec, _SentenceSequenceSource
+from deeplearning4j_tpu.text.sequence import Sequence, VocabWord
+from deeplearning4j_tpu.text.sentence_iterators import (
+    LabelAwareSentenceIterator, LabelsSource, SentenceIterator)
+from deeplearning4j_tpu.text.tokenization import TokenizerFactory
+
+
+class _LabelledSource:
+    """Attach labels (explicit or generated) to tokenized sentences."""
+
+    def __init__(self, sentences: SentenceIterator, tf: TokenizerFactory,
+                 labels_source: LabelsSource):
+        self.sentences = sentences
+        self.tf = tf
+        self.labels_source = labels_source
+
+    def __iter__(self):
+        self.sentences.reset()
+        self.labels_source.reset()
+        label_aware = isinstance(self.sentences, LabelAwareSentenceIterator)
+        while self.sentences.has_next():
+            sentence = self.sentences.next_sentence()
+            seq = Sequence()
+            for tok in self.tf.create(sentence).get_tokens():
+                if tok:
+                    seq.add_element(VocabWord(tok))
+            if label_aware:
+                label = self.sentences.current_label()
+                self.labels_source.store_label(label)
+            else:
+                label = self.labels_source.next_label()
+            lbl = VocabWord(label)
+            lbl.special = True
+            seq.set_sequence_label(lbl)
+            yield seq
+
+
+class ParagraphVectors(Word2Vec):
+
+    def __init__(self, conf: Optional[VectorsConfiguration] = None):
+        conf = conf or VectorsConfiguration()
+        conf.train_sequences = True
+        super().__init__(conf)
+        self.labels_source = LabelsSource()
+
+    class Builder(Word2Vec.Builder):
+        def __init__(self, configuration: Optional[VectorsConfiguration] = None):
+            super().__init__(configuration)
+            self._labels_source = LabelsSource()
+            # PV-DM is the reference default sequence algorithm
+            self.conf.sequence_learning_algorithm = "DM"
+            self.conf.train_sequences = True
+
+        def labels_source(self, source: LabelsSource):
+            self._labels_source = source
+            return self
+
+        def labels(self, labels: List[str]):
+            self._labels_source = LabelsSource(labels=labels)
+            return self
+
+        def train_word_vectors(self, b: bool):
+            self.conf.train_elements = b
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            pv = ParagraphVectors(self.conf)
+            pv.labels_source = self._labels_source
+            if self._sentences is not None:
+                pv._sequence_source = _LabelledSource(
+                    self._sentences, self._tf, self._labels_source)
+            else:
+                pv._sequence_source = self._source
+            pv.vocab = self._vocab
+            return pv
+
+    # -- inference ---------------------------------------------------------
+    def infer_vector(self, text_or_tokens, steps: int = 10,
+                     learning_rate: float = 0.01) -> np.ndarray:
+        """Train a fresh doc vector against the frozen tables
+        (ref: ParagraphVectors.inferVector → SkipGram.iterateSample with
+        isInference=true updating only inferenceVector)."""
+        if isinstance(text_or_tokens, str):
+            from deeplearning4j_tpu.text.tokenization import DefaultTokenizerFactory
+            tokens = DefaultTokenizerFactory().create(text_or_tokens).get_tokens()
+        else:
+            tokens = list(text_or_tokens)
+        ids = [self.vocab.index_of(t) for t in tokens]
+        ids = np.array([i for i in ids if i >= 0], np.int32)
+        D = self.conf.layer_size
+        rng = np.random.default_rng(self.conf.seed)
+        vec = jnp.asarray((rng.random((1, D), dtype=np.float32) - 0.5) / D)
+        if ids.size == 0:
+            return np.asarray(vec[0])
+
+        points_m, codes_m, cmask_m = self._code_matrices()
+        t = self.lookup_table
+        K = max(self.conf.negative, 0) + 1
+        for _step in range(steps):
+            for center in ids:
+                pts = jnp.asarray(points_m[None, center])
+                codes = jnp.asarray(codes_m[None, center])
+                cmask = jnp.asarray(cmask_m[None, center]
+                                    if self.conf.use_hierarchic_softmax
+                                    else np.zeros_like(cmask_m[None, center]))
+                nidx = np.zeros((1, K), np.int32)
+                nidx[0, 0] = center
+                nlab = np.zeros((1, K), np.float32)
+                nlab[0, 0] = 1.0
+                nmask = np.zeros((1, K), np.float32)
+                if self.conf.negative > 0:
+                    negs = t.sample_negatives(rng, (1, K - 1))
+                    nidx[0, 1:] = negs
+                    nmask[:] = 1.0
+                    nmask[0, 1:] = (negs != center).astype(np.float32)
+                vec = kernels.infer_step(
+                    vec, t.syn1, t.syn1neg, pts, codes, cmask,
+                    jnp.asarray(nidx), jnp.asarray(nlab), jnp.asarray(nmask),
+                    jnp.asarray([learning_rate], np.float32))
+        return np.asarray(vec[0])
+
+    # -- label queries ------------------------------------------------------
+    def nearest_labels(self, text_or_vec, top: int = 5) -> List[str]:
+        if isinstance(text_or_vec, (str, list)):
+            vec = self.infer_vector(text_or_vec)
+        else:
+            vec = np.asarray(text_or_vec)
+        labels = [l for l in self.labels_source.get_labels()
+                  if self.vocab.contains_word(l)]
+        if not labels:
+            return []
+        table = np.stack([self.word_vector(l) for l in labels])
+        table = table / np.maximum(
+            np.linalg.norm(table, axis=1, keepdims=True), 1e-12)
+        v = vec / max(np.linalg.norm(vec), 1e-12)
+        order = np.argsort(-(table @ v))[:top]
+        return [labels[i] for i in order]
+
+    def similarity_to_label(self, text_or_vec, label: str) -> float:
+        if isinstance(text_or_vec, (str, list)):
+            vec = self.infer_vector(text_or_vec)
+        else:
+            vec = np.asarray(text_or_vec)
+        lv = self.word_vector(label)
+        if lv is None:
+            return float("nan")
+        return float(np.dot(vec, lv) /
+                     max(np.linalg.norm(vec) * np.linalg.norm(lv), 1e-12))
+
+
+ParagraphVectors.Builder._vectors_cls = ParagraphVectors

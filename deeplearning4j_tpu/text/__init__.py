@@ -1,0 +1,30 @@
+"""Text pipeline: tokenizers, sentence iterators, vocab construction.
+
+TPU-native re-realization of the reference's text stack
+(ref: deeplearning4j-nlp-parent/deeplearning4j-nlp/.../text/ — sentence
+iterators, tokenization factories, stopwords — and
+models/word2vec/wordstore/ — vocab cache + Huffman coding).  All of this
+is host-side CPU work feeding integer batches to the device kernels in
+``deeplearning4j_tpu.embeddings``.
+"""
+
+from deeplearning4j_tpu.text.sequence import Sequence, SequenceElement, VocabWord  # noqa: F401
+from deeplearning4j_tpu.text.tokenization import (  # noqa: F401
+    CommonPreprocessor,
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    EndingPreProcessor,
+    LowCasePreProcessor,
+    NGramTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.text.sentence_iterators import (  # noqa: F401
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelAwareListSentenceIterator,
+    LabelsSource,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.text.stopwords import StopWords  # noqa: F401
+from deeplearning4j_tpu.text.vocab import AbstractCache, Huffman, VocabConstructor  # noqa: F401

@@ -1,0 +1,299 @@
+"""NLP stack tests — mirrors the reference's Word2VecTests /
+tokenization / vectorizer suites (ref: deeplearning4j-nlp/src/test/
+models/word2vec/Word2VecTests.java — train on a small corpus, assert
+wordsNearest semantics; text/tokenization tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.text import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, Huffman, LabelAwareListSentenceIterator,
+    NGramTokenizerFactory, StopWords, VocabConstructor, VocabWord,
+)
+from deeplearning4j_tpu.text.sequence import Sequence
+from deeplearning4j_tpu.text.vectorizers import (
+    BagOfWordsVectorizer, TfidfVectorizer)
+from deeplearning4j_tpu.embeddings import (
+    Glove, ParagraphVectors, SequenceVectors, VectorsConfiguration,
+    Word2Vec, WordVectorSerializer)
+
+
+def _corpus():
+    """Synthetic corpus with two tight topical clusters."""
+    rng = np.random.default_rng(42)
+    animals = ["cat", "dog", "puppy", "kitten"]
+    fruits = ["apple", "banana", "mango", "pear"]
+    sents = []
+    for _ in range(300):
+        group = animals if rng.random() < 0.5 else fruits
+        words = [group[rng.integers(len(group))] for _ in range(8)]
+        sents.append(" ".join(words))
+    return sents
+
+
+# ---------------------------------------------------------------- text
+
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 test.").get_tokens()
+    assert toks == ["hello", "world", "test"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+    toks = tf.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_stopwords():
+    assert StopWords.is_stop_word("the")
+    assert not StopWords.is_stop_word("convolution")
+
+
+def test_basic_line_iterator(tmp_path):
+    p = tmp_path / "text.txt"
+    p.write_text("line one\n\nline two\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["line one", "line two"]
+    assert list(it) == ["line one", "line two"]  # resettable
+
+
+def test_huffman_codes_prefix_free():
+    words = [VocabWord(f"w{i}", freq) for i, freq in
+             enumerate([100, 50, 20, 10, 5, 2, 1])]
+    Huffman(words).build()
+    codes = {tuple(w.codes) for w in words}
+    assert len(codes) == len(words)
+    # prefix-free: no code is a prefix of another
+    for a in codes:
+        for b in codes:
+            if a != b:
+                assert a != b[:len(a)]
+    # highest-frequency word gets the shortest code
+    assert len(words[0].codes) == min(len(w.codes) for w in words)
+    # points are valid inner-node indices (< V-1)
+    for w in words:
+        assert all(0 <= p < len(words) - 1 for p in w.points)
+
+
+def test_vocab_constructor_min_frequency():
+    seqs = []
+    for sentence in ["a a a b b c", "a b d"]:
+        s = Sequence()
+        for tok in sentence.split():
+            s.add_element(VocabWord(tok))
+        seqs.append(s)
+    cache = VocabConstructor(min_element_frequency=2).add_source(seqs) \
+        .build_joint_vocabulary()
+    assert cache.contains_word("a") and cache.contains_word("b")
+    assert not cache.contains_word("c") and not cache.contains_word("d")
+    assert cache.index_of("a") == 0  # most frequent first
+
+
+# ---------------------------------------------------------------- word2vec
+
+
+@pytest.fixture(scope="module")
+def trained_w2v():
+    sents = _corpus()
+    w2v = (Word2Vec.Builder()
+           .iterate(CollectionSentenceIterator(sents))
+           .layer_size(32).window_size(4).epochs(3)
+           .learning_rate(0.05).min_word_frequency(1)
+           .negative_sample(5).use_hierarchic_softmax(True)
+           .batch_size(512).seed(12345)
+           .build())
+    w2v.fit()
+    return w2v
+
+
+def test_word2vec_clusters(trained_w2v):
+    w2v = trained_w2v
+    assert w2v.has_word("cat") and w2v.has_word("apple")
+    # in-cluster similarity beats cross-cluster
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "banana")
+    assert w2v.similarity("apple", "mango") > w2v.similarity("apple", "puppy")
+    nearest = w2v.words_nearest("cat", top=3)
+    assert set(nearest) <= {"dog", "puppy", "kitten"}
+
+
+def test_word2vec_cbow():
+    sents = _corpus()
+    w2v = (Word2Vec.Builder()
+           .iterate(CollectionSentenceIterator(sents))
+           .layer_size(24).window_size(4).epochs(3)
+           .learning_rate(0.05).min_word_frequency(1)
+           .negative_sample(5)
+           .elements_learning_algorithm("CBOW")
+           .batch_size(512).seed(7)
+           .build())
+    w2v.fit()
+    assert w2v.similarity("dog", "kitten") > w2v.similarity("dog", "pear")
+
+
+def test_word2vec_serialization_roundtrip(trained_w2v, tmp_path):
+    path = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.write_word_vectors(trained_w2v, path)
+    loaded = WordVectorSerializer.read_word_vectors(path)
+    v1 = trained_w2v.word_vector("cat")
+    v2 = loaded.word_vector("cat")
+    np.testing.assert_allclose(v1, v2, atol=1e-5)
+
+    binpath = str(tmp_path / "vectors.bin")
+    WordVectorSerializer.write_binary(trained_w2v, binpath)
+    loaded_bin = WordVectorSerializer.read_binary(binpath)
+    np.testing.assert_allclose(v1, loaded_bin.word_vector("cat"), atol=1e-6)
+
+    zippath = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_word2vec_model(trained_w2v, zippath)
+    model = WordVectorSerializer.read_word2vec_model(zippath)
+    np.testing.assert_allclose(v1, model.lookup_table.vector("cat"),
+                               atol=1e-6)
+    assert model.vocab.word_for("cat").codes == \
+        trained_w2v.vocab.word_for("cat").codes
+
+
+# ---------------------------------------------------------------- doc2vec
+
+
+def test_paragraph_vectors_labels():
+    sents = _corpus()
+    labels = ["animal" if any(w in s for w in ("cat", "dog"))
+              else "fruit" for s in sents]
+    pv = (ParagraphVectors.Builder()
+          .iterate(LabelAwareListSentenceIterator(sents, labels))
+          .layer_size(24).window_size(4).epochs(3)
+          .learning_rate(0.05).min_word_frequency(1)
+          .negative_sample(5).batch_size(512).seed(3)
+          .build())
+    pv.fit()
+    assert pv.has_word("animal") and pv.has_word("fruit")
+    # document vector for an animal sentence lands nearer "animal"
+    inferred = pv.infer_vector("cat dog puppy kitten cat dog", steps=20,
+                               learning_rate=0.05)
+    assert inferred.shape == (24,)
+    assert (pv.similarity_to_label(inferred, "animal")
+            > pv.similarity_to_label(inferred, "fruit"))
+
+
+# ---------------------------------------------------------------- glove
+
+
+def test_glove_clusters():
+    g = (Glove.Builder()
+         .iterate(CollectionSentenceIterator(_corpus()))
+         .layer_size(16).window_size(4).epochs(20)
+         .learning_rate(0.05).min_word_frequency(1).seed(11)
+         .build())
+    loss = g.fit()
+    assert np.isfinite(loss)
+    assert g.similarity("cat", "dog") > g.similarity("cat", "banana")
+
+
+# ---------------------------------------------------------------- vectorizers
+
+
+def test_bow_tfidf():
+    sents = ["the cat sat", "the dog sat", "apple banana"]
+    labels = ["pets", "pets", "fruit"]
+    bow = BagOfWordsVectorizer(
+        LabelAwareListSentenceIterator(sents, labels))
+    bow.fit()
+    v = bow.transform("cat cat dog")
+    assert v[bow.vocab.index_of("cat")] == 2.0
+    assert v[bow.vocab.index_of("dog")] == 1.0
+    ds = bow.fit_transform_all()
+    assert ds.features.shape[0] == 3 and ds.labels.shape[1] == 2
+
+    tfidf = TfidfVectorizer(LabelAwareListSentenceIterator(sents, labels))
+    tfidf.fit()
+    v = tfidf.transform("the cat")
+    # "the" appears in 2/3 docs, "cat" in 1/3 → cat weighted higher
+    assert v[tfidf.vocab.index_of("cat")] > v[tfidf.vocab.index_of("the")]
+
+
+def test_cnn_sentence_iterator(trained_w2v):
+    from deeplearning4j_tpu.text.cnn_iterator import (
+        CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
+    provider = CollectionLabeledSentenceProvider(
+        ["cat dog", "apple banana mango"], ["a", "f"])
+    it = CnnSentenceDataSetIterator(provider, trained_w2v, batch_size=4,
+                                    max_sentence_length=5)
+    ds = it.next()
+    assert ds.features.shape == (2, 1, 5, 32)
+    assert ds.labels.shape == (2, 2)
+    assert ds.features_mask.sum() == 5  # 2 + 3 tokens
+    # padded positions are zero
+    assert np.all(ds.features[0, 0, 2:] == 0)
+
+
+# ----------------------------------------------------- review regressions
+
+
+def test_generic_sequencevectors_trains():
+    """Plain SequenceVectors (no Word2Vec subclass) must resolve raw
+    elements against the vocab and actually train."""
+    rng = np.random.default_rng(0)
+    def seqs():
+        for _ in range(100):
+            s = Sequence()
+            group = ["a", "b"] if rng.random() < 0.5 else ["x", "y"]
+            for _ in range(6):
+                s.add_element(VocabWord(group[rng.integers(2)]))
+            yield s
+    sv = (SequenceVectors.Builder()
+          .iterate(list(seqs()))
+          .layer_size(8).window_size(2).epochs(2).min_word_frequency(1)
+          .negative_sample(2).batch_size(128).seed(5)
+          .build())
+    sv.fit()
+    before = (np.random.default_rng(5).random((4, 8)) - 0.5) / 8
+    assert not np.allclose(np.asarray(sv.lookup_table.syn0), before)
+    assert sv.similarity("a", "b") > sv.similarity("a", "x")
+
+
+def test_refit_preserves_weights(tmp_path, trained_w2v):
+    """fit() on a deserialized model must not wipe loaded weights."""
+    path = str(tmp_path / "m.zip")
+    WordVectorSerializer.write_word2vec_model(trained_w2v, path)
+    loaded = WordVectorSerializer.read_word2vec_model(path)
+    v_before = loaded.lookup_table.vector("cat").copy()
+    loaded.build_vocab()   # must be a no-op on weights
+    np.testing.assert_array_equal(loaded.lookup_table.vector("cat"), v_before)
+
+
+def test_sentence_iterator_reset_clears_peek():
+    it = CollectionSentenceIterator(["a", "b"])
+    it.has_next()
+    it.reset()
+    assert list(it) == ["a", "b"]
+
+
+def test_prefetch_propagates_errors():
+    def bad_source():
+        yield Sequence([VocabWord("a")])
+        raise RuntimeError("boom")
+    sv = (SequenceVectors.Builder()
+          .iterate([Sequence([VocabWord("a"), VocabWord("b")])])
+          .layer_size(4).min_word_frequency(1).build())
+    sv.build_vocab()
+    with pytest.raises(RuntimeError, match="boom"):
+        list(sv._prefetch(bad_source()))
+
+
+def test_text_serializer_tokens_with_spaces(tmp_path):
+    sv = (SequenceVectors.Builder()
+          .iterate([Sequence([VocabWord("new york"), VocabWord("city")])])
+          .layer_size(4).min_word_frequency(1).build())
+    sv.build_vocab()
+    path = str(tmp_path / "v.txt")
+    WordVectorSerializer.write_word_vectors(sv, path)
+    loaded = WordVectorSerializer.read_word_vectors(path)
+    assert loaded.has_word("new york")
+    np.testing.assert_allclose(loaded.word_vector("new york"),
+                               sv.word_vector("new york"), atol=1e-5)
