@@ -1,5 +1,6 @@
 from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: F401
 from deeplearning4j_tpu.nn.conf import layers  # noqa: F401
+from deeplearning4j_tpu.nn.conf import layers_pretrain  # noqa: F401  (registers pretrain layer types)
 from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
     NeuralNetConfiguration,
     MultiLayerConfiguration,

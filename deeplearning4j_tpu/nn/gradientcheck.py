@@ -61,10 +61,15 @@ def _check_gradients_x64(net, x, y, *, epsilon, max_rel_error, min_abs_error,
     y64 = jnp.asarray(np.asarray(y), jnp.float64)
 
     def score(p):
-        preout, _, m = net._forward_to_preout(p, state64, x64, fmask, True, rng)
+        preout, _, m, feats = net._forward_to_preout(p, state64, x64, fmask,
+                                                     True, rng)
         lm = lmask if lmask is not None else (
             m if (m is not None and m.ndim == preout.ndim - 1) else None)
-        per_ex = out_layer.compute_score(y64, preout, lm)
+        if getattr(out_layer, "requires_features_for_score", False):
+            per_ex = out_layer.compute_score_with_features(
+                y64, preout, feats, p[-1], lm)
+        else:
+            per_ex = out_layer.compute_score(y64, preout, lm)
         s = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
         return s + net._reg_penalty(p)
 
@@ -76,36 +81,94 @@ def _check_gradients_x64(net, x, y, *, epsilon, max_rel_error, min_abs_error,
     failures = []
     for li, lp in enumerate(params64):
         for k in param_util.ordered_keys(lp):
-            shape = lp[k].shape
-            # NB: reshape on an np.array-of-jax-array can silently COPY, so
-            # the flat buffer is the single mutable source of truth here.
-            flat = np.array(lp[k], dtype=np.float64).reshape(-1).copy()
-            an = np.asarray(analytic[li][k])
-            n = flat.size
-            idxs = (np.arange(n) if subset is None or n <= subset
-                    else nprng.choice(n, subset, replace=False))
-            for i in idxs:
-                orig = flat[i]
-                flat[i] = orig + epsilon
-                plus = float(score_jit(_with(params64, li, k, flat.reshape(shape))))
-                flat[i] = orig - epsilon
-                minus = float(score_jit(_with(params64, li, k, flat.reshape(shape))))
-                flat[i] = orig
-                numeric = (plus - minus) / (2 * epsilon)
-                a = an.reshape(-1)[i]
-                denom = max(abs(a), abs(numeric))
-                rel = abs(a - numeric) / denom if denom > 0 else 0.0
-                total_checked += 1
-                if rel > max_rel_error and abs(a - numeric) > min_abs_error:
-                    failures.append((li, k, int(i), float(a), numeric, rel))
+            fails, checked = _fd_check_one(
+                lp[k], np.asarray(analytic[li][k]),
+                lambda arr, li=li, k=k: float(
+                    score_jit(_with(params64, li, k, arr))),
+                epsilon, max_rel_error, min_abs_error, subset, nprng)
+            total_checked += checked
+            failures.extend((f"layer {li} {k}", i, a, num, rel)
+                            for i, a, num, rel in fails)
 
     if print_results or failures:
         print(f"Gradient check: {total_checked} params checked, "
               f"{len(failures)} failures")
-        for li, k, i, a, num, rel in failures[:20]:
-            print(f"  layer {li} {k}[{i}]: analytic={a:.3e} numeric={num:.3e} "
+        for label, i, a, num, rel in failures[:20]:
+            print(f"  {label}[{i}]: analytic={a:.3e} numeric={num:.3e} "
                   f"rel={rel:.3e}")
     return not failures
+
+
+def _fd_check_one(arr, analytic, eval_with, epsilon, max_rel_error,
+                  min_abs_error, subset, nprng):
+    """Central-difference check of one param tensor.  ``eval_with(new_arr)``
+    evaluates the scalar loss with the tensor replaced.  Returns
+    ([(flat_idx, analytic, numeric, rel_err)...] failures, n_checked)."""
+    shape = arr.shape
+    # NB: reshape on an np.array-of-jax-array can silently COPY, so
+    # the flat buffer is the single mutable source of truth here.
+    flat = np.array(arr, dtype=np.float64).reshape(-1).copy()
+    an = analytic.reshape(-1)
+    n = flat.size
+    idxs = (np.arange(n) if subset is None or n <= subset
+            else nprng.choice(n, subset, replace=False))
+    failures = []
+    for i in idxs:
+        orig = flat[i]
+        flat[i] = orig + epsilon
+        plus = eval_with(flat.reshape(shape))
+        flat[i] = orig - epsilon
+        minus = eval_with(flat.reshape(shape))
+        flat[i] = orig
+        numeric = (plus - minus) / (2 * epsilon)
+        a = an[i]
+        denom = max(abs(a), abs(numeric))
+        rel = abs(a - numeric) / denom if denom > 0 else 0.0
+        if rel > max_rel_error and abs(a - numeric) > min_abs_error:
+            failures.append((int(i), float(a), numeric, rel))
+    return failures, len(idxs)
+
+
+def check_pretrain_gradients(layer, params, x, *, epsilon: float = 1e-6,
+                             max_rel_error: float = 1e-3,
+                             min_abs_error: float = 1e-8,
+                             subset: Optional[int] = 64, seed: int = 0) -> bool:
+    """Gradient-check a pretrain layer's unsupervised loss
+    (ref: VaeGradientCheckTests.java — checks the pretrain path).
+
+    Stochastic pieces (corruption masks, MC samples, Gibbs chains) are made
+    deterministic by fixing the rng across both analytic and numeric
+    evaluation, so the finite difference probes the same realized loss.
+    """
+    with jax.enable_x64(True):
+        rng = jax.random.PRNGKey(seed)
+        p64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), params)
+        x64 = jnp.asarray(np.asarray(x), jnp.float64)
+
+        def loss(p):
+            return layer.pretrain_loss(p, x64, rng)
+
+        loss_jit = jax.jit(loss)
+        analytic = jax.grad(loss)(p64)
+        nprng = np.random.default_rng(seed)
+        failures = []
+        for k in param_util.ordered_keys(p64):
+            def eval_with(arr, k=k):
+                pp = dict(p64)
+                pp[k] = jnp.asarray(arr)
+                return float(loss_jit(pp))
+
+            fails, _ = _fd_check_one(
+                p64[k], np.asarray(analytic[k]), eval_with, epsilon,
+                max_rel_error, min_abs_error, subset, nprng)
+            failures.extend((k, i, a, num, rel) for i, a, num, rel in fails)
+        if failures:
+            print(f"Pretrain gradient check: {len(failures)} failures")
+            for k, i, a, num, rel in failures[:20]:
+                print(f"  {k}[{i}]: analytic={a:.3e} numeric={num:.3e} "
+                      f"rel={rel:.3e}")
+        return not failures
 
 
 def _with(params, li, k, arr):

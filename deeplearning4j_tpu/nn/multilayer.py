@@ -152,26 +152,32 @@ class MultiLayerNetwork:
             x = last._maybe_dropout(x, True, jax.random.fold_in(rng, n - 1))
         preout = last.preoutput(params[-1], x)
         new_states.append(state[-1])
-        return preout, new_states, mask
+        return preout, new_states, mask, x
 
     def _reg_penalty(self, params):
         total = 0.0
         for layer, lp in zip(self.layers, params):
-            l1 = layer.l1 or 0.0
-            l2 = layer.l2 or 0.0
-            l1b = layer.l1_bias or 0.0
-            l2b = layer.l2_bias or 0.0
-            for k, v in lp.items():
-                if k in BIAS_KEYS:
-                    if l1b:
-                        total = total + l1b * jnp.sum(jnp.abs(v))
-                    if l2b:
-                        total = total + 0.5 * l2b * jnp.sum(v * v)
-                elif k in WEIGHT_KEYS:
-                    if l1:
-                        total = total + l1 * jnp.sum(jnp.abs(v))
-                    if l2:
-                        total = total + 0.5 * l2 * jnp.sum(v * v)
+            total = total + self._layer_reg_penalty(layer, lp)
+        return total
+
+    @staticmethod
+    def _layer_reg_penalty(layer, lp):
+        total = 0.0
+        l1 = layer.l1 or 0.0
+        l2 = layer.l2 or 0.0
+        l1b = layer.l1_bias or 0.0
+        l2b = layer.l2_bias or 0.0
+        for k, v in lp.items():
+            if k in BIAS_KEYS:
+                if l1b:
+                    total = total + l1b * jnp.sum(jnp.abs(v))
+                if l2b:
+                    total = total + 0.5 * l2b * jnp.sum(v * v)
+            elif k in WEIGHT_KEYS:
+                if l1:
+                    total = total + l1 * jnp.sum(jnp.abs(v))
+                if l2:
+                    total = total + 0.5 * l2 * jnp.sum(v * v)
         return total
 
     # ------------------------------------------------------------------
@@ -190,12 +196,16 @@ class MultiLayerNetwork:
 
         def step(params, state, opts, x, y, fmask, lmask, it, rng):
             def loss_fn(p):
-                preout, new_states, m = self._forward_to_preout(
+                preout, new_states, m, feats = self._forward_to_preout(
                     p, state, x, fmask, True, rng,
                     stateful_rnn=(self.conf.backprop_type == "truncatedbptt"))
                 lm = lmask if lmask is not None else (
                     m if (m is not None and m.ndim == preout.ndim - 1) else None)
-                per_ex = out_layer.compute_score(y, preout, lm)
+                if getattr(out_layer, "requires_features_for_score", False):
+                    per_ex = out_layer.compute_score_with_features(
+                        y, preout, feats, p[-1], lm)
+                else:
+                    per_ex = out_layer.compute_score(y, preout, lm)
                 score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
                 score = score + self._reg_penalty(p)
                 if not g.minimize:
@@ -243,11 +253,15 @@ class MultiLayerNetwork:
         g = self.conf.global_conf
 
         def score_fn(params, state, x, y, fmask, lmask):
-            preout, _, m = self._forward_to_preout(params, state, x, fmask,
-                                                   False, jax.random.PRNGKey(0))
+            preout, _, m, feats = self._forward_to_preout(
+                params, state, x, fmask, False, jax.random.PRNGKey(0))
             lm = lmask if lmask is not None else (
                 m if (m is not None and m.ndim == preout.ndim - 1) else None)
-            per_ex = out_layer.compute_score(y, preout, lm)
+            if getattr(out_layer, "requires_features_for_score", False):
+                per_ex = out_layer.compute_score_with_features(
+                    y, preout, feats, params[-1], lm)
+            else:
+                per_ex = out_layer.compute_score(y, preout, lm)
             score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
             return score + self._reg_penalty(params)
 
@@ -347,6 +361,95 @@ class MultiLayerNetwork:
             self.iteration += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------
+    # Layerwise unsupervised pretraining (AE / RBM / VAE)
+    # ------------------------------------------------------------------
+    def pretrain(self, data, epochs: int = 1):
+        """Layerwise pretrain every pretrain-capable layer
+        (ref: MultiLayerNetwork.pretrain :1010-1024)."""
+        for i, layer in enumerate(self.layers):
+            if layer.is_pretrain_layer():
+                self.pretrain_layer(i, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, layer_idx: int, data, epochs: int = 1):
+        """Unsupervised fit of one layer on activations of the layers below
+        (ref: MultiLayerNetwork.pretrainLayer :197).  The per-layer step —
+        forward-to-layer, pretrain loss, grad, updater — is one jitted XLA
+        program with donated param/opt buffers."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            DataSetIterator, ListDataSetIterator)
+
+        layer = self.layers[layer_idx]
+        if not layer.is_pretrain_layer():
+            return self
+        if self.net_params is None:
+            self.init()
+        if isinstance(data, (np.ndarray, jax.Array)):
+            data = DataSet(np.asarray(data), np.asarray(data))
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        assert isinstance(data, DataSetIterator)
+
+        g = self.conf.global_conf
+        updater = self.updaters[layer_idx]
+
+        def pre_step(lp, opt, prefix_params, state, x, it, rng):
+            def to_layer_input(xi):
+                m = None
+                for j in range(layer_idx):
+                    if j in self.conf.preprocessors:
+                        xi, m = self.conf.preprocessors[j](xi, m)
+                    xi, _, m = self.layers[j].forward(
+                        prefix_params[j], state[j], xi, train=False,
+                        rng=jax.random.fold_in(rng, j), mask=m)
+                if layer_idx in self.conf.preprocessors:
+                    xi, m = self.conf.preprocessors[layer_idx](xi, m)
+                return xi
+
+            feats = jax.lax.stop_gradient(to_layer_input(x))
+
+            def full_loss(p):
+                # pretrain score includes this layer's l1/l2 and honors
+                # minimize, matching the supervised step (ref:
+                # BasePretrainNetwork score includes regularization)
+                loss = layer.pretrain_loss(p, feats, rng) + \
+                    self._layer_reg_penalty(layer, p)
+                return loss if g.minimize else -loss
+
+            loss, grads = jax.value_and_grad(full_loss)(lp)
+            grads = upd_ops.normalize_gradient(
+                grads, layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0)
+            lr = upd_ops.schedule_lr(
+                layer.learning_rate if layer.learning_rate is not None
+                else g.learning_rate,
+                g.lr_policy, it,
+                decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
+            upd, new_opt = updater.apply(grads, opt, lr, it)
+            new_lp = {k: lp[k] - upd[k] for k in lp}
+            return new_lp, new_opt, loss
+
+        step_jit = jax.jit(pre_step, donate_argnums=(0, 1))
+        for _ in range(epochs):
+            data.reset()
+            while data.has_next():
+                ds = data.next()
+                self._key, sub = jax.random.split(self._key)
+                lp, opt, loss = step_jit(
+                    self.net_params[layer_idx], self.opt_states[layer_idx],
+                    self.net_params[:layer_idx], self.net_state, ds.features,
+                    jnp.asarray(self.iteration, jnp.int32), sub)
+                self.net_params[layer_idx] = lp
+                self.opt_states[layer_idx] = opt
+                self._score = loss
+                self.iteration += 1
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration)
+        return self
 
     def _strip_rnn_state(self):
         """Drop per-batch RNN carry so standard training doesn't leak state

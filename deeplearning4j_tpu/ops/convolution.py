@@ -97,6 +97,47 @@ def pool2d(x, kind: str, kernel=(2, 2), stride=(2, 2), pad=(0, 0),
     raise ValueError(f"Unknown pooling type '{kind}'")
 
 
+def conv1d(x, w, b=None, stride=1, pad=0, dilation=1,
+           border_mode: str = "truncate", accum_dtype=None):
+    """1D convolution over sequences [N, T, C] with weights [K, C_in, C_out]
+    (ref: nn/conf/layers/Convolution1DLayer.java — operates on RNN-format
+    data).  One conv HLO on the MXU; NWC layout is TPU-friendly (channels
+    minor → lane dimension)."""
+    if accum_dtype is None:
+        accum_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    padding = "SAME" if border_mode == "same" else [(pad, pad)]
+    y = lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride,),
+        padding=padding,
+        rhs_dilation=(dilation,),
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        preferred_element_type=accum_dtype,
+    )
+    if b is not None:
+        y = y + b.reshape(1, 1, -1)
+    return y.astype(x.dtype)
+
+
+def pool1d(x, kind: str, kernel=2, stride=2, pad=0,
+           border_mode: str = "truncate", pnorm: int = 2):
+    """1D pooling over [N, T, C]
+    (ref: nn/conf/layers/Subsampling1DLayer.java).  Delegates to pool2d on
+    a [N, C, T, 1] view — the transposes are layout-only and fuse away."""
+    x2 = jnp.transpose(x, (0, 2, 1))[..., None]
+    y2 = pool2d(x2, kind, (kernel, 1), (stride, 1), (pad, 0),
+                border_mode, pnorm)
+    return jnp.transpose(y2[..., 0], (0, 2, 1))
+
+
+def conv1d_output_len(t, kernel, stride, pad, dilation=1,
+                      border_mode: str = "truncate"):
+    if border_mode == "same":
+        return -(-t // stride)
+    eff_k = (kernel - 1) * dilation + 1
+    return (t + 2 * pad - eff_k) // stride + 1
+
+
 def zero_pad2d(x, pad_top, pad_bottom, pad_left, pad_right):
     """ZeroPaddingLayer (ref: nn/conf/layers/ZeroPaddingLayer)."""
     return jnp.pad(x, ((0, 0), (0, 0), (pad_top, pad_bottom), (pad_left, pad_right)))

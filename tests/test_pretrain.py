@@ -1,0 +1,249 @@
+"""Unsupervised layer family: AutoEncoder, RBM, VAE, CenterLoss, Conv1D.
+
+Modeled on the reference's VaeGradientCheckTests.java, RBM/AutoEncoder
+tests in deeplearning4j-core, and the center-loss usage in
+CenterLossOutputLayer.java.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, Layer, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers_pretrain import (
+    AutoEncoder, CenterLossOutputLayer, Convolution1DLayer, RBM,
+    Subsampling1DLayer, VariationalAutoencoder)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.gradientcheck import (
+    check_gradients, check_pretrain_gradients)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _x(n=16, d=8, seed=0):
+    return np.random.default_rng(seed).normal(size=(n, d)).astype(np.float32)
+
+
+def _net(*layers, lr=0.1, updater="sgd", input_type=None):
+    b = (NeuralNetConfiguration.builder().seed(42).learning_rate(lr)
+         .updater(updater).list())
+    for l in layers:
+        b = b.layer(l)
+    if input_type is not None:
+        b = b.set_input_type(input_type)
+    return MultiLayerNetwork(b.build()).init()
+
+
+# ---------------------------------------------------------------------------
+# AutoEncoder
+# ---------------------------------------------------------------------------
+
+def test_autoencoder_pretrain_reduces_loss():
+    # data in [0,1] — the sigmoid decoder's range
+    x = np.random.default_rng(0).uniform(size=(16, 8)).astype(np.float32)
+    net = _net(AutoEncoder(n_in=8, n_out=4, activation="sigmoid",
+                           corruption_level=0.0, loss="mse"),
+               OutputLayer(n_in=4, n_out=3, activation="softmax"),
+               lr=0.05, updater="adam")
+    layer = net.layers[0]
+    p0 = net.net_params[0]
+    before = float(layer.pretrain_loss(p0, x, jax.random.PRNGKey(0)))
+    net.pretrain_layer(0, x, epochs=60)
+    after = float(layer.pretrain_loss(net.net_params[0], x,
+                                      jax.random.PRNGKey(0)))
+    assert after < before * 0.9
+
+
+def test_autoencoder_pretrain_gradients():
+    layer = AutoEncoder(n_in=6, n_out=4, activation="sigmoid",
+                        corruption_level=0.3, loss="mse")
+    params, _, _ = layer.initialize(jax.random.PRNGKey(1),
+                                    InputType.feed_forward(6))
+    assert check_pretrain_gradients(layer, params, _x(8, 6), subset=None)
+
+
+def test_autoencoder_supervised_forward_shape():
+    net = _net(AutoEncoder(n_in=8, n_out=4, activation="sigmoid"),
+               OutputLayer(n_in=4, n_out=3, activation="softmax"))
+    out = net.output(_x(5))
+    assert out.shape == (5, 3)
+
+
+# ---------------------------------------------------------------------------
+# RBM
+# ---------------------------------------------------------------------------
+
+def test_rbm_cd_reduces_reconstruction_error():
+    rng = np.random.default_rng(3)
+    # bimodal binary-ish data the RBM can model
+    x = (rng.uniform(size=(64, 12)) < 0.2).astype(np.float32)
+    x[::2] = (rng.uniform(size=x[::2].shape) < 0.8).astype(np.float32)
+    net = _net(RBM(n_in=12, n_out=8, hidden_unit="binary",
+                   visible_unit="binary", k=1),
+               OutputLayer(n_in=8, n_out=2, activation="softmax"), lr=0.05)
+    layer = net.layers[0]
+    before = layer.reconstruction_error(net.net_params[0], x)
+    net.pretrain_layer(0, x, epochs=100)
+    after = layer.reconstruction_error(net.net_params[0], x)
+    assert after < before
+
+
+def test_rbm_free_energy_finite():
+    layer = RBM(n_in=6, n_out=4)
+    params, _, _ = layer.initialize(jax.random.PRNGKey(0),
+                                    InputType.feed_forward(6))
+    fe = layer.free_energy(params, _x(4, 6))
+    assert np.all(np.isfinite(np.asarray(fe)))
+
+
+# ---------------------------------------------------------------------------
+# Variational autoencoder
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dist", [
+    {"type": "gaussian", "activation": "identity"},
+    {"type": "bernoulli"},
+    {"type": "loss", "loss": "mse", "activation": "sigmoid"},
+])
+def test_vae_pretrain_gradients(dist):
+    layer = VariationalAutoencoder(
+        n_in=5, n_out=3, encoder_layer_sizes=(7,), decoder_layer_sizes=(7,),
+        activation="tanh", pzx_activation="identity",
+        reconstruction_distribution=dist, num_samples=1)
+    params, _, _ = layer.initialize(jax.random.PRNGKey(2),
+                                    InputType.feed_forward(5))
+    x = _x(6, 5, seed=4)
+    if dist["type"] == "bernoulli":
+        x = (x > 0).astype(np.float32)
+    assert check_pretrain_gradients(layer, params, x, subset=48)
+
+
+def test_vae_pretrain_reduces_elbo():
+    x = _x(32, 8, seed=5)
+    net = _net(VariationalAutoencoder(
+        n_in=8, n_out=2, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+        activation="tanh",
+        reconstruction_distribution={"type": "gaussian"}),
+        OutputLayer(n_in=2, n_out=2, activation="softmax"), lr=0.01,
+        updater="adam")
+    layer = net.layers[0]
+    before = float(layer.pretrain_loss(net.net_params[0], x,
+                                       jax.random.PRNGKey(9)))
+    net.pretrain_layer(0, x, epochs=80)
+    after = float(layer.pretrain_loss(net.net_params[0], x,
+                                      jax.random.PRNGKey(9)))
+    assert after < before
+
+
+def test_vae_generation_and_reconstruction_api():
+    layer = VariationalAutoencoder(
+        n_in=8, n_out=2, encoder_layer_sizes=(10,), decoder_layer_sizes=(10,),
+        activation="tanh",
+        reconstruction_distribution={"type": "gaussian"})
+    params, _, _ = layer.initialize(jax.random.PRNGKey(0),
+                                    InputType.feed_forward(8))
+    x = _x(4, 8)
+    lp = layer.reconstruction_log_probability(params, x,
+                                              jax.random.PRNGKey(1),
+                                              num_samples=4)
+    assert lp.shape == (4,)
+    z = np.zeros((3, 2), np.float32)
+    recon = layer.generate_at_mean_given_z(params, z)
+    assert recon.shape == (3, 8)
+    err = layer.reconstruction_error(params, x)
+    assert err.shape == (4,)
+
+
+def test_pretrain_whole_network():
+    """pretrain() walks every pretrain layer (ref: MultiLayerNetwork.pretrain)."""
+    x = _x(16, 8)
+    net = _net(AutoEncoder(n_in=8, n_out=6, activation="sigmoid",
+                           corruption_level=0.0),
+               AutoEncoder(n_in=6, n_out=4, activation="sigmoid",
+                           corruption_level=0.0),
+               OutputLayer(n_in=4, n_out=2, activation="softmax"))
+    net.pretrain(x, epochs=3)
+    assert net.iteration == 6  # 3 epochs x 2 pretrain layers x 1 batch
+
+
+# ---------------------------------------------------------------------------
+# Center loss
+# ---------------------------------------------------------------------------
+
+def test_center_loss_gradients():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(8, 4))
+    y = np.eye(3)[rng.integers(0, 3, 8)]
+    net = _net(DenseLayer(n_in=4, n_out=5, activation="tanh"),
+               CenterLossOutputLayer(n_in=5, n_out=3, activation="softmax",
+                                     loss="mcxent", lambda_=0.5,
+                                     gradient_check=True))
+    assert check_gradients(net, x, y, subset=None)
+
+
+def test_center_loss_training_moves_centers():
+    rng = np.random.default_rng(8)
+    n = 60
+    labels = rng.integers(0, 2, n)
+    x = rng.normal(size=(n, 4)) + 3.0 * labels[:, None]
+    y = np.eye(2)[labels]
+    net = _net(DenseLayer(n_in=4, n_out=6, activation="relu"),
+               CenterLossOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                     alpha=0.5, lambda_=0.01), lr=0.1)
+    net.fit(x, y, epochs=30)
+    centers = np.asarray(net.net_params[-1]["cL"])
+    assert not np.allclose(centers, 0.0)  # centers moved toward class means
+    assert np.mean(net.predict(x) == labels) > 0.8
+
+
+# ---------------------------------------------------------------------------
+# Conv1D family
+# ---------------------------------------------------------------------------
+
+def test_conv1d_shapes_and_training():
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(4, 10, 3)).astype(np.float32)  # [N, T, C]
+    y = np.tile(np.eye(2)[rng.integers(0, 2, 4)][:, None, :], (1, 5, 1))
+    net = _net(Convolution1DLayer(n_in=3, n_out=6, kernel=3,
+                                  convolution_mode="same", activation="relu"),
+               Subsampling1DLayer(kernel=2, stride=2),
+               RnnOutputLayer(n_in=6, n_out=2, activation="softmax"),
+               input_type=InputType.recurrent(3, 10))
+    out = net.output(x)
+    assert out.shape == (4, 5, 2)
+    s0 = None
+    for _ in range(20):
+        net.fit(x, y)
+        if s0 is None:
+            s0 = net.score()
+    assert net.score() < s0
+
+
+def test_conv1d_gradients():
+    rng = np.random.default_rng(10)
+    x = rng.normal(size=(3, 8, 2))
+    y = np.tile(np.eye(2)[rng.integers(0, 2, 3)][:, None, :], (1, 8, 1))
+    net = _net(Convolution1DLayer(n_in=2, n_out=4, kernel=3,
+                                  convolution_mode="same", activation="tanh"),
+               RnnOutputLayer(n_in=4, n_out=2, activation="softmax"),
+               input_type=InputType.recurrent(2, 8))
+    assert check_gradients(net, x, y, subset=None)
+
+
+# ---------------------------------------------------------------------------
+# Serialization round-trip of the new configs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("layer", [
+    AutoEncoder(n_in=8, n_out=4, corruption_level=0.2),
+    RBM(n_in=8, n_out=4, hidden_unit="binary", visible_unit="gaussian", k=2),
+    VariationalAutoencoder(n_in=8, n_out=2, encoder_layer_sizes=(5,),
+                           reconstruction_distribution={"type": "bernoulli"}),
+    CenterLossOutputLayer(n_in=5, n_out=3, alpha=0.1, lambda_=0.01),
+    Convolution1DLayer(n_in=3, n_out=6, kernel=5),
+    Subsampling1DLayer(kernel=3, stride=3),
+])
+def test_layer_config_roundtrip(layer):
+    d = layer.to_dict()
+    back = Layer.from_dict(d)
+    assert back == layer
