@@ -1,0 +1,19 @@
+"""Clustering + space-partitioning structures.
+
+Parity with the reference's deeplearning4j-core clustering package
+(ref: clustering/{kmeans,kdtree,vptree,quadtree,sptree,cluster}/ — ~4.1k
+LoC Java).  TPU-first split: the iterative numeric kernels (K-Means
+assignment/update, t-SNE forces) are jitted dense linear algebra on the
+MXU; the pointer-chasing trees (KD/VP/SP/Quad) stay host-side with
+vectorized NumPy distance evaluation — on TPU a dense batched distance
+matrix beats tree traversal for any N that fits in HBM, so the trees
+exist for API parity and for host-side serving (NearestNeighborsServer).
+"""
+
+from deeplearning4j_tpu.clustering.cluster import (  # noqa: F401
+    Cluster, ClusterSet, Point)
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering  # noqa: F401
+from deeplearning4j_tpu.clustering.kdtree import KDTree  # noqa: F401
+from deeplearning4j_tpu.clustering.vptree import VPTree  # noqa: F401
+from deeplearning4j_tpu.clustering.quadtree import QuadTree  # noqa: F401
+from deeplearning4j_tpu.clustering.sptree import SpTree  # noqa: F401

@@ -490,9 +490,40 @@ class Subsampling1DLayer(Layer):
         return {}, {}, self.output_type(input_type)
 
     def forward(self, params, state, x, *, train, rng, mask=None):
-        y = conv_ops.pool1d(x, self.pooling_type, self.kernel, self.stride,
-                            self.padding, self.convolution_mode, self.pnorm)
-        return y, state, None
+        kind = self.pooling_type.lower()
+        if mask is None:
+            y = conv_ops.pool1d(x, kind, self.kernel, self.stride,
+                                self.padding, self.convolution_mode,
+                                self.pnorm)
+            return y, state, None
+        # Mask-aware pooling (MaskedReductionUtil semantics): padded
+        # timesteps must not contribute, and the output mask is the
+        # max-pool of the input mask (window valid ⟺ any valid step).
+        mf = mask[..., None].astype(x.dtype)
+        if kind == "max":
+            fill = jnp.finfo(x.dtype).min
+            xm = jnp.where(mf > 0, x, fill)
+            y = conv_ops.pool1d(xm, "max", self.kernel, self.stride,
+                                self.padding, self.convolution_mode)
+        elif kind in ("avg", "mean"):
+            s = conv_ops.pool1d(x * mf, "sum", self.kernel, self.stride,
+                                self.padding, self.convolution_mode)
+            cnt = conv_ops.pool1d(jnp.broadcast_to(mf, x.shape), "sum",
+                                  self.kernel, self.stride, self.padding,
+                                  self.convolution_mode)
+            y = s / jnp.maximum(cnt, 1.0)
+        elif kind == "sum":
+            y = conv_ops.pool1d(x * mf, "sum", self.kernel, self.stride,
+                                self.padding, self.convolution_mode)
+        else:  # pnorm
+            y = conv_ops.pool1d(x * mf, "pnorm", self.kernel, self.stride,
+                                self.padding, self.convolution_mode,
+                                self.pnorm)
+        out_mask = conv_ops.pool1d(mask[..., None].astype(x.dtype), "max",
+                                   self.kernel, self.stride, self.padding,
+                                   self.convolution_mode)[..., 0]
+        y = y * (out_mask[..., None] > 0).astype(x.dtype)
+        return y, state, out_mask
 
     def output_type(self, input_type):
         t = input_type.timesteps

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne  # noqa: F401

@@ -1,0 +1,248 @@
+"""t-SNE embedding (ref: plot/BarnesHutTsne.java:65, 858 LoC; plot/Tsne.java).
+
+TPU-first split:
+  - ``theta == 0`` (exact): the ENTIRE optimization — perplexity binary
+    search, pairwise affinities, KL gradient, momentum+gains update loop —
+    is one jitted program of dense [N, N] ops, which the MXU eats for any
+    N that fits in HBM (N·N·4 bytes; ~20k points in <2 GB).  This is the
+    default and the fast path: on TPU a dense quadratic kernel beats
+    pointer-chasing Barnes-Hut until N is far beyond what t-SNE is
+    typically used for.
+  - ``theta > 0``: classic Barnes-Hut (VPTree kNN sparse affinities +
+    SpTree force approximation) on the host, for API/semantics parity
+    with the reference and for very large N.
+
+Reference hyperparameter defaults preserved: learning rate 500, momentum
+0.5 → 0.8 at iteration 100 (switchMomentumIteration), early exaggeration
+until iteration 250 (stopLyingIteration).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SpTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+# ---------------------------------------------------------------------------
+# Exact TPU kernel
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _x2p_dense(x, perplexity: float, tol: float = 1e-5, iters: int = 50):
+    """Per-point conditional gaussians with bisection on beta so every
+    row hits the target perplexity (ref: Tsne x2p / computeGaussianPerplexity).
+    Vectorized: all N bisections advance together."""
+    n = x.shape[0]
+    sum_x = jnp.sum(x * x, axis=1)
+    xxt = jnp.dot(x, x.T, precision=jax.lax.Precision.HIGHEST)
+    d = jnp.maximum(sum_x[:, None] - 2.0 * xxt + sum_x[None, :], 0.0)
+    log_u = jnp.log(perplexity)
+    eye = jnp.eye(n, dtype=bool)
+
+    def entropy_and_p(beta):
+        p = jnp.where(eye, 0.0, jnp.exp(-d * beta[:, None]))
+        sum_p = jnp.maximum(jnp.sum(p, axis=1), 1e-30)
+        h = jnp.log(sum_p) + beta * jnp.sum(d * p, axis=1) / sum_p
+        return h, p / sum_p[:, None]
+
+    def body(i, carry):
+        beta, lo, hi = carry
+        h, _ = entropy_and_p(beta)
+        too_high = h > log_u  # entropy too high -> beta too small
+        lo = jnp.where(too_high, beta, lo)
+        hi = jnp.where(too_high, hi, beta)
+        beta = jnp.where(jnp.isinf(hi), beta * 2.0,
+                         jnp.where(jnp.isinf(lo), beta / 2.0, (lo + hi) / 2.0))
+        return beta, lo, hi
+
+    beta0 = jnp.ones((n,), x.dtype)
+    beta, _, _ = jax.lax.fori_loop(
+        0, iters, body,
+        (beta0, jnp.full((n,), -jnp.inf, x.dtype), jnp.full((n,), jnp.inf, x.dtype)))
+    _, p = entropy_and_p(beta)
+    return p
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
+def _tsne_exact(p_sym, y0, n_iter: int, lr: float,
+                switch_momentum_iter: int, stop_lying_iter: int,
+                exaggeration: float):
+    """Momentum+gains gradient descent on KL(P||Q) — one traced loop
+    (ref: Tsne.gradient / BarnesHutTsne.gradient)."""
+    n = y0.shape[0]
+    eye = jnp.eye(n, dtype=bool)
+
+    def body(it, carry):
+        y, inc, gains = carry
+        sum_y = jnp.sum(y * y, axis=1)
+        # highest precision + clamp: TPU matmuls default to bf16 passes,
+        # and a slightly-negative d² here turns 1/(1+d²) into inf
+        yyt = jnp.dot(y, y.T, precision=jax.lax.Precision.HIGHEST)
+        d2 = jnp.maximum(sum_y[:, None] - 2.0 * yyt + sum_y[None, :], 0.0)
+        num = 1.0 / (1.0 + d2)
+        num = jnp.where(eye, 0.0, num)
+        q = jnp.maximum(num / jnp.sum(num), 1e-12)
+        exag = jnp.where(it < stop_lying_iter, exaggeration, 1.0)
+        pq = (p_sym * exag - q) * num                       # [N, N]
+        grad = 4.0 * ((jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y)
+        gains = jnp.where(jnp.sign(grad) != jnp.sign(inc),
+                          gains + 0.2, gains * 0.8)
+        gains = jnp.maximum(gains, 0.01)
+        momentum = jnp.where(it < switch_momentum_iter, 0.5, 0.8)
+        inc = momentum * inc - lr * gains * grad
+        y = y + inc
+        y = y - jnp.mean(y, axis=0, keepdims=True)
+        return y, inc, gains
+
+    y, _, _ = jax.lax.fori_loop(
+        0, n_iter, body,
+        (y0, jnp.zeros_like(y0), jnp.ones_like(y0)))
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+class BarnesHutTsne:
+    """(ref: plot/BarnesHutTsne.java — implements Model; here a plain
+    estimator with fit/fit_transform)."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 theta: float = 0.0, learning_rate: float = 500.0,
+                 n_iter: int = 1000, stop_lying_iteration: int = 250,
+                 switch_momentum_iteration: int = 100,
+                 exaggeration: float = 12.0, seed: int = 0):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.theta = theta
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.stop_lying_iteration = stop_lying_iteration
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.exaggeration = exaggeration
+        self.seed = seed
+        self.Y_: Optional[np.ndarray] = None
+
+    # -- exact path --------------------------------------------------------
+    def _fit_exact(self, x):
+        p = _x2p_dense(jnp.asarray(x, jnp.float32), float(self.perplexity))
+        p = (p + p.T) / (2.0 * x.shape[0])
+        p = jnp.maximum(p, 1e-12)
+        y0 = 1e-4 * jax.random.normal(
+            jax.random.PRNGKey(self.seed), (x.shape[0], self.n_components),
+            jnp.float32)
+        y = _tsne_exact(p, y0, self.n_iter, self.learning_rate,
+                        self.switch_momentum_iteration,
+                        self.stop_lying_iteration, self.exaggeration)
+        return np.asarray(y)
+
+    # -- Barnes-Hut path ---------------------------------------------------
+    def _knn_p(self, x):
+        """Sparse kNN affinities via VPTree
+        (ref: BarnesHutTsne.computeGaussianPerplexity(…, k=3*perplexity))."""
+        n = x.shape[0]
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(x, "euclidean", seed=self.seed)
+        rows = np.zeros((n, k), np.int32)
+        vals = np.zeros((n, k), np.float64)
+        log_u = np.log(self.perplexity)
+        for i in range(n):
+            idxs, dists = tree.knn(x[i], k + 1)
+            pairs_id = [(j, dj) for j, dj in zip(idxs, dists) if j != i][:k]
+            idxs = [j for j, _ in pairs_id]
+            d2 = np.array([dj for _, dj in pairs_id]) ** 2
+            beta, lo, hi = 1.0, -np.inf, np.inf
+            for _ in range(50):
+                pr = np.exp(-d2 * beta)
+                sum_p = max(pr.sum(), 1e-30)
+                h = np.log(sum_p) + beta * float((d2 * pr).sum()) / sum_p
+                if abs(h - log_u) < 1e-5:
+                    break
+                if h > log_u:
+                    lo = beta
+                    beta = beta * 2.0 if np.isinf(hi) else (lo + hi) / 2.0
+                else:
+                    hi = beta
+                    beta = beta / 2.0 if np.isinf(lo) else (lo + hi) / 2.0
+            pr = np.exp(-d2 * beta)
+            pr /= max(pr.sum(), 1e-30)
+            rows[i, :len(idxs)] = idxs
+            vals[i, :len(idxs)] = pr
+        return rows, vals
+
+    def _fit_bh(self, x):
+        n = x.shape[0]
+        rows, vals = self._knn_p(x)
+        # symmetrize into a dict-of-pairs sparse P
+        p = {}
+        for i in range(n):
+            for j, v in zip(rows[i], vals[i]):
+                if v <= 0:
+                    continue
+                key = (min(i, int(j)), max(i, int(j)))
+                p[key] = p.get(key, 0.0) + v
+        total = sum(p.values())
+        pairs = np.array(list(p.keys()), np.int32)
+        pvals = np.array(list(p.values())) / max(total, 1e-30)
+
+        rng = np.random.default_rng(self.seed)
+        y = 1e-4 * rng.standard_normal((n, self.n_components))
+        inc = np.zeros_like(y)
+        gains = np.ones_like(y)
+        for it in range(self.n_iter):
+            exag = self.exaggeration if it < self.stop_lying_iteration else 1.0
+            # attractive forces from sparse P
+            diff = y[pairs[:, 0]] - y[pairs[:, 1]]
+            q_num = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            f = (exag * pvals * q_num)[:, None] * diff
+            attr = np.zeros_like(y)
+            np.add.at(attr, pairs[:, 0], f)
+            np.add.at(attr, pairs[:, 1], -f)
+            # repulsive via SpTree
+            tree = SpTree.build(y)
+            rep = np.zeros_like(y)
+            sum_q = 0.0
+            for i in range(n):
+                neg, sq = tree.compute_non_edge_forces(y[i], self.theta)
+                rep[i] = neg
+                sum_q += sq
+            grad = attr - rep / max(sum_q, 1e-30)
+            gains = np.where(np.sign(grad) != np.sign(inc),
+                             gains + 0.2, gains * 0.8)
+            gains = np.maximum(gains, 0.01)
+            momentum = 0.5 if it < self.switch_momentum_iteration else 0.8
+            inc = momentum * inc - self.learning_rate * gains * grad
+            y = y + inc
+            y = y - y.mean(0, keepdims=True)
+        return y
+
+    # -- API ---------------------------------------------------------------
+    def fit(self, x) -> "BarnesHutTsne":
+        x = np.asarray(x, np.float32)
+        self.Y_ = self._fit_exact(x) if self.theta == 0.0 else self._fit_bh(x)
+        return self
+
+    def fit_transform(self, x) -> np.ndarray:
+        return self.fit(x).Y_
+
+    def save_as_file(self, labels, path: str) -> None:
+        """CSV "y1,y2,...,label" per point (ref: BarnesHutTsne.saveAsFile)."""
+        with open(path, "w") as f:
+            for row, lab in zip(self.Y_, labels):
+                f.write(",".join(f"{v:.6f}" for v in row) + f",{lab}\n")
+
+
+class Tsne(BarnesHutTsne):
+    """Exact-only alias (ref: plot/Tsne.java)."""
+
+    def __init__(self, **kw):
+        kw["theta"] = 0.0
+        super().__init__(**kw)

@@ -1,0 +1,184 @@
+"""Clustering + t-SNE (modeled on the reference's clustering tests and
+BarnesHutTsneTest in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    Cluster, ClusterSet, KDTree, KMeansClustering, Point, QuadTree, SpTree,
+    VPTree)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(n_per=50, centers=((0, 0), (10, 10), (-10, 10)), seed=0, d=2):
+    rng = np.random.default_rng(seed)
+    pts, labels = [], []
+    for ci, c in enumerate(centers):
+        base = np.zeros(d)
+        base[:2] = c
+        pts.append(rng.normal(size=(n_per, d)) + base)
+        labels += [ci] * n_per
+    return np.concatenate(pts).astype(np.float32), np.array(labels)
+
+
+# ---------------------------------------------------------------------------
+# KMeans
+# ---------------------------------------------------------------------------
+
+def test_kmeans_recovers_blobs():
+    x, labels = _blobs()
+    km = KMeansClustering.setup(3, 100, "euclidean", seed=1)
+    cs = km.apply_to(x)
+    assert isinstance(cs, ClusterSet)
+    assert len(cs.clusters) == 3
+    # every cluster should be label-pure given well-separated blobs
+    assign = km.assignments_
+    for k in range(3):
+        members = labels[assign == k]
+        assert len(members) > 0
+        counts = np.bincount(members, minlength=3)
+        assert counts.max() / counts.sum() > 0.95
+
+
+def test_kmeans_predict_and_nearest_cluster():
+    x, _ = _blobs()
+    km = KMeansClustering.setup(3, 50, seed=2)
+    cs = km.apply_to(x)
+    pred = km.predict(np.array([[0.0, 0.0], [10.0, 10.0]], np.float32))
+    assert pred.shape == (2,)
+    assert pred[0] != pred[1]
+    c = cs.nearest_cluster(Point(np.array([10.0, 10.0])))
+    assert np.linalg.norm(c.center - np.array([10, 10])) < 2.0
+
+
+# ---------------------------------------------------------------------------
+# Trees
+# ---------------------------------------------------------------------------
+
+def test_kdtree_knn_matches_bruteforce():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(200, 5))
+    tree = KDTree.build(pts)
+    q = rng.normal(size=5)
+    _, dists, idxs = tree.knn(q, 7)
+    brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+    assert set(idxs) == set(brute.tolist())
+    assert np.all(np.diff(dists) >= -1e-12)
+
+
+def test_kdtree_insert_and_nn():
+    tree = KDTree(2)
+    for i, p in enumerate([(0, 0), (5, 5), (1, 1), (9, 2)]):
+        tree.insert(np.array(p, float), i)
+    pt, d, idx = tree.nn(np.array([1.2, 1.1]))
+    assert idx == 2
+    assert d < 0.5
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "cosine", "manhattan"])
+def test_vptree_knn_matches_bruteforce(metric):
+    from deeplearning4j_tpu.clustering.distances import distance_fn
+    rng = np.random.default_rng(4)
+    pts = rng.normal(size=(150, 8))
+    tree = VPTree(pts, metric, seed=5)
+    q = rng.normal(size=8)
+    idxs, dists = tree.knn(q, 5)
+    brute = np.argsort(np.atleast_1d(distance_fn(metric)(q, pts)))[:5]
+    assert set(idxs) == set(brute.tolist())
+
+
+def test_vptree_cosine_exact_on_many_queries():
+    """Regression: cosine pruning must stay exact (searches in euclidean
+    space over normalized vectors — triangle inequality holds there)."""
+    from deeplearning4j_tpu.clustering.distances import distance_fn
+    rng = np.random.default_rng(42)
+    pts = rng.normal(size=(300, 8))
+    tree = VPTree(pts, "cosine", seed=1)
+    wrong = 0
+    for _ in range(40):
+        q = rng.normal(size=8)
+        idxs, dists = tree.knn(q, 5)
+        brute_d = np.atleast_1d(distance_fn("cosine")(q, pts))
+        brute = np.argsort(brute_d)[:5]
+        if set(idxs) != set(brute.tolist()):
+            wrong += 1
+        assert np.allclose(sorted(dists), np.sort(brute_d)[:5], atol=1e-9)
+    assert wrong == 0
+
+
+def test_vptree_rejects_non_metric_dot():
+    with pytest.raises(ValueError):
+        VPTree(np.eye(3), "dot")
+
+
+def test_vptree_labels():
+    pts = np.eye(4)
+    tree = VPTree(pts, "euclidean", labels=["a", "b", "c", "d"])
+    labs, _ = tree.knn_labels(np.array([1.0, 0.1, 0, 0]), 1)
+    assert labs == ["a"]
+
+
+def test_sptree_center_of_mass_and_forces():
+    rng = np.random.default_rng(6)
+    pts = rng.normal(size=(64, 2))
+    tree = SpTree.build(pts)
+    assert tree.cum_size == 64
+    assert np.allclose(tree.center_of_mass, pts.mean(0), atol=1e-9)
+    # theta=0 forces the exact O(N) traversal -> matches brute force
+    q = pts[0]
+    neg, sum_q = tree.compute_non_edge_forces(q, theta=0.0)
+    diff = q - pts[1:]
+    qn = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+    assert np.isclose(sum_q, qn.sum(), rtol=1e-6)
+    assert np.allclose(neg, (qn[:, None] ** 2 * diff).sum(0), rtol=1e-6)
+
+
+def test_quadtree_is_2d():
+    pts = np.random.default_rng(7).normal(size=(32, 2))
+    tree = QuadTree.build(pts)
+    assert tree.cum_size == 32
+    with pytest.raises(AssertionError):
+        QuadTree.build(np.zeros((4, 3)))
+
+
+# ---------------------------------------------------------------------------
+# t-SNE
+# ---------------------------------------------------------------------------
+
+def _cluster_separation(y, labels):
+    """Ratio of mean inter-class to mean intra-class distance."""
+    intra, inter = [], []
+    for i in range(0, len(y), 7):
+        for j in range(i + 1, len(y), 7):
+            d = np.linalg.norm(y[i] - y[j])
+            (intra if labels[i] == labels[j] else inter).append(d)
+    return np.mean(inter) / np.mean(intra)
+
+
+def test_tsne_exact_separates_blobs():
+    x, labels = _blobs(n_per=40, d=10, seed=8)
+    ts = Tsne(perplexity=15.0, n_iter=600, seed=9)
+    y = ts.fit_transform(x)
+    assert y.shape == (120, 2)
+    assert np.all(np.isfinite(y))
+    assert _cluster_separation(y, labels) > 2.0
+
+
+def test_tsne_barnes_hut_separates_blobs():
+    x, labels = _blobs(n_per=30, d=6, seed=10)
+    ts = BarnesHutTsne(perplexity=10.0, theta=0.5, n_iter=350, seed=11)
+    y = ts.fit_transform(x)
+    assert y.shape == (90, 2)
+    assert np.all(np.isfinite(y))
+    assert _cluster_separation(y, labels) > 2.0
+
+
+def test_tsne_save_as_file(tmp_path):
+    x, labels = _blobs(n_per=10, seed=12)
+    ts = Tsne(perplexity=5.0, n_iter=50, seed=13)
+    ts.fit(x)
+    out = tmp_path / "tsne.csv"
+    ts.save_as_file([str(l) for l in labels], str(out))
+    lines = out.read_text().strip().split("\n")
+    assert len(lines) == 30
+    assert lines[0].count(",") == 2

@@ -219,6 +219,32 @@ def test_conv1d_shapes_and_training():
     assert net.score() < s0
 
 
+def test_subsampling1d_mask_aware_pooling():
+    """Padded timesteps must not leak into pooled outputs, and the mask
+    must propagate (MaskedReductionUtil semantics)."""
+    import jax.numpy as jnp
+    layer = Subsampling1DLayer(pooling_type="max", kernel=2, stride=2)
+    x = np.arange(24, dtype=np.float32).reshape(1, 12, 2) + 100.0
+    mask = np.ones((1, 12), np.float32)
+    mask[0, 6:] = 0.0  # only first 6 steps valid
+    y, _, out_mask = layer.forward({}, {}, jnp.asarray(x), train=False,
+                                   rng=None, mask=jnp.asarray(mask))
+    assert out_mask.shape == (1, 6)
+    assert np.allclose(np.asarray(out_mask), [[1, 1, 1, 0, 0, 0]])
+    # masked windows output exactly 0, not padding values
+    assert np.allclose(np.asarray(y)[0, 3:], 0.0)
+    assert np.asarray(y)[0, 0, 0] == 102.0  # max of steps 0,1 channel 0
+
+    # avg pooling divides by VALID count only
+    layer_avg = Subsampling1DLayer(pooling_type="avg", kernel=4, stride=4)
+    mask2 = np.ones((1, 12), np.float32)
+    mask2[0, 2:] = 0.0  # window 0 has 2 valid of 4
+    y2, _, om2 = layer_avg.forward({}, {}, jnp.asarray(x), train=False,
+                                   rng=None, mask=jnp.asarray(mask2))
+    expect = (x[0, 0, 0] + x[0, 1, 0]) / 2.0
+    assert np.isclose(np.asarray(y2)[0, 0, 0], expect)
+
+
 def test_conv1d_gradients():
     rng = np.random.default_rng(10)
     x = rng.normal(size=(3, 8, 2))
