@@ -20,6 +20,11 @@ are averaged (not summed) for stability.  All three weight tables are
 donated so XLA updates them in place.
 
 This module is the portable XLA path and the reference semantics.
+
+All gathers use mode="clip": placeholder tables (e.g. the 1-row syn1neg
+when negative sampling is off) are indexed by masked-out entries, and the
+default out-of-bounds fill is NaN, which survives multiplication by a
+zero mask (0·NaN = NaN) and poisons the whole update.
 """
 
 from __future__ import annotations
@@ -51,7 +56,7 @@ def _inv_row_counts(n_rows, idx, weight):
     counts = jnp.zeros((n_rows,), weight.dtype).at[idx].add(
         weight, mode="drop")
     inv = 1.0 / jnp.maximum(counts, 1.0)
-    return jnp.take(inv, idx, axis=0)
+    return jnp.take(inv, idx, axis=0, mode="clip")
 
 
 def _chunked(arr, chunk):
@@ -70,7 +75,7 @@ def _hs_ns_grads(l1, syn1, syn1neg, points, code_targets, code_mask,
     dt = l1.dtype
     neu1e = jnp.zeros_like(l1)
 
-    l2 = jnp.take(syn1, points, axis=0)                     # (B, C, D)
+    l2 = jnp.take(syn1, points, axis=0, mode="clip")                     # (B, C, D)
     f = _sigmoid_clipped(jnp.einsum("bd,bcd->bc", l1, l2))
     g = ((code_targets - f) * code_mask * alpha[:, None]).astype(dt)
     neu1e = neu1e + jnp.einsum("bc,bcd->bd", g, l2)
@@ -78,7 +83,7 @@ def _hs_ns_grads(l1, syn1, syn1neg, points, code_targets, code_mask,
     syn1 = syn1.at[points].add((g * inv1)[..., None] * l1[:, None, :],
                                mode="drop")
 
-    l2n = jnp.take(syn1neg, neg_idx, axis=0)                # (B, K, D)
+    l2n = jnp.take(syn1neg, neg_idx, axis=0, mode="clip")                # (B, K, D)
     fn = _sigmoid_clipped(jnp.einsum("bd,bkd->bk", l1, l2n))
     gn = ((neg_label - fn) * neg_mask * alpha[:, None]).astype(dt)
     neu1e = neu1e + jnp.einsum("bk,bkd->bd", gn, l2n)
@@ -112,7 +117,7 @@ def skipgram_step(syn0, syn1, syn1neg,
         syn0, syn1, syn1neg = carry
         ctx, pts, ct, cm, ni, nl, nm, al = xs
         dt = syn0.dtype
-        l1 = jnp.take(syn0, ctx, axis=0)
+        l1 = jnp.take(syn0, ctx, axis=0, mode="clip")
         valid = (al > 0).astype(jnp.float32)
         neu1e, syn1, syn1neg = _hs_ns_grads(
             l1, syn1, syn1neg, pts, ct, cm, ni, nl, nm, al)
@@ -144,7 +149,7 @@ def cbow_step(syn0, syn1, syn1neg,
         syn0, syn1, syn1neg = carry
         win, wm, pts, ct, cm, ni, nl, nm, al = xs
         dt = syn0.dtype
-        vecs = jnp.take(syn0, win, axis=0)                  # (b, W, D)
+        vecs = jnp.take(syn0, win, axis=0, mode="clip")                  # (b, W, D)
         counts = jnp.maximum(wm.sum(-1, keepdims=True), 1.0).astype(dt)
         l1 = (vecs * wm[..., None].astype(dt)).sum(1) / counts
         neu1e, syn1, syn1neg = _hs_ns_grads(
@@ -174,12 +179,12 @@ def infer_step(vec, syn1, syn1neg,
     vec: (B, D) — donated; one inference vector per row.
     """
     dt = vec.dtype
-    l2 = jnp.take(syn1, points, axis=0)
+    l2 = jnp.take(syn1, points, axis=0, mode="clip")
     f = _sigmoid_clipped(jnp.einsum("bd,bcd->bc", vec, l2))
     g = ((code_targets - f) * code_mask * alpha[:, None]).astype(dt)
     neu1e = jnp.einsum("bc,bcd->bd", g, l2)
 
-    l2n = jnp.take(syn1neg, neg_idx, axis=0)
+    l2n = jnp.take(syn1neg, neg_idx, axis=0, mode="clip")
     fn = _sigmoid_clipped(jnp.einsum("bd,bkd->bk", vec, l2n))
     gn = ((neg_label - fn) * neg_mask * alpha[:, None]).astype(dt)
     neu1e = neu1e + jnp.einsum("bk,bkd->bd", gn, l2n)

@@ -1,0 +1,16 @@
+"""Graph embeddings (ref: deeplearning4j-graph, ~3.4k LoC —
+graph/Graph.java, data/GraphLoader.java, iterator random walkers,
+models/deepwalk/DeepWalk.java + GraphHuffman.java).
+
+TPU-first: walks are generated host-side (pointer-chasing), then the
+embedding training rides the same fused skip-gram/HS XLA kernels as
+Word2Vec via the SequenceVectors engine — the reference's separate
+InMemoryGraphLookupTable+manual HS loop collapses into that engine.
+"""
+
+from deeplearning4j_tpu.graph.graph import Edge, Graph, Vertex  # noqa: F401
+from deeplearning4j_tpu.graph.loader import GraphLoader  # noqa: F401
+from deeplearning4j_tpu.graph.walkers import (  # noqa: F401
+    Node2VecWalker, RandomWalkIterator, WeightedRandomWalkIterator)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman  # noqa: F401
+from deeplearning4j_tpu.graph.serializer import GraphVectorSerializer  # noqa: F401

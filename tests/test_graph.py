@@ -1,0 +1,147 @@
+"""Graph module tests (modeled on the reference's TestDeepWalk.java,
+TestGraphHuffman.java, TestGraphLoading.java, random-walk tests)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphHuffman, GraphLoader, GraphVectorSerializer,
+    Node2VecWalker, RandomWalkIterator, WeightedRandomWalkIterator)
+from deeplearning4j_tpu.graph.walkers import NoEdgesError
+
+
+def _two_cliques(k=5):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, k)
+    return g
+
+
+def test_graph_construction_and_queries():
+    g = Graph(4)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2, weight=2.0)
+    g.add_edge(3, 0, directed=True)
+    assert g.num_vertices() == 4
+    assert g.get_vertex_degree(1) == 2      # undirected edges counted out
+    assert g.get_connected_vertices(1) == [0, 2]
+    assert g.get_connected_vertices(0) == [1]  # directed 3->0 not out of 0
+    assert g.get_connected_vertices(3) == [0]
+    assert g.get_vertex(2).idx == 2
+
+
+def test_graph_loader_edge_list(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0 1\n1 2\n2 3\n")
+    g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 4)
+    assert g.get_connected_vertices(1) == [0, 2]
+
+    w = tmp_path / "weighted.txt"
+    w.write_text("0,1,0.5\n1,2,2.0\n")
+    gw = GraphLoader.load_weighted_edge_list_file(str(w), 3, delim=",")
+    assert gw.get_edges_out(0)[0].weight == 0.5
+
+    a = tmp_path / "adj.txt"
+    a.write_text("0 1 2\n1 0\n2 0\n")
+    ga = GraphLoader.load_adjacency_list_file(str(a))
+    assert ga.num_vertices() == 3
+    assert set(ga.get_connected_vertices(0)) == {1, 2}
+
+
+def test_random_walk_properties():
+    g = _two_cliques(4)
+    walks = list(RandomWalkIterator(g, walk_length=10, seed=3))
+    assert len(walks) == g.num_vertices()
+    starts = sorted(w[0] for w in walks)
+    assert starts == list(range(g.num_vertices()))  # one walk per vertex
+    for w in walks:
+        assert len(w) == 10
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertices(a)
+
+
+def test_random_walk_no_edge_handling():
+    g = Graph(2)
+    g.add_edge(0, 1, directed=True)  # vertex 1 is a sink
+    walks = {w[0]: w for w in RandomWalkIterator(g, 4, seed=0,
+                                                 no_edge_handling="self_loop")}
+    assert walks[1] == [1, 1, 1, 1]
+    with pytest.raises(NoEdgesError):
+        list(RandomWalkIterator(g, 4, seed=0, no_edge_handling="exception"))
+
+
+def test_weighted_walk_follows_weights():
+    g = Graph(3, allow_multiple_edges=True)
+    g.add_edge(0, 1, weight=1000.0, directed=True)
+    g.add_edge(0, 2, weight=1e-9, directed=True)
+    g.add_edge(1, 0, directed=True)
+    g.add_edge(2, 0, directed=True)
+    visits = [w[1] for w in WeightedRandomWalkIterator(g, 2, seed=1)
+              if w[0] == 0]
+    # transitions from 0 overwhelmingly go to 1
+    seq = [w for w in WeightedRandomWalkIterator(g, 20, seed=2)][0]
+    trans = [b for a, b in zip(seq, seq[1:]) if a == 0]
+    assert trans.count(1) >= len(trans) - 1
+
+
+def test_node2vec_walker_valid_walks():
+    g = _two_cliques(4)
+    walks = list(Node2VecWalker(g, walk_length=8, p=0.5, q=2.0, seed=4))
+    assert len(walks) == 8
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertices(a)
+
+
+def test_graph_huffman_codes():
+    """(ref: TestGraphHuffman.java — codes are prefix-free, high-degree
+    vertices get short codes)"""
+    g = Graph(7)
+    # star: vertex 0 connected to everything, plus a chain
+    for i in range(1, 7):
+        g.add_edge(0, i)
+    g.add_edge(1, 2)
+    gh = GraphHuffman(g)
+    codes = ["".join(str(b) for b in gh.get_code(i)) for i in range(7)]
+    # prefix-free
+    for i, ci in enumerate(codes):
+        for j, cj in enumerate(codes):
+            if i != j:
+                assert not cj.startswith(ci)
+    # highest-degree vertex has the (joint-)shortest code
+    assert len(codes[0]) == min(len(c) for c in codes)
+    assert gh.get_code_length(0) == len(codes[0])
+    assert len(gh.get_path_inner_nodes(0)) == len(codes[0])
+
+
+def test_deepwalk_embeds_cliques_closer():
+    """(ref: TestDeepWalk.java — vertices in the same community end up
+    more similar than vertices across communities)"""
+    g = _two_cliques(6)
+    dw = (DeepWalk.Builder()
+          .vector_size(16).window_size(3).learning_rate(0.05)
+          .epochs(3).seed(5).build())
+    dw._walks_per_vertex = 10
+    dw.fit_graph(g, walk_length=20, seed=6)
+    assert dw.get_vertex_vector(0).shape == (16,)
+    intra = np.mean([dw.vertex_similarity(0, j) for j in range(1, 6)] +
+                    [dw.vertex_similarity(6, 6 + j) for j in range(1, 6)])
+    inter = np.mean([dw.vertex_similarity(i, 6 + j)
+                     for i in range(1, 6) for j in range(1, 6)])
+    assert intra > inter
+
+
+def test_deepwalk_custom_walker_and_serialization(tmp_path):
+    g = _two_cliques(4)
+    dw = (DeepWalk.Builder().vector_size(8).window_size(2)
+          .epochs(2).seed(7).build())
+    dw.fit_walker(Node2VecWalker(g, walk_length=12, p=0.5, q=2.0, seed=8), g)
+    path = tmp_path / "gv.txt"
+    GraphVectorSerializer.write_graph_vectors(dw, str(path))
+    loaded = GraphVectorSerializer.load_txt_vectors(str(path))
+    assert len(loaded) == 8
+    np.testing.assert_allclose(loaded[3], dw.get_vertex_vector(3), rtol=1e-5)
