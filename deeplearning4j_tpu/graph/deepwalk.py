@@ -84,6 +84,7 @@ class DeepWalk(SequenceVectors):
         def build(self) -> "DeepWalk":
             dw = DeepWalk(self.conf)
             dw.vocab = self._vocab
+            dw._sequence_source = self._source
             dw._walks_per_vertex = self._walks_per_vertex
             return dw
 

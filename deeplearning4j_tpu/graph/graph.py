@@ -35,6 +35,10 @@ class Graph:
     # ---- construction ----
     def add_edge(self, from_idx: int, to_idx: int, weight: float = 1.0,
                  directed: bool = False):
+        n = len(self._out)
+        if not (0 <= from_idx < n and 0 <= to_idx < n):
+            raise IndexError(
+                f"edge ({from_idx},{to_idx}) out of range for {n} vertices")
         e = Edge(from_idx, to_idx, weight, directed)
         if not self.allow_multiple_edges and any(
                 x.to_idx == to_idx for x in self._out[from_idx]):

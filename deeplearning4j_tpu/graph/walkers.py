@@ -98,7 +98,12 @@ class Node2VecWalker(RandomWalkIterator):
             for _ in range(self.walk_length - 1):
                 edges = g.get_edges_out(cur)
                 if not edges:
-                    cur = self._step(cur, int(start), rng)
+                    nxt = self._step(cur, int(start), rng)
+                    # the p/q bias is only meaningful relative to the true
+                    # predecessor; a restart jump has none, a self-loop's
+                    # predecessor is the dead-end vertex itself
+                    prev = -1 if nxt != cur else cur
+                    cur = nxt
                     walk.append(cur)
                     continue
                 w = np.array([e.weight for e in edges], np.float64)

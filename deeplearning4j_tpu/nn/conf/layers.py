@@ -610,6 +610,74 @@ class LastTimeStepLayer(Layer):
 
 
 # ==========================================================================
+# Attention (long-context extension — SURVEY.md §5: the reference's only
+# long-sequence mechanism is TBPTT; this layer plus parallel/sequence.py
+# adds exact ring / all-to-all sequence-parallel attention over the mesh).
+# ==========================================================================
+
+@register_layer
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over recurrent input [B, T, F].
+
+    The attention core dispatches through
+    ``parallel.sequence.attention``: dense on one device, ring /
+    all-to-all sequence-parallel when a mesh with a non-trivial 'seq'
+    axis is active (``parallel.sequence.sequence_mesh``).
+    """
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    causal: bool = False
+    strategy: str = "auto"      # auto | ring | ulysses | dense
+    project_output: bool = True
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.size
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out={self.n_out} % n_heads={self.n_heads}")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        params = {
+            "Wq": self._winit(kq, (n_in, self.n_out), dtype),
+            "Wk": self._winit(kk, (n_in, self.n_out), dtype),
+            "Wv": self._winit(kv, (n_in, self.n_out), dtype),
+            "bq": self._binit((self.n_out,), dtype),
+            "bk": self._binit((self.n_out,), dtype),
+            "bv": self._binit((self.n_out,), dtype),
+        }
+        if self.project_output:
+            params["Wo"] = self._winit(ko, (self.n_out, self.n_out), dtype)
+            params["bo"] = self._binit((self.n_out,), dtype)
+        return params, {}, InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        from deeplearning4j_tpu.parallel import sequence as seq_ops
+        x = self._maybe_dropout(x, train, rng)
+        B, T, _ = x.shape
+        H, Dh = self.n_heads, self.n_out // self.n_heads
+
+        def split(a):  # [B, T, n_out] -> [B, H, T, Dh]
+            return a.reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+
+        q = split(x @ params["Wq"] + params["bq"])
+        k = split(x @ params["Wk"] + params["bk"])
+        v = split(x @ params["Wv"] + params["bv"])
+        out = seq_ops.attention(q, k, v, causal=self.causal, key_mask=mask,
+                                strategy=self.strategy)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
+        if self.project_output:
+            out = out @ params["Wo"] + params["bo"]
+        out = self._act(out)
+        if mask is not None:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, state, mask
+
+    def output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+
+# ==========================================================================
 # Misc
 # ==========================================================================
 

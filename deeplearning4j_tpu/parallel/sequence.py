@@ -1,0 +1,229 @@
+"""Sequence/context parallelism — long-context attention over the mesh.
+
+The reference's only long-sequence mechanism is truncated BPTT
+(ref: nn/multilayer/MultiLayerNetwork.java:1227); it predates ring
+attention.  This module is the capability-parity *extension* SURVEY.md §5
+prescribes: shard the time dimension over the mesh's 'seq' axis and keep
+attention exact with ring / all-to-all communication over ICI.
+
+Two strategies, both exact (bitwise-comparable to dense attention up to
+float reassociation):
+
+* **Ring attention** (``ring_attention``): K/V blocks rotate around the
+  'seq' ring via ``lax.ppermute`` while each device streams them into a
+  numerically-stable online softmax (flash-attention accumulation:
+  running max / running sum / weighted accumulator).  Communication is
+  neighbor-to-neighbor → rides ICI links; memory is O(T_local) per chip,
+  so global context length scales linearly with the ring size.
+
+* **Ulysses / all-to-all** (``ulysses_attention``): ``lax.all_to_all``
+  re-shards [B, H, T/S, D] → [B, H/S, T, D] (heads scattered, sequence
+  gathered), runs ordinary dense attention per head group, and transposes
+  back.  Requires n_heads % seq_size == 0; two collectives instead of
+  S-1 permutes.
+
+Both run inside ``shard_map`` over just the attention core — projections
+and the rest of the network stay plain GSPMD ops, so XLA still fuses and
+partitions them automatically from the input shardings.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+# ---------------------------------------------------------------------------
+# Active-mesh context: layers query this to decide whether their attention
+# core should be sequence-parallel (the analog of the reference's implicit
+# "which device am I on" AffinityManager state, made explicit and scoped).
+_ACTIVE_MESH: Optional[Mesh] = None
+_SEQ_AXIS = "seq"
+
+
+@contextlib.contextmanager
+def sequence_mesh(mesh: Optional[Mesh]):
+    """Scope under which attention layers shard their time dimension over
+    the mesh's 'seq' axis (no-op if mesh is None or seq size is 1)."""
+    global _ACTIVE_MESH
+    prev = _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+    try:
+        yield mesh
+    finally:
+        _ACTIVE_MESH = prev
+
+
+def active_seq_size() -> int:
+    if _ACTIVE_MESH is None:
+        return 1
+    return int(_ACTIVE_MESH.shape.get(_SEQ_AXIS, 1))
+
+
+# ---------------------------------------------------------------------------
+# Dense reference core (single device / no 'seq' axis).
+
+
+def dense_attention(q, k, v, *, causal: bool = False, key_mask=None,
+                    scale: Optional[float] = None):
+    """Plain softmax attention.  q,k,v: [B, H, T, D]; key_mask: [B, Tk]
+    with 1=keep (the reference's feedForwardMaskArray convention,
+    ref: nn/api/Layer.java:309)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        qi = jnp.arange(Tq)[:, None]
+        ki = jnp.arange(Tk)[None, :]
+        scores = jnp.where(qi >= ki, scores, NEG_INF)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :].astype(bool),
+                           scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (per-shard body; run under shard_map over 'seq').
+
+
+def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
+                            causal: bool, scale: Optional[float]):
+    """Online-softmax ring scan.  Per-shard shapes: q,k,v [B, H, Tl, D],
+    key_mask [B, Tl] or None.  The device's global block index comes from
+    ``lax.axis_index`` so causal masking uses *global* positions."""
+    S = lax.axis_size(axis_name)
+    B, H, Tl, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    idx = lax.axis_index(axis_name)
+    q_pos = idx * Tl + jnp.arange(Tl)                      # global q positions
+
+    m = jnp.full((B, H, Tl), NEG_INF, q.dtype)             # running row max
+    l = jnp.zeros((B, H, Tl), q.dtype)                     # running denom
+    o = jnp.zeros((B, H, Tl, D), q.dtype)                  # weighted accum
+    if key_mask is None:
+        key_mask = jnp.ones((B, Tl), q.dtype)
+
+    # after s hops each device holds the block originally on (idx - s) % S
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def block_update(carry, kv_blk):
+        m, l, o = carry
+        k_blk, v_blk, mask_blk, src = kv_blk
+        k_pos = src * Tl + jnp.arange(Tl)                  # global k positions
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            scores = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                               scores, NEG_INF)
+        scores = jnp.where(mask_blk[:, None, None, :].astype(bool),
+                           scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # guard fully-masked rows: keep exp argument finite
+        alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        return (m_new, l, o)
+
+    carry = (m, l, o)
+    for s in range(S):
+        src = (idx - s) % S
+        carry = block_update(carry, (k, v, key_mask, src))
+        if s < S - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+            key_mask = lax.ppermute(key_mask, axis_name, perm)
+    m, l, o = carry
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, causal: bool = False,
+                   key_mask=None, scale: Optional[float] = None,
+                   axis_name: str = _SEQ_AXIS):
+    """shard_map-wrapped exact ring attention; q,k,v are full arrays whose
+    time dim is (to be) sharded over ``axis_name``."""
+    spec = P(None, None, axis_name, None)
+    mask_spec = P(None, axis_name)
+    if key_mask is None:
+        key_mask = jnp.ones((q.shape[0], q.shape[2]), q.dtype)
+    fn = shard_map(
+        partial(_ring_attention_sharded, axis_name=axis_name,
+                causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, mask_spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v, key_mask)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses (all-to-all) sequence parallelism.
+
+
+def _ulysses_sharded(q, k, v, key_mask, *, axis_name: str, causal: bool,
+                     scale: Optional[float]):
+    """Per-shard: [B, H, Tl, D] → all_to_all → [B, H/S, T, D] → dense
+    attention → all_to_all back."""
+    S = lax.axis_size(axis_name)
+    a2a = partial(lax.all_to_all, axis_name=axis_name, split_axis=1,
+                  concat_axis=2, tiled=True)
+    qg, kg, vg = a2a(q), a2a(k), a2a(v)                  # [B, H/S, T, D]
+    mask_g = lax.all_gather(key_mask, axis_name, axis=1, tiled=True)  # [B, T]
+    out = dense_attention(qg, kg, vg, causal=causal, key_mask=mask_g,
+                          scale=scale)
+    return lax.all_to_all(out, axis_name=axis_name, split_axis=2,
+                          concat_axis=1, tiled=True)     # [B, H, Tl, D]
+
+
+def ulysses_attention(q, k, v, *, mesh: Mesh, causal: bool = False,
+                      key_mask=None, scale: Optional[float] = None,
+                      axis_name: str = _SEQ_AXIS):
+    S = int(mesh.shape[axis_name])
+    if q.shape[1] % S:
+        raise ValueError(f"n_heads={q.shape[1]} not divisible by seq={S}")
+    spec = P(None, None, axis_name, None)
+    mask_spec = P(None, axis_name)
+    if key_mask is None:
+        key_mask = jnp.ones((q.shape[0], q.shape[2]), q.dtype)
+    fn = shard_map(
+        partial(_ulysses_sharded, axis_name=axis_name, causal=causal,
+                scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, mask_spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v, key_mask)
+
+
+# ---------------------------------------------------------------------------
+# Strategy dispatch used by SelfAttentionLayer.
+
+
+def attention(q, k, v, *, causal: bool = False, key_mask=None,
+              scale: Optional[float] = None, strategy: str = "auto"):
+    """Attention core that is sequence-parallel whenever a mesh with a
+    non-trivial 'seq' axis is active (see ``sequence_mesh``), dense
+    otherwise.  strategy: 'auto' | 'ring' | 'ulysses' | 'dense'."""
+    mesh = _ACTIVE_MESH
+    seq = active_seq_size()
+    if strategy == "dense" or seq == 1 or mesh is None:
+        return dense_attention(q, k, v, causal=causal, key_mask=key_mask,
+                               scale=scale)
+    if strategy == "ulysses":
+        # explicit request: let ulysses_attention raise on head/seq mismatch
+        return ulysses_attention(q, k, v, mesh=mesh, causal=causal,
+                                 key_mask=key_mask, scale=scale)
+    if strategy == "auto" and q.shape[1] % seq == 0 and seq <= 4:
+        return ulysses_attention(q, k, v, mesh=mesh, causal=causal,
+                                 key_mask=key_mask, scale=scale)
+    return ring_attention(q, k, v, mesh=mesh, causal=causal,
+                          key_mask=key_mask, scale=scale)
