@@ -26,12 +26,8 @@ class DataSet:
         return int(self.features.shape[0])
 
     def split_test_and_train(self, n_train: int):
-        return (DataSet(self.features[:n_train], self.labels[:n_train],
-                        None if self.features_mask is None else self.features_mask[:n_train],
-                        None if self.labels_mask is None else self.labels_mask[:n_train]),
-                DataSet(self.features[n_train:], self.labels[n_train:],
-                        None if self.features_mask is None else self.features_mask[n_train:],
-                        None if self.labels_mask is None else self.labels_mask[n_train:]))
+        return (self.get_range(0, n_train),
+                self.get_range(n_train, self.num_examples()))
 
     def shuffle(self, seed: Optional[int] = None) -> "DataSet":
         rng = np.random.default_rng(seed)
@@ -40,15 +36,17 @@ class DataSet:
                        None if self.features_mask is None else self.features_mask[idx],
                        None if self.labels_mask is None else self.labels_mask[idx])
 
+    def get_range(self, start: int, end: int) -> "DataSet":
+        sl = slice(start, end)
+        return DataSet(
+            self.features[sl], self.labels[sl],
+            None if self.features_mask is None else self.features_mask[sl],
+            None if self.labels_mask is None else self.labels_mask[sl])
+
     def batch_by(self, batch_size: int) -> List["DataSet"]:
-        out = []
-        for i in range(0, self.num_examples(), batch_size):
-            sl = slice(i, i + batch_size)
-            out.append(DataSet(
-                self.features[sl], self.labels[sl],
-                None if self.features_mask is None else self.features_mask[sl],
-                None if self.labels_mask is None else self.labels_mask[sl]))
-        return out
+        n = self.num_examples()
+        return [self.get_range(i, min(i + batch_size, n))
+                for i in range(0, n, batch_size)]
 
     @staticmethod
     def merge(datasets: Sequence["DataSet"]) -> "DataSet":

@@ -61,6 +61,18 @@ class Evaluation:
         p = np.argmax(predictions, axis=-1)
         np.add.at(self.confusion.matrix, (a, p), 1)
 
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Combine counts from another Evaluation (ref:
+        eval/Evaluation.java merge — the distributed-eval reduce)."""
+        if other.confusion is None:
+            return self
+        self._ensure(other.n_classes)
+        if other.n_classes != self.n_classes:
+            raise ValueError(
+                f"class-count mismatch: {self.n_classes} vs {other.n_classes}")
+        self.confusion.matrix += other.confusion.matrix
+        return self
+
     # ---- metrics ----
     def _tp(self):
         return np.diag(self.confusion.matrix).astype(np.float64)

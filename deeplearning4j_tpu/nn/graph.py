@@ -275,9 +275,19 @@ class ComputationGraph:
                 self._fit_batch(item)
         return self
 
+    def _check_trace_token(self):
+        """See MultiLayerNetwork._check_trace_token — retrace when the
+        ambient sequence-parallel regime changes."""
+        from deeplearning4j_tpu.parallel import sequence as seq_ops
+        tok = seq_ops.cache_token()
+        if tok != getattr(self, "_trace_token", None):
+            self._trace_token = tok
+            self._step_fn = self._score_fn = self._output_fn = None
+
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
             self.init()
+        self._check_trace_token()
         if self._step_fn is None:
             self._step_fn = self._build_step()
         self.last_batch_size = mds.num_examples()
@@ -311,6 +321,7 @@ class ComputationGraph:
         (ref: ComputationGraph feedForward/outputs)."""
         if self.net_params is None:
             self.init()
+        self._check_trace_token()
         if self._output_fn is None:
             def out_fn(params, state, xs):
                 ins = dict(zip(self.conf.network_inputs, xs))
@@ -328,6 +339,7 @@ class ComputationGraph:
             return float(self._score)
         if isinstance(data, DataSet):
             data = MultiDataSet([data.features], [data.labels])
+        self._check_trace_token()
         if self._score_fn is None:
             out_confs = self._output_layer_confs()
             out_pos = {n: self.conf.network_outputs.index(n) for n in out_confs}

@@ -180,6 +180,17 @@ class MultiLayerNetwork:
                     total = total + 0.5 * l2 * jnp.sum(v * v)
         return total
 
+    def _check_trace_token(self):
+        """Invalidate cached jitted functions when the ambient
+        sequence-parallel regime changed (parallel/sequence.sequence_mesh)
+        — the shard_map collectives are baked into the traced program, so
+        a cached step from another regime is silently wrong."""
+        from deeplearning4j_tpu.parallel import sequence as seq_ops
+        tok = seq_ops.cache_token()
+        if tok != getattr(self, "_trace_token", None):
+            self._trace_token = tok
+            self._step_fn = self._score_fn = self._output_fn = None
+
     # ------------------------------------------------------------------
     # The jitted train step — ONE XLA computation per step
     # ------------------------------------------------------------------
@@ -299,6 +310,7 @@ class MultiLayerNetwork:
         assert isinstance(data, DataSetIterator)
         if self.net_params is None:
             self.init()
+        self._check_trace_token()
         if self._step_fn is None:
             self._step_fn = self._build_step()
 
@@ -466,6 +478,7 @@ class MultiLayerNetwork:
         """(ref: MultiLayerNetwork.output :1668)"""
         if self.net_params is None:
             self.init()
+        self._check_trace_token()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
         return self._output_fn(self.net_params,
@@ -500,6 +513,7 @@ class MultiLayerNetwork:
         (ref: MultiLayerNetwork.score)."""
         if dataset is None:
             return float(self._score)
+        self._check_trace_token()
         if self._score_fn is None:
             self._score_fn = self._build_score_fn()
         return float(self._score_fn(self.net_params, self.net_state,

@@ -68,6 +68,16 @@ def active_seq_size() -> int:
     return int(_ACTIVE_MESH.shape.get(_SEQ_AXIS, 1))
 
 
+def cache_token():
+    """Identity of the active sequence-parallel regime.  Models key their
+    cached jitted step/score/output functions on this: entering or
+    leaving ``sequence_mesh`` (or switching meshes) must retrace, since
+    the collectives are baked into the traced program."""
+    if _ACTIVE_MESH is None or active_seq_size() == 1:
+        return None
+    return id(_ACTIVE_MESH)
+
+
 # ---------------------------------------------------------------------------
 # Dense reference core (single device / no 'seq' axis).
 
@@ -116,33 +126,32 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
     # after s hops each device holds the block originally on (idx - s) % S
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def block_update(carry, kv_blk):
-        m, l, o = carry
-        k_blk, v_blk, mask_blk, src = kv_blk
+    # lax.scan (not a Python loop) so the HLO stays O(1) in ring size —
+    # one block-update body compiled once, S trips; the extra ppermute on
+    # the last trip completes the cycle (blocks return to their owners).
+    def body(carry, s):
+        m, l, o, k, v, mask = carry
+        src = (idx - s) % S
         k_pos = src * Tl + jnp.arange(Tl)                  # global k positions
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
         if causal:
             scores = jnp.where(q_pos[:, None] >= k_pos[None, :],
                                scores, NEG_INF)
-        scores = jnp.where(mask_blk[:, None, None, :].astype(bool),
+        scores = jnp.where(mask[:, None, None, :].astype(bool),
                            scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         # guard fully-masked rows: keep exp argument finite
         alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
         p = jnp.exp(scores - m_new[..., None])
         l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
-        return (m_new, l, o)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        mask = lax.ppermute(mask, axis_name, perm)
+        return (m_new, l, o, k, v, mask), None
 
-    carry = (m, l, o)
-    for s in range(S):
-        src = (idx - s) % S
-        carry = block_update(carry, (k, v, key_mask, src))
-        if s < S - 1:
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
-            key_mask = lax.ppermute(key_mask, axis_name, perm)
-    m, l, o = carry
+    (m, l, o, _, _, _), _ = lax.scan(
+        body, (m, l, o, k, v, key_mask), jnp.arange(S))
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
@@ -213,11 +222,18 @@ def attention(q, k, v, *, causal: bool = False, key_mask=None,
     """Attention core that is sequence-parallel whenever a mesh with a
     non-trivial 'seq' axis is active (see ``sequence_mesh``), dense
     otherwise.  strategy: 'auto' | 'ring' | 'ulysses' | 'dense'."""
+    if strategy not in ("auto", "ring", "ulysses", "dense"):
+        raise ValueError(f"unknown attention strategy {strategy!r} "
+                         "(expected auto|ring|ulysses|dense)")
     mesh = _ACTIVE_MESH
     seq = active_seq_size()
     if strategy == "dense" or seq == 1 or mesh is None:
         return dense_attention(q, k, v, causal=causal, key_mask=key_mask,
                                scale=scale)
+    if q.shape[2] % seq:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by the mesh 'seq' "
+            f"axis ({seq}); pad/bucket the time dimension to a multiple")
     if strategy == "ulysses":
         # explicit request: let ulysses_attention raise on head/seq mismatch
         return ulysses_attention(q, k, v, mesh=mesh, causal=causal,

@@ -1,0 +1,32 @@
+"""Cluster-scale training — the reference's `deeplearning4j-scaleout/spark`
+tier rebuilt TPU-natively (SURVEY.md §2.6).
+
+The reference distributes by shipping (conf, params, updater-state) to
+Spark executors, fitting locally per partition, and tree-aggregating the
+resulting parameters back to the driver
+(ref: spark/impl/paramavg/ParameterAveragingTrainingMaster.java).  Here
+the same TrainingMaster SPI exists, but the unit of distribution is a
+*host process driving a TPU slice*: workers run the jitted train step,
+and the aggregation is either host-staged tree averaging (reference
+parity, works across any transport) or — the recommended path — one
+`psum` over the mesh inside the compiled step (parallel/ParallelWrapper),
+with DCN-spanning meshes via `jax.distributed` for pod scale
+(scaleout.multislice)."""
+
+from deeplearning4j_tpu.scaleout.training_master import (
+    NetBroadcastTuple, TrainingHook, TrainingMaster, TrainingWorker,
+    WorkerConfiguration)
+from deeplearning4j_tpu.scaleout.param_averaging import (
+    ParameterAveragingTrainingMaster)
+from deeplearning4j_tpu.scaleout.frontends import (
+    ClusterComputationGraph, ClusterDl4jMultiLayer)
+from deeplearning4j_tpu.scaleout.stats import TrainingStats
+from deeplearning4j_tpu.scaleout.time_source import (
+    NTPTimeSource, SystemClockTimeSource, TimeSourceProvider)
+
+__all__ = [
+    "NetBroadcastTuple", "TrainingHook", "TrainingMaster", "TrainingWorker",
+    "WorkerConfiguration", "ParameterAveragingTrainingMaster",
+    "ClusterComputationGraph", "ClusterDl4jMultiLayer", "TrainingStats",
+    "NTPTimeSource", "SystemClockTimeSource", "TimeSourceProvider",
+]

@@ -1,0 +1,115 @@
+"""Distributed data plumbing
+(ref: dl4j-spark/.../spark/data/ — BatchAndExportDataSetsFunction,
+DataSetExportFunction, PathSparkDataSetIterator; spark/util/SparkUtils
+repartitioning; spark/iterator/PortableDataStreamDataSetIterator).
+
+The reference persists RDD<DataSet> partitions to distributed storage
+and re-reads them by path on executors.  Here DataSets export to ``.npz``
+files (features/labels/masks) and stream back through a path-backed
+iterator — the same decoupling of ETL from training, feeding the async
+device-prefetch pipeline."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def export_dataset(ds: DataSet, path: Union[str, Path]) -> None:
+    """(ref: spark/data/DataSetExportFunction.java)"""
+    arrays = {"features": ds.features, "labels": ds.labels}
+    if ds.features_mask is not None:
+        arrays["features_mask"] = ds.features_mask
+    if ds.labels_mask is not None:
+        arrays["labels_mask"] = ds.labels_mask
+    np.savez(path, **arrays)
+
+
+def load_dataset(path: Union[str, Path]) -> DataSet:
+    with np.load(path) as z:
+        return DataSet(z["features"], z["labels"],
+                       z["features_mask"] if "features_mask" in z else None,
+                       z["labels_mask"] if "labels_mask" in z else None)
+
+
+def batch_and_export(datasets: Iterable[DataSet], out_dir: Union[str, Path],
+                     batch_size: int) -> List[str]:
+    """Rebatch to exactly ``batch_size`` then export each minibatch
+    (ref: spark/data/BatchAndExportDataSetsFunction.java — used to fix up
+    partition batch sizes before training)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    paths: List[str] = []
+    buf: List[DataSet] = []
+    count = 0
+
+    def flush(final: bool) -> None:
+        nonlocal buf, count
+        if not buf:
+            return
+        merged = DataSet.merge(buf)
+        buf = []
+        full = merged.num_examples() // batch_size * batch_size
+        for b in merged.get_range(0, full).batch_by(batch_size):
+            p = out_dir / f"dataset_{count}.npz"
+            export_dataset(b, p)
+            paths.append(str(p))
+            count += 1
+        rest = merged.get_range(full, merged.num_examples())
+        if rest.num_examples():
+            if final:
+                p = out_dir / f"dataset_{count}.npz"
+                export_dataset(rest, p)
+                paths.append(str(p))
+                count += 1
+            else:
+                buf = [rest]
+
+    for ds in datasets:
+        buf.append(ds)
+        if sum(d.num_examples() for d in buf) >= batch_size:
+            flush(final=False)
+    flush(final=True)
+    return paths
+
+
+class PathDataSetIterator(DataSetIterator):
+    """Streams DataSets from exported files
+    (ref: spark/iterator/PathSparkDataSetIterator.java)."""
+
+    def __init__(self, paths: Sequence[Union[str, Path]]):
+        self.paths = [str(p) for p in paths]
+        self._i = 0
+
+    @staticmethod
+    def from_dir(directory: Union[str, Path]) -> "PathDataSetIterator":
+        files = sorted(Path(directory).glob("*.npz"),
+                       key=lambda p: (len(p.name), p.name))
+        return PathDataSetIterator(files)
+
+    def has_next(self) -> bool:
+        return self._i < len(self.paths)
+
+    def next(self) -> DataSet:
+        ds = load_dataset(self.paths[self._i])
+        self._i += 1
+        return ds
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+def repartition_balanced(items: Sequence, n_partitions: int) -> List[List]:
+    """Equal-count round-robin split
+    (ref: spark/util/SparkUtils.repartitionBalanceIfRequired,
+    spark/impl/common/repartition/BalancedPartitioner.java)."""
+    parts: List[List] = [[] for _ in range(n_partitions)]
+    for i, x in enumerate(items):
+        parts[i % n_partitions].append(x)
+    return parts

@@ -1,0 +1,78 @@
+"""Early stopping on the cluster tier
+(ref: dl4j-spark/.../spark/earlystopping/{SparkEarlyStoppingTrainer,
+SparkDataSetLossCalculator,SparkLossCalculatorComputationGraph}.java).
+
+One epoch = one ``TrainingMaster.execute_training`` pass over the data;
+the score calculator fans the loss out over partitions like the
+reference's RDD score functions."""
+
+from __future__ import annotations
+
+import math
+
+from deeplearning4j_tpu.nn.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingResult)
+
+
+class ClusterDataSetLossCalculator:
+    """(ref: spark/earlystopping/SparkDataSetLossCalculator.java)"""
+
+    def __init__(self, front_end, data, average: bool = True):
+        self.front_end = front_end
+        self.data = data
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        # front_end.network IS the driver model being trained
+        return self.front_end.calculate_score(self.data, average=self.average)
+
+
+class ClusterEarlyStoppingTrainer:
+    """(ref: spark/earlystopping/BaseSparkEarlyStoppingTrainer.java)"""
+
+    def __init__(self, config: EarlyStoppingConfiguration, front_end,
+                 train_data):
+        self.config = config
+        self.front_end = front_end
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        net = self.front_end.network
+        best_score, best_epoch = math.inf, -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        while True:
+            self.front_end.fit(self.train_data)
+            s = float(net.score())
+            terminated = False
+            for cond in cfg.iteration_termination_conditions:
+                if cond.terminate(net.iteration, s):
+                    reason, details = "IterationTerminationCondition", repr(cond)
+                    terminated = True
+            if terminated:
+                break
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(net)
+                score_vs_epoch[epoch] = score
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best(net)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest(net)
+                stop = False
+                for cond in cfg.epoch_termination_conditions:
+                    if cond.terminate(epoch, score):
+                        reason, details = ("EpochTerminationCondition",
+                                           repr(cond))
+                        stop = True
+                if stop:
+                    break
+            epoch += 1
+        best = cfg.model_saver.get_best()
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            total_epochs=epoch + 1, best_model_epoch=best_epoch,
+            best_model_score=best_score, score_vs_epoch=score_vs_epoch,
+            best_model=best if best is not None else net)

@@ -1,0 +1,117 @@
+"""Cluster front-ends wrapping MultiLayerNetwork / ComputationGraph
+(ref: spark/impl/multilayer/SparkDl4jMultiLayer.java:202-282,
+spark/impl/graph/SparkComputationGraph.java).
+
+``fit`` delegates to the TrainingMaster (ref: SparkDl4jMultiLayer.fit
+:212-216 → trainingMaster.executeTraining); ``evaluate``/
+``calculate_score`` fan out over worker partitions and merge —
+the reference's distributed-eval path
+(ref: spark/impl/multilayer/evaluation/, spark/impl/common/score/)."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.scaleout.training_master import TrainingMaster
+
+
+class _BaseClusterFrontEnd:
+    is_graph = False
+
+    def __init__(self, network, training_master: TrainingMaster):
+        self.network = network
+        self.training_master = training_master
+
+    # -- training -----------------------------------------------------------
+    def fit(self, data, epochs: int = 1):
+        for _ in range(epochs):
+            self.training_master.execute_training(self, data)
+        return self.network
+
+    # -- distributed eval / scoring ----------------------------------------
+    def _partitions(self, data, batch: int) -> List[DataSet]:
+        if isinstance(data, DataSet):
+            return data.batch_by(batch)
+        if hasattr(data, "has_next"):
+            data.reset()
+            out = []
+            while data.has_next():
+                out.append(data.next())
+            return out
+        return list(data)
+
+    def calculate_score(self, data, average: bool = True,
+                        batch: int = 64) -> float:
+        """(ref: SparkDl4jMultiLayer.calculateScore — sum/avg of per-
+        example scores across the RDD)"""
+        parts = self._partitions(data, batch)
+        n_workers = getattr(self.training_master, "num_workers", 4)
+
+        def score_part(ds):
+            return float(self.network.score(ds)) * ds.num_examples()
+
+        with ThreadPoolExecutor(max_workers=n_workers) as ex:
+            totals = list(ex.map(score_part, parts))
+        n = sum(p.num_examples() for p in parts)
+        s = sum(totals)
+        return s / n if average and n else s
+
+    def evaluate(self, data, batch: int = 64):
+        """Distributed evaluation: per-partition Evaluations merged
+        (ref: spark/impl/multilayer/evaluation/EvaluationRunner)."""
+        from deeplearning4j_tpu.nn.evaluation import Evaluation
+        parts = self._partitions(data, batch)
+        n_workers = getattr(self.training_master, "num_workers", 4)
+
+        def eval_part(ds):
+            ev = Evaluation()
+            out = np.asarray(self.network.output(ds.features))
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+            return ev
+
+        with ThreadPoolExecutor(max_workers=n_workers) as ex:
+            evals = list(ex.map(eval_part, parts))
+        merged = Evaluation()
+        for ev in evals:
+            merged.merge(ev)
+        return merged
+
+    # -- stats passthrough --------------------------------------------------
+    def get_training_stats(self):
+        return getattr(self.training_master, "stats", None)
+
+
+class ClusterDl4jMultiLayer(_BaseClusterFrontEnd):
+    """(ref: spark/impl/multilayer/SparkDl4jMultiLayer.java)"""
+
+    is_graph = False
+
+    def __init__(self, conf_or_net, training_master: TrainingMaster):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if isinstance(conf_or_net, MultiLayerNetwork):
+            net = conf_or_net
+        else:
+            net = MultiLayerNetwork(conf_or_net)
+        if net.net_params is None:
+            net.init()
+        super().__init__(net, training_master)
+
+
+class ClusterComputationGraph(_BaseClusterFrontEnd):
+    """(ref: spark/impl/graph/SparkComputationGraph.java)"""
+
+    is_graph = True
+
+    def __init__(self, conf_or_net, training_master: TrainingMaster):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        if isinstance(conf_or_net, ComputationGraph):
+            net = conf_or_net
+        else:
+            net = ComputationGraph(conf_or_net)
+        if net.net_params is None:
+            net.init()
+        super().__init__(net, training_master)

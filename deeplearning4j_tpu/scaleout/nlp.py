@@ -1,0 +1,123 @@
+"""Distributed NLP: parallel vocab construction + cluster Word2Vec
+(ref: dl4j-spark-nlp/.../spark/text/functions/TextPipeline.java — map
+sentences → tokens → per-partition word counts → reduce; spark/models/
+embeddings/word2vec/Word2Vec.java; dl4j-spark-nlp-java8/.../SparkWord2Vec.java).
+
+The reference counts words with Spark accumulators across partitions and
+then trains with its parameter-averaging loop.  Here the corpus is
+partitioned across a worker pool for counting (the TextPipeline role),
+the vocab/Huffman build is shared, and training runs through the fused
+XLA skip-gram kernels — batched device steps replace the reference's
+per-executor Aggregate ops."""
+
+from __future__ import annotations
+
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable, List, Optional
+
+from deeplearning4j_tpu.scaleout.data import repartition_balanced
+from deeplearning4j_tpu.text.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+
+
+class TextPipeline:
+    """Distributed token counting (ref: spark/text/functions/
+    TextPipeline.java — buildVocabCache: tokenize, filter stopwords,
+    accumulate counts, filter minWordFrequency)."""
+
+    def __init__(self, sentences: Iterable[str],
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 stop_words: Optional[Iterable[str]] = None,
+                 min_word_frequency: int = 1,
+                 num_partitions: int = 4):
+        self.sentences = list(sentences)
+        self.tf = tokenizer_factory or DefaultTokenizerFactory()
+        self.stop_words = set(stop_words or [])
+        self.min_word_frequency = min_word_frequency
+        self.num_partitions = num_partitions
+
+    def _count_partition(self, part: List[str]) -> Counter:
+        c: Counter = Counter()
+        for sentence in part:
+            for tok in self.tf.create(sentence).get_tokens():
+                if tok and tok not in self.stop_words:
+                    c[tok] += 1
+        return c
+
+    def build_word_counts(self) -> Counter:
+        parts = repartition_balanced(self.sentences, self.num_partitions)
+        with ThreadPoolExecutor(max_workers=self.num_partitions) as ex:
+            counters = list(ex.map(self._count_partition, parts))
+        total: Counter = Counter()
+        for c in counters:
+            total.update(c)
+        return total
+
+    def build_vocab_cache(self):
+        """→ AbstractCache with Huffman codes, ready for training."""
+        from deeplearning4j_tpu.text.sequence import SequenceElement
+        from deeplearning4j_tpu.text.vocab import AbstractCache, Huffman
+        counts = self.build_word_counts()
+        cache = AbstractCache()
+        for word, n in counts.items():
+            if n >= self.min_word_frequency:
+                cache.add_token(SequenceElement(word, frequency=float(n)))
+        cache.build_index()
+        Huffman(cache.vocab_words()).build()
+        return cache
+
+
+class ClusterWord2Vec:
+    """Word2Vec with distributed vocab build
+    (ref: spark/models/embeddings/word2vec/Word2Vec.java — the Spark
+    front-end wraps the same training core behind an RDD<String> input)."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 min_word_frequency: int = 1, negative: int = 5,
+                 use_hierarchic_softmax: bool = True, seed: int = 42,
+                 num_partitions: int = 4, iterations: int = 1,
+                 learning_rate: float = 0.025,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 stop_words: Optional[Iterable[str]] = None):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.use_hierarchic_softmax = use_hierarchic_softmax
+        self.seed = seed
+        self.num_partitions = num_partitions
+        self.iterations = iterations
+        self.learning_rate = learning_rate
+        self.tokenizer_factory = tokenizer_factory
+        self.stop_words = stop_words
+        self.model = None
+
+    def fit(self, sentences: Iterable[str]):
+        from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+        from deeplearning4j_tpu.text.sentence_iterators import (
+            CollectionSentenceIterator)
+        sentences = list(sentences)
+        pipeline = TextPipeline(
+            sentences, self.tokenizer_factory, self.stop_words,
+            self.min_word_frequency, self.num_partitions)
+        vocab = pipeline.build_vocab_cache()
+        builder = (Word2Vec.Builder()
+                   .iterate(CollectionSentenceIterator(sentences)))
+        builder.conf.layer_size = self.layer_size
+        builder.conf.window = self.window
+        builder.conf.min_word_frequency = self.min_word_frequency
+        builder.conf.negative = self.negative
+        builder.conf.use_hierarchic_softmax = self.use_hierarchic_softmax
+        builder.conf.seed = self.seed
+        builder.conf.iterations = self.iterations
+        builder.conf.learning_rate = self.learning_rate
+        if self.tokenizer_factory is not None:
+            builder.tokenizer_factory(self.tokenizer_factory)
+        if self.stop_words:
+            builder.stop_words(self.stop_words)
+        w2v = builder.build()
+        w2v.vocab = vocab  # pre-built distributed vocab
+        w2v.fit()
+        self.model = w2v
+        return w2v

@@ -1,0 +1,194 @@
+"""Asynchronous parameter server
+(ref: deeplearning4j-scaleout-parallelwrapper-parameter-server —
+parallelism/parameterserver/ParameterServerTrainer.java:15,33-74,
+ParameterServerTrainerContext.java; external nd4j-parameter-server with
+its Aeron UDP transport).
+
+The reference's third communication tier: workers train local replicas
+and asynchronously push updates to / pull parameters from a server node
+over UDP.  Rebuilt here as a length-prefixed TCP protocol (no Aeron in
+this image; the update semantics, not the wire library, are the
+capability).  Server-side accumulation is additive — workers push
+*deltas* (new − pulled), the Hogwild-style async-SGD scheme the
+parameter-averaging literature calls "asynchronous update push".
+
+On-mesh training should prefer the per-step psum path
+(parallel/ParallelWrapper); this tier exists for asynchronous,
+loosely-coupled workers — e.g. hosts feeding independent TPU slices
+without a shared mesh.
+
+Wire format: 1-byte op ('P' push, 'G' get, 'Q' quit) + u32 little-endian
+payload length + float32 array bytes.  Responses: u32 length + payload.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+_HDR = struct.Struct("<cI")
+_LEN = struct.Struct("<I")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class ParameterServerNode:
+    """Server holding the canonical flat parameter vector
+    (ref: external nd4j ParameterServerNode consumed at
+    ParameterServerTrainer.java:15)."""
+
+    def __init__(self, initial_params: np.ndarray, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.params = np.array(initial_params, np.float32, copy=True)
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(32)
+        self.host, self.port = self._srv.getsockname()
+        self.updates_received = 0
+        self._running = True
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- server loop --------------------------------------------------------
+    def _serve(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                op, n = _HDR.unpack(hdr)
+                if op == b"P":  # push delta
+                    payload = _recv_exact(conn, n)
+                    delta = np.frombuffer(payload, np.float32)
+                    with self._lock:
+                        if delta.shape != self.params.shape:
+                            conn.sendall(_LEN.pack(0))
+                            continue
+                        self.params += delta
+                        self.updates_received += 1
+                    conn.sendall(_LEN.pack(1))
+                elif op == b"G":  # pull
+                    with self._lock:
+                        payload = self.params.tobytes()
+                    conn.sendall(_LEN.pack(len(payload)) + payload)
+                elif op == b"Q":
+                    break
+                else:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self) -> None:
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class ParameterServerClient:
+    """(ref: org.nd4j.parameterserver.client.ParameterServerClient —
+    pushNDArray / getArray surface)"""
+
+    def __init__(self, host: str, port: int):
+        self._sock = socket.create_connection((host, port))
+        self._lock = threading.Lock()
+
+    def push_nd_array(self, delta: np.ndarray) -> bool:
+        payload = np.ascontiguousarray(delta, np.float32).tobytes()
+        with self._lock:
+            self._sock.sendall(_HDR.pack(b"P", len(payload)))
+            self._sock.sendall(payload)
+            (ok,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+        return bool(ok)
+
+    def get_nd_array(self) -> np.ndarray:
+        with self._lock:
+            self._sock.sendall(_HDR.pack(b"G", 0))
+            (n,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+            payload = _recv_exact(self._sock, n)
+        return np.frombuffer(payload, np.float32).copy()
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(_HDR.pack(b"Q", 0))
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class ParameterServerTrainer:
+    """Async-SGD trainer: N workers pull → local fit → push delta
+    (ref: parallelism/parameterserver/ParameterServerTrainer.java:33-74 —
+    feedDataSet trains then pushes/pulls through the client)."""
+
+    def __init__(self, model, num_workers: int = 2,
+                 node: Optional[ParameterServerNode] = None):
+        if model.net_params is None:
+            model.init()
+        self.model = model
+        self.num_workers = num_workers
+        self._own_node = node is None
+        self.node = node or ParameterServerNode(np.asarray(model.params()))
+
+    def fit(self, iterator, epochs: int = 1):
+        conf_json = self.model.conf.to_json()
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        # collect batches, round-robin to workers
+        batches = []
+        for _ in range(epochs):
+            iterator.reset()
+            while iterator.has_next():
+                batches.append(iterator.next())
+        parts: List[List] = [[] for _ in range(self.num_workers)]
+        for i, b in enumerate(batches):
+            parts[i % self.num_workers].append(b)
+
+        def worker(part):
+            if not part:
+                return
+            client = ParameterServerClient(self.node.host, self.node.port)
+            net = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(conf_json)).init()
+            try:
+                for ds in part:
+                    pulled = client.get_nd_array()
+                    net.set_params(pulled)
+                    net.fit(ds)
+                    delta = np.asarray(net.params()) - pulled
+                    client.push_nd_array(delta)
+            finally:
+                client.close()
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as ex:
+            list(ex.map(worker, parts))
+        self.model.set_params(self.node.params)
+        if self._own_node:
+            self.node.shutdown()
+        return self.model

@@ -1,0 +1,121 @@
+"""Distributed-training phase instrumentation
+(ref: dl4j-spark/.../spark/api/stats/CommonSparkTrainingStats.java,
+StatsCalculationHelper.java, spark/stats/StatsUtils.java:exportStatsAsHtml,
+spark/impl/paramavg/stats/ParameterAveragingTrainingMasterStats.java).
+
+Every phase of a distributed run (split, broadcast, worker fit,
+aggregate, apply) records an ``EventStats`` with wall times from the
+configured TimeSource; ``export_stats_html`` renders the same timeline
+view the reference produces."""
+
+from __future__ import annotations
+
+import dataclasses
+import html
+import json
+from collections import defaultdict
+from typing import Dict, List
+
+from deeplearning4j_tpu.scaleout.time_source import TimeSourceProvider
+
+
+@dataclasses.dataclass
+class EventStats:
+    """(ref: spark/stats/BaseEventStats.java)"""
+
+    phase: str
+    start_ms: int
+    duration_ms: float
+    worker_id: str = "driver"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class TrainingStats:
+    """Accumulates per-phase events; the analog of
+    ParameterAveragingTrainingMasterStats + CommonSparkTrainingStats."""
+
+    def __init__(self):
+        self.events: List[EventStats] = []
+        self._ts = TimeSourceProvider.get_instance()
+
+    # -- StatsCalculationHelper-style timers --------------------------------
+    class _Timer:
+        def __init__(self, owner: "TrainingStats", phase: str, worker_id: str):
+            self.owner, self.phase, self.worker_id = owner, phase, worker_id
+
+        def __enter__(self):
+            self.start = self.owner._ts.current_time_millis()
+            return self
+
+        def __exit__(self, *exc):
+            end = self.owner._ts.current_time_millis()
+            self.owner.events.append(EventStats(
+                self.phase, self.start, end - self.start, self.worker_id))
+            return False
+
+    def time(self, phase: str, worker_id: str = "driver") -> "_Timer":
+        return TrainingStats._Timer(self, phase, worker_id)
+
+    def add(self, phase: str, start_ms: int, duration_ms: float,
+            worker_id: str = "driver") -> None:
+        self.events.append(EventStats(phase, start_ms, duration_ms, worker_id))
+
+    # -- aggregation --------------------------------------------------------
+    def phase_totals_ms(self) -> Dict[str, float]:
+        totals: Dict[str, float] = defaultdict(float)
+        for e in self.events:
+            totals[e.phase] += e.duration_ms
+        return dict(totals)
+
+    def to_json(self) -> str:
+        return json.dumps([e.to_dict() for e in self.events])
+
+    # -- HTML timeline (ref: StatsUtils.exportStatsAsHtml) ------------------
+    def export_stats_html(self, path: str) -> None:
+        if not self.events:
+            body = "<p>no events recorded</p>"
+        else:
+            t0 = min(e.start_ms for e in self.events)
+            t1 = max(e.start_ms + e.duration_ms for e in self.events)
+            span = max(t1 - t0, 1.0)
+            phases = sorted({e.phase for e in self.events})
+            colors = ["#4C78A8", "#F58518", "#54A24B", "#E45756", "#72B7B2",
+                      "#B279A2", "#FF9DA6", "#9D755D"]
+            color = {p: colors[i % len(colors)] for i, p in enumerate(phases)}
+            lanes = sorted({e.worker_id for e in self.events})
+            rows = []
+            for lane in lanes:
+                bars = []
+                for e in self.events:
+                    if e.worker_id != lane:
+                        continue
+                    left = 100.0 * (e.start_ms - t0) / span
+                    width = max(100.0 * e.duration_ms / span, 0.15)
+                    bars.append(
+                        f'<div class="bar" title="{html.escape(e.phase)}: '
+                        f'{e.duration_ms:.1f} ms" style="left:{left:.2f}%;'
+                        f'width:{width:.2f}%;background:{color[e.phase]}">'
+                        f'</div>')
+                rows.append(f'<div class="lane"><span class="label">'
+                            f'{html.escape(lane)}</span>{"".join(bars)}</div>')
+            legend = "".join(
+                f'<span class="key"><i style="background:{color[p]}"></i>'
+                f'{html.escape(p)} ({self.phase_totals_ms()[p]:.0f} ms)</span>'
+                for p in phases)
+            body = (f'<div class="legend">{legend}</div>'
+                    f'<div class="timeline">{"".join(rows)}</div>')
+        doc = f"""<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>Training stats timeline</title><style>
+body{{font-family:sans-serif;margin:20px}}
+.lane{{position:relative;height:26px;margin:3px 0;background:#f2f2f2}}
+.lane .label{{position:absolute;left:4px;top:4px;font-size:11px;z-index:2}}
+.bar{{position:absolute;top:2px;height:22px;opacity:.85}}
+.legend{{margin-bottom:12px}}
+.key{{margin-right:14px;font-size:12px}}
+.key i{{display:inline-block;width:10px;height:10px;margin-right:4px}}
+</style></head><body><h2>Distributed training timeline</h2>{body}
+</body></html>"""
+        with open(path, "w") as f:
+            f.write(doc)

@@ -1,0 +1,92 @@
+"""Cross-node time sources for training stats
+(ref: dl4j-spark/.../spark/time/{TimeSource,NTPTimeSource,
+SystemClockTimeSource,TimeSourceProvider}.java).
+
+The reference disciplines executor clocks against NTP so distributed
+stats timelines line up (ref: NTPTimeSource.java:28, sysprops :31-32).
+This environment has zero egress, so NTPTimeSource degrades to a zero
+offset with a recorded reason rather than failing."""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+class TimeSource:
+    def current_time_millis(self) -> int:
+        raise NotImplementedError
+
+
+class SystemClockTimeSource(TimeSource):
+    """(ref: spark/time/SystemClockTimeSource.java)"""
+
+    def current_time_millis(self) -> int:
+        return int(time.time() * 1000)
+
+
+class NTPTimeSource(TimeSource):
+    """NTP-disciplined clock (ref: spark/time/NTPTimeSource.java).
+
+    Queries the server named by DL4J_NTP_SERVER (reference sysprop
+    ``org.deeplearning4j.spark.time.NTPTimeSource.server``) at
+    construction and every ``update_frequency_ms``; on any failure the
+    offset stays at its last value (0 initially) — training never blocks
+    on the clock."""
+
+    DEFAULT_SERVER = "0.pool.ntp.org"
+
+    def __init__(self, server: str | None = None,
+                 update_frequency_ms: int = 30 * 60 * 1000):
+        self.server = server or os.environ.get("DL4J_NTP_SERVER",
+                                               self.DEFAULT_SERVER)
+        self.update_frequency_ms = update_frequency_ms
+        self.offset_ms = 0
+        self.last_error: str | None = None
+        self._last_sync = 0.0
+        self._sync()
+
+    def _sync(self) -> None:
+        self._last_sync = time.time()
+        try:
+            self.offset_ms = self._query_offset()
+            self.last_error = None
+        except Exception as e:  # zero-egress / DNS failure path
+            self.last_error = f"{type(e).__name__}: {e}"
+
+    def _query_offset(self) -> int:
+        import socket
+        import struct
+        # SNTP: 48-byte packet, LI=0 VN=3 mode=3
+        pkt = b"\x1b" + 47 * b"\0"
+        t0 = time.time()
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(2.0)
+            s.sendto(pkt, (self.server, 123))
+            data, _ = s.recvfrom(48)
+        t3 = time.time()
+        NTP_EPOCH_DELTA = 2208988800
+        secs, frac = struct.unpack("!II", data[40:48])
+        server_time = secs - NTP_EPOCH_DELTA + frac / 2 ** 32
+        return int(((server_time - (t0 + t3) / 2)) * 1000)
+
+    def current_time_millis(self) -> int:
+        if (time.time() - self._last_sync) * 1000 > self.update_frequency_ms:
+            self._sync()
+        return int(time.time() * 1000) + self.offset_ms
+
+
+class TimeSourceProvider:
+    """(ref: spark/time/TimeSourceProvider.java) — class chosen by the
+    DL4J_TIMESOURCE env var; defaults to the system clock (the reference
+    defaults to NTP, but with no egress that would always degrade)."""
+
+    _instance: TimeSource | None = None
+
+    @classmethod
+    def get_instance(cls) -> TimeSource:
+        if cls._instance is None:
+            name = os.environ.get("DL4J_TIMESOURCE", "system")
+            cls._instance = (NTPTimeSource() if name.lower() == "ntp"
+                             else SystemClockTimeSource())
+        return cls._instance

@@ -1,0 +1,109 @@
+"""TrainingMaster / TrainingWorker SPI
+(ref: spark/api/TrainingMaster.java, TrainingWorker.java,
+TrainingHook.java, WorkerConfiguration.java,
+spark/api/worker/NetBroadcastTuple.java).
+
+The SPI shape is preserved — a pluggable strategy object that owns how a
+front-end's ``fit`` distributes work — but the worker boundary is a host
+thread/process driving device computation instead of a Spark executor
+JVM."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class WorkerConfiguration:
+    """(ref: spark/api/WorkerConfiguration.java)"""
+
+    is_graph_network: bool = False
+    batch_size_per_worker: int = 32
+    averaging_frequency: int = 5
+    prefetch_num_batches: int = 2
+    collect_training_stats: bool = False
+
+
+@dataclasses.dataclass
+class NetBroadcastTuple:
+    """Everything a worker needs to reconstruct the model: the conf JSON,
+    the flat parameter vector, and the flat updater-state vector
+    (ref: spark/api/worker/NetBroadcastTuple.java — the broadcast's
+    payload; flat-vector parity is the checkpoint-format contract)."""
+
+    conf_json: str
+    params: np.ndarray
+    updater_state: Optional[np.ndarray]
+    is_graph: bool = False
+    iteration: int = 0  # driver step count — keeps Adam bias correction
+    #                     aligned across re-broadcasts
+
+
+class TrainingHook:
+    """(ref: spark/api/TrainingHook.java — pre/post update callbacks;
+    the parameter-server edition wires push/pull in here,
+    ref: dl4j-spark-parameterserver/.../ParameterServerTrainingHook.java)"""
+
+    def pre_update(self, minibatch, model) -> None:  # pragma: no cover
+        pass
+
+    def post_update(self, minibatch, model) -> None:  # pragma: no cover
+        pass
+
+
+class TrainingWorker:
+    """Executor-side logic (ref: spark/api/TrainingWorker.java): build the
+    net from the broadcast, process minibatches, emit a result."""
+
+    def get_initial_model(self, broadcast: NetBroadcastTuple):
+        raise NotImplementedError
+
+    def process_minibatch(self, dataset, model) -> None:
+        raise NotImplementedError
+
+    def get_final_result(self, model) -> Any:
+        raise NotImplementedError
+
+
+class TrainingMaster:
+    """(ref: spark/api/TrainingMaster.java) — the distributed-training
+    strategy SPI.  Concrete: ParameterAveragingTrainingMaster."""
+
+    def __init__(self):
+        self.hooks: List[TrainingHook] = []
+
+    # -- hook management (ref: TrainingMaster.addHook/removeHook) ----------
+    def add_hook(self, hook: TrainingHook) -> None:
+        self.hooks.append(hook)
+
+    def remove_hook(self, hook: TrainingHook) -> None:
+        self.hooks.remove(hook)
+
+    # -- main entry points --------------------------------------------------
+    def execute_training(self, front_end, data) -> None:
+        raise NotImplementedError
+
+    # -- reproducibility (ref: TrainingMaster.toJson/fromJson) -------------
+    def _config_dict(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def to_json(self) -> str:
+        d = {"type": type(self).__name__}
+        d.update(self._config_dict())
+        return json.dumps(d, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "TrainingMaster":
+        d = json.loads(s)
+        kind = d.pop("type")
+        from deeplearning4j_tpu.scaleout.param_averaging import (
+            ParameterAveragingTrainingMaster)
+        registry = {
+            "ParameterAveragingTrainingMaster":
+                ParameterAveragingTrainingMaster,
+        }
+        return registry[kind](**d)

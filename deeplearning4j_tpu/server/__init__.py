@@ -1,0 +1,9 @@
+"""Serving edges (SURVEY.md §2.9): nearest-neighbor HTTP server and the
+Python gateway entry point."""
+
+from deeplearning4j_tpu.server.nearestneighbors import (
+    NearestNeighbor, NearestNeighborsServer)
+from deeplearning4j_tpu.server.gateway import DeepLearning4jEntryPoint, Server
+
+__all__ = ["NearestNeighbor", "NearestNeighborsServer",
+           "DeepLearning4jEntryPoint", "Server"]

@@ -1,0 +1,123 @@
+"""Gateway entry point for external (non-framework) processes
+(ref: deeplearning4j-keras — keras/Server.java:15-18 starts a py4j
+GatewayServer around DeepLearning4jEntryPoint;
+DeepLearning4jEntryPoint.fit() :21-33 trains a Keras-saved model on
+batches streamed from disk; HDF5MiniBatchDataSetIterator reads them).
+
+The reference's wire tech (py4j JVM gateway) is replaced by a JSON-RPC
+HTTP endpoint — the natural cross-process seam for a Python-hosted
+runtime.  The entry-point surface is preserved: ``fit`` takes a saved
+model (Keras .h5 via keras_import, or a framework .zip checkpoint) plus
+a directory of exported minibatches, trains, and writes the result
+checkpoint."""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+
+
+class DeepLearning4jEntryPoint:
+    """(ref: keras/DeepLearning4jEntryPoint.java:21-33 — the object the
+    gateway exposes; one method per remote operation)."""
+
+    def _load_model(self, model_path: str):
+        p = Path(model_path)
+        if p.suffix in (".h5", ".hdf5"):
+            from deeplearning4j_tpu.keras_import import KerasModelImport
+            return KerasModelImport.import_keras_model_and_weights(str(p))
+        from deeplearning4j_tpu.nn.serialization import load_model
+        return load_model(str(p))
+
+    def fit(self, model_path: str, data_dir: str, epochs: int = 1,
+            save_path: Optional[str] = None) -> dict:
+        """Train ``model_path`` on the .npz minibatches in ``data_dir``
+        (the HDF5MiniBatchDataSetIterator role is played by
+        scaleout.data.PathDataSetIterator)."""
+        from deeplearning4j_tpu.nn.serialization import write_model
+        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
+        model = self._load_model(model_path)
+        it = PathDataSetIterator.from_dir(data_dir)
+        for _ in range(int(epochs)):
+            it.reset()
+            while it.has_next():
+                model.fit(it.next())
+        out = save_path or model_path
+        if not out.endswith(".zip"):
+            out = str(Path(out).with_suffix(".zip"))
+        write_model(model, out)
+        return {"score": float(model.score()), "model_path": out}
+
+    def evaluate(self, model_path: str, data_dir: str) -> dict:
+        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
+        model = self._load_model(model_path)
+        ev = model.evaluate(PathDataSetIterator.from_dir(data_dir))
+        return {"accuracy": ev.accuracy(), "f1": ev.f1()}
+
+    def predict(self, model_path: str, data_dir: str) -> dict:
+        import numpy as np
+        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
+        model = self._load_model(model_path)
+        it = PathDataSetIterator.from_dir(data_dir)
+        outs = []
+        while it.has_next():
+            outs.append(np.asarray(model.output(it.next().features)))
+        stacked = np.concatenate(outs) if outs else np.zeros((0,))
+        return {"predictions": stacked.tolist()}
+
+
+class Server:
+    """(ref: keras/Server.java — `new GatewayServer(new
+    DeepLearning4jEntryPoint()).start()`).  JSON-RPC over HTTP:
+
+    POST / {"method": "fit", "params": {...}} →
+        {"result": {...}} or {"error": "..."}
+    """
+
+    def __init__(self, entry_point: Optional[DeepLearning4jEntryPoint] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        ep = entry_point or DeepLearning4jEntryPoint()
+        self.entry_point = ep
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    method = req.get("method", "")
+                    if method.startswith("_") or not hasattr(ep, method):
+                        raise AttributeError(f"no method {method!r}")
+                    result = getattr(ep, method)(**req.get("params", {}))
+                    payload = json.dumps({"result": result}).encode()
+                    code = 200
+                except Exception as e:
+                    payload = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}",
+                         "traceback": traceback.format_exc()}).encode()
+                    code = 500
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Server":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

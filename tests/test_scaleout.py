@@ -1,0 +1,230 @@
+"""Cluster-tier tests — the reference exercises its Spark layer in
+local[N] mode without a real cluster (ref: dl4j-spark BaseSparkTest.java:89);
+the analog here is the in-process worker pool (SURVEY.md §4)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.earlystopping import (
+    EarlyStoppingConfiguration, MaxEpochsTerminationCondition)
+from deeplearning4j_tpu.scaleout import (
+    ClusterDl4jMultiLayer, ParameterAveragingTrainingMaster,
+    SystemClockTimeSource, TrainingMaster)
+from deeplearning4j_tpu.scaleout.data import (
+    PathDataSetIterator, batch_and_export, repartition_balanced)
+from deeplearning4j_tpu.scaleout.earlystopping import (
+    ClusterDataSetLossCalculator, ClusterEarlyStoppingTrainer)
+from deeplearning4j_tpu.scaleout.nlp import ClusterWord2Vec, TextPipeline
+from deeplearning4j_tpu.scaleout.time_source import NTPTimeSource
+
+
+def _iris_conf(seed=12345):
+    return (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+
+
+def _iris_data():
+    ds = load_iris()
+    n = NormalizerStandardize(); n.fit(ds); ds = n.transform(ds)
+    return ds.shuffle(seed=0)
+
+
+def test_parameter_averaging_trains():
+    """(ref: TestSparkMultiLayerParameterAveraging.java)"""
+    ds = _iris_data()
+    tm = ParameterAveragingTrainingMaster(
+        num_workers=4, batch_size_per_worker=15, averaging_frequency=2,
+        collect_training_stats=True)
+    cluster = ClusterDl4jMultiLayer(_iris_conf(), tm)
+    before = cluster.calculate_score(ds, batch=30)
+    cluster.fit(ds, epochs=10)
+    after = cluster.calculate_score(ds, batch=30)
+    assert np.isfinite(after) and after < before, (before, after)
+    ev = cluster.evaluate(ds, batch=30)
+    assert ev.accuracy() > 0.7, ev.accuracy()
+
+
+def test_param_averaging_matches_single_node_one_worker():
+    """With 1 worker and avgFreq=1 the master must reproduce plain fit."""
+    ds = _iris_data()
+    batches = ds.batch_by(15)
+
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    solo = MultiLayerNetwork(_iris_conf()).init()
+    for b in batches:
+        solo.fit(b)
+
+    tm = ParameterAveragingTrainingMaster(
+        num_workers=1, batch_size_per_worker=15, averaging_frequency=1)
+    cluster = ClusterDl4jMultiLayer(_iris_conf(), tm)
+    cluster.fit(batches)
+
+    np.testing.assert_allclose(
+        np.asarray(cluster.network.params()), np.asarray(solo.params()),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_training_stats_and_html(tmp_path):
+    """(ref: spark/stats/StatsUtils.exportStatsAsHtml)"""
+    ds = _iris_data()
+    tm = ParameterAveragingTrainingMaster(
+        num_workers=2, batch_size_per_worker=25, averaging_frequency=2,
+        collect_training_stats=True)
+    ClusterDl4jMultiLayer(_iris_conf(), tm).fit(ds)
+    stats = tm.stats
+    totals = stats.phase_totals_ms()
+    assert {"broadcast", "worker_fit", "aggregate"} <= set(totals)
+    out = tmp_path / "stats.html"
+    stats.export_stats_html(str(out))
+    text = out.read_text()
+    assert "worker_fit" in text and "timeline" in text
+    json.loads(stats.to_json())
+
+
+def test_training_master_json_round_trip():
+    tm = ParameterAveragingTrainingMaster(
+        num_workers=3, batch_size_per_worker=7, averaging_frequency=4,
+        aggregation_depth=3)
+    tm2 = TrainingMaster.from_json(tm.to_json())
+    assert isinstance(tm2, ParameterAveragingTrainingMaster)
+    assert tm2.num_workers == 3
+    assert tm2.batch_size_per_worker == 7
+    assert tm2.averaging_frequency == 4
+    assert tm2.aggregation_depth == 3
+
+
+def test_batch_and_export_round_trip(tmp_path):
+    """(ref: spark/data/BatchAndExportDataSetsFunction.java)"""
+    rng = np.random.default_rng(0)
+    dss = [DataSet(rng.normal(size=(n, 3)).astype(np.float32),
+                   np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)])
+           for n in (10, 7, 5)]
+    paths = batch_and_export(dss, tmp_path, batch_size=8)
+    # 22 examples → 2 full batches of 8 + remainder 6
+    sizes = []
+    it = PathDataSetIterator(paths)
+    total = 0
+    while it.has_next():
+        b = it.next()
+        sizes.append(b.num_examples())
+        total += b.num_examples()
+    assert total == 22
+    assert sizes[:-1] == [8, 8]
+    it.reset()
+    assert it.has_next()
+    merged = DataSet.merge(dss)
+    round_tripped = DataSet.merge(
+        [PathDataSetIterator(paths).next() for _ in range(1)])
+    np.testing.assert_array_equal(round_tripped.features,
+                                  merged.features[:8])
+
+
+def test_repartition_balanced():
+    parts = repartition_balanced(list(range(10)), 3)
+    assert [len(p) for p in parts] == [4, 3, 3]
+    assert sorted(sum(parts, [])) == list(range(10))
+
+
+def test_cluster_early_stopping():
+    """(ref: spark/earlystopping/TestEarlyStoppingSpark.java)"""
+    ds = _iris_data()
+    tm = ParameterAveragingTrainingMaster(
+        num_workers=2, batch_size_per_worker=25, averaging_frequency=2)
+    fe = ClusterDl4jMultiLayer(_iris_conf(), tm)
+    conf = EarlyStoppingConfiguration(
+        score_calculator=ClusterDataSetLossCalculator(fe, ds),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+    result = ClusterEarlyStoppingTrainer(conf, fe, ds).fit()
+    assert result.termination_reason == "EpochTerminationCondition"
+    assert result.total_epochs <= 4
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
+
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the dog barks at the quick fox",
+    "a lazy dog sleeps all day",
+    "the fox and the dog are friends",
+    "quick brown foxes jump over lazy dogs",
+] * 4
+
+
+def test_text_pipeline_counts():
+    """(ref: spark/text/functions/TextPipeline.java)"""
+    tp = TextPipeline(CORPUS, min_word_frequency=2, num_partitions=3)
+    counts = tp.build_word_counts()
+    assert counts["the"] == 24  # 6 per block x 4
+    vocab = tp.build_vocab_cache()
+    assert vocab.contains_word("dog")
+    el = vocab.word_for("the")
+    assert el.code_length > 0  # Huffman built
+    assert vocab.index_of("the") == 0  # most frequent word first
+
+
+def test_cluster_word2vec_trains():
+    """(ref: dl4j-spark-nlp Word2Vec)"""
+    cw = ClusterWord2Vec(layer_size=16, min_word_frequency=1, window=3,
+                         num_partitions=2, iterations=2, seed=1)
+    model = cw.fit(CORPUS)
+    sim = model.similarity("dog", "fox")
+    assert -1.0 <= sim <= 1.0
+    near = model.words_nearest("dog", top=3)
+    assert len(near) == 3
+
+
+def test_time_sources():
+    t = SystemClockTimeSource().current_time_millis()
+    assert t > 1.7e12  # sanity: epoch millis
+    ntp = NTPTimeSource(server="192.0.2.1")  # TEST-NET, unreachable
+    # zero-egress env: degrades to offset 0 with recorded error
+    assert ntp.current_time_millis() > 1.7e12
+    assert ntp.offset_ms == 0 or isinstance(ntp.offset_ms, int)
+
+
+def test_parameter_server_push_pull():
+    """(ref: nd4j ParameterServerClient pushNDArray/getArray surface)"""
+    from deeplearning4j_tpu.scaleout.paramserver import (
+        ParameterServerClient, ParameterServerNode)
+    init = np.zeros(8, np.float32)
+    node = ParameterServerNode(init)
+    try:
+        c = ParameterServerClient(node.host, node.port)
+        assert np.array_equal(c.get_nd_array(), init)
+        assert c.push_nd_array(np.ones(8, np.float32))
+        assert c.push_nd_array(2 * np.ones(8, np.float32))
+        np.testing.assert_allclose(c.get_nd_array(), 3 * np.ones(8))
+        assert node.updates_received == 2
+        # shape mismatch rejected
+        assert not c.push_nd_array(np.ones(4, np.float32))
+        c.close()
+    finally:
+        node.shutdown()
+
+
+def test_parameter_server_trainer():
+    """(ref: parameterserver/ParameterServerTrainer.java)"""
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.scaleout.paramserver import ParameterServerTrainer
+
+    ds = _iris_data()
+    net = MultiLayerNetwork(_iris_conf()).init()
+    before = float(net.score(ds))
+    trainer = ParameterServerTrainer(net, num_workers=3)
+    trainer.fit(ListDataSetIterator(ds, 15), epochs=8)
+    after = float(net.score(ds))
+    assert np.isfinite(after) and after < before, (before, after)

@@ -116,3 +116,52 @@ def test_self_attention_layer_trains_sequence_parallel(seq_mesh):
         last = float(net.score())
     assert np.isfinite(last)
     assert last < first, (first, last)
+
+
+def test_trace_cache_invalidated_on_mesh_change(seq_mesh):
+    """Cached jitted steps must retrace when entering/leaving
+    sequence_mesh — the collectives are baked into the traced program."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        RnnOutputLayer, SelfAttentionLayer)
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    B, T, F, C = 4, 8, 6, 2
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (B, T))]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05)
+            .list()
+            .layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                      strategy="ring"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(F, T))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    net.fit(ListDataSetIterator(ds, B))          # dense trace
+    dense_step = net._step_fn
+    with seq.sequence_mesh(seq_mesh):
+        net.fit(ListDataSetIterator(ds, B))      # must retrace sharded
+        assert net._step_fn is not dense_step
+        sp_out = np.asarray(net.output(x))
+    out = np.asarray(net.output(x))              # back to dense: retrace again
+    np.testing.assert_allclose(out, sp_out, rtol=1e-5, atol=1e-5)
+
+
+def test_unknown_strategy_raises():
+    q, k, v = _qkv(seed=9)
+    with pytest.raises(ValueError, match="unknown attention strategy"):
+        seq.attention(q, k, v, strategy="ulyses")
+
+
+def test_non_divisible_seq_raises(seq_mesh):
+    q, k, v = _qkv(T=10)  # 10 % 4 != 0
+    with seq.sequence_mesh(seq_mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            seq.attention(q, k, v, strategy="ring")

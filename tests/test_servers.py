@@ -1,0 +1,111 @@
+"""Serving edges (SURVEY.md §2.9): NearestNeighborsServer HTTP endpoints
+and the gateway entry point (keras backend server analog)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.server import (
+    DeepLearning4jEntryPoint, NearestNeighborsServer, Server)
+from deeplearning4j_tpu.server.nearestneighbors import (
+    base64_to_ndarray, ndarray_to_base64)
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_base64_ndarray_round_trip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = base64_to_ndarray(ndarray_to_base64(a))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_nearest_neighbors_server():
+    """(ref: server/NearestNeighborsServer.java — /knn and /knnnew)"""
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(50, 8)).astype(np.float32)
+    srv = NearestNeighborsServer(pts)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        # /knn: neighbors of stored point 3 (excluding itself)
+        code, resp = _post(base + "/knn", {"ndarrayIndex": 3, "k": 5})
+        assert code == 200
+        results = resp["results"]
+        assert len(results) == 5
+        assert all(r["index"] != 3 for r in results)
+        dists = [r["distance"] for r in results]
+        assert dists == sorted(dists)
+        # /knnnew: query equals point 7 → nearest must be 7 at distance 0
+        body = ndarray_to_base64(pts[7])
+        body["k"] = 3
+        code, resp = _post(base + "/knnnew", body)
+        assert code == 200
+        assert resp["results"][0]["index"] == 7
+        assert resp["results"][0]["distance"] < 1e-5
+        # bad request → 400
+        code, resp = _post(base + "/knn", {"k": 2})
+        assert code == 400 and "error" in resp
+    finally:
+        srv.stop()
+
+
+def test_gateway_fit_evaluate(tmp_path):
+    """(ref: keras/Server.java + DeepLearning4jEntryPoint.fit :21-33)"""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.serialization import write_model
+    from deeplearning4j_tpu.scaleout.data import export_dataset
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(60, 5)).astype(np.float32)
+    w = rng.normal(size=(5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    for i, b in enumerate(DataSet(x, y).batch_by(20)):
+        export_dataset(b, data_dir / f"b{i}.npz")
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model_path = str(tmp_path / "model.zip")
+    write_model(MultiLayerNetwork(conf).init(), model_path)
+
+    srv = Server().start()
+    try:
+        base = f"http://{srv.host}:{srv.port}/"
+        code, resp = _post(base, {"method": "fit", "params": {
+            "model_path": model_path, "data_dir": str(data_dir),
+            "epochs": 30}})
+        assert code == 200, resp
+        assert np.isfinite(resp["result"]["score"])
+        code, resp = _post(base, {"method": "evaluate", "params": {
+            "model_path": resp["result"]["model_path"],
+            "data_dir": str(data_dir)}})
+        assert code == 200, resp
+        assert resp["result"]["accuracy"] > 0.8
+        # unknown method → error, private method blocked
+        code, resp = _post(base, {"method": "_load_model", "params": {}})
+        assert code == 500 and "error" in resp
+    finally:
+        srv.stop()
+
+
+def test_entry_point_direct(tmp_path):
+    ep = DeepLearning4jEntryPoint()
+    assert hasattr(ep, "fit") and hasattr(ep, "evaluate")
