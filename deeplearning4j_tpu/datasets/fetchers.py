@@ -33,7 +33,15 @@ MNIST_FILES = {
 
 
 def _read_idx(path: Path) -> np.ndarray:
-    """Parse an IDX (ubyte) file, gzip or raw (ref: MnistManager.java)."""
+    """Parse an IDX (ubyte) file, gzip or raw (ref: MnistManager.java).
+    Raw files go through the native parser (native/dl4j_io.cc) when the
+    library is available."""
+    if path.suffix != ".gz":
+        try:
+            from deeplearning4j_tpu.native import read_idx
+            return read_idx(path).astype(np.uint8)
+        except Exception:
+            pass  # fall through to the pure-Python parse
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]

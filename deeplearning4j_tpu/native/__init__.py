@@ -1,0 +1,102 @@
+"""ctypes bindings for the native host-runtime library (native/dl4j_io.cc)
+— the TPU framework's equivalent of the reference's native tier
+(SURVEY.md §2.3/§2.10: libnd4j + JavaCPP bridges; here the math tier is
+XLA/PJRT, and the native surface is the host data path + staging arena).
+
+The library builds on first import (g++ is baked into the image); every
+consumer has a pure-Python fallback, so a missing/failed build degrades
+gracefully — ``available()`` reports which path is active."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_LIB_PATH = Path(__file__).parent / "libdl4j_io.so"
+_SRC_DIR = Path(__file__).parent.parent.parent / "native"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    src = _SRC_DIR / "dl4j_io.cc"
+    if not src.exists():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+             "-shared", "-o", str(_LIB_PATH), str(src)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # no compiler / build error → Python fallback
+        log.warning("native build failed (%s); using Python fallbacks", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not _LIB_PATH.exists() or (
+            (_SRC_DIR / "dl4j_io.cc").exists()
+            and (_SRC_DIR / "dl4j_io.cc").stat().st_mtime
+            > _LIB_PATH.stat().st_mtime):
+        if not _build() and not _LIB_PATH.exists():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        log.warning("native load failed (%s); using Python fallbacks", e)
+        return None
+    c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+    lib.csv_dims.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_long),
+                             ctypes.POINTER(ctypes.c_long)]
+    lib.csv_dims.restype = ctypes.c_int
+    lib.csv_read.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_float), ctypes.c_long,
+                             ctypes.c_long]
+    lib.csv_read.restype = ctypes.c_int
+    lib.idx_dims.argtypes = [ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_long),
+                             ctypes.POINTER(ctypes.c_long)]
+    lib.idx_dims.restype = ctypes.c_int
+    lib.idx_read.argtypes = [ctypes.c_char_p,
+                             ctypes.POINTER(ctypes.c_float), ctypes.c_long]
+    lib.idx_read.restype = ctypes.c_int
+    lib.prefetch_open.argtypes = [c_char_pp, ctypes.c_long, ctypes.c_long,
+                                  ctypes.c_long]
+    lib.prefetch_open.restype = ctypes.c_void_p
+    lib.prefetch_next.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_char_p)]
+    lib.prefetch_next.restype = ctypes.c_long
+    lib.prefetch_close.argtypes = [ctypes.c_void_p]
+    lib.arena_create.argtypes = [ctypes.c_long]
+    lib.arena_create.restype = ctypes.c_void_p
+    lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.arena_alloc.restype = ctypes.c_void_p
+    lib.arena_reset.argtypes = [ctypes.c_void_p]
+    lib.arena_used.argtypes = [ctypes.c_void_p]
+    lib.arena_used.restype = ctypes.c_long
+    lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+from deeplearning4j_tpu.native.io import (  # noqa: E402
+    NativeFilePrefetcher, read_csv_matrix, read_idx)
+from deeplearning4j_tpu.native.workspace import MemoryWorkspace  # noqa: E402
+
+__all__ = ["available", "get_lib", "NativeFilePrefetcher",
+           "read_csv_matrix", "read_idx", "MemoryWorkspace"]

@@ -1,0 +1,139 @@
+"""High-level wrappers over the native IO library, with pure-Python
+fallbacks (the cuDNN-helper pattern of the reference inverted: native is
+the optional fast path, Python the always-working baseline —
+ref: nn/layers/convolution/ConvolutionLayer.java:67 helper loading)."""
+
+from __future__ import annotations
+
+import ctypes
+import io as _io
+from pathlib import Path
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu import native as _native
+
+
+def read_csv_matrix(path: Union[str, Path], delimiter: str = ",",
+                    skip_lines: int = 0) -> np.ndarray:
+    """Numeric CSV → float32 [rows, cols]; non-numeric cells become NaN.
+    Native fast path via csv_dims/csv_read."""
+    lib = _native.get_lib()
+    p = str(path).encode()
+    if lib is not None:
+        rows, cols = ctypes.c_long(), ctypes.c_long()
+        if lib.csv_dims(p, delimiter.encode(), skip_lines,
+                        ctypes.byref(rows), ctypes.byref(cols)) == 0:
+            out = np.empty((rows.value, cols.value), np.float32)
+            got = lib.csv_read(
+                p, delimiter.encode(), skip_lines,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                rows.value, cols.value)
+            if got == rows.value:
+                return out
+    # fallback
+    rows_py: List[List[float]] = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if i < skip_lines or not line.strip():
+                continue
+            vals = []
+            for c in line.rstrip("\n").split(delimiter):
+                try:
+                    vals.append(float(c))
+                except ValueError:
+                    vals.append(float("nan"))
+            rows_py.append(vals)
+    width = max((len(r) for r in rows_py), default=0)
+    out = np.full((len(rows_py), width), np.nan, np.float32)
+    for i, r in enumerate(rows_py):
+        out[i, :len(r)] = r
+    return out
+
+
+def read_idx(path: Union[str, Path]) -> np.ndarray:
+    """IDX (MNIST) file → float32 ndarray.  Native big-endian parse."""
+    lib = _native.get_lib()
+    p = str(path).encode()
+    if lib is not None:
+        ndim = ctypes.c_long()
+        dims = (ctypes.c_long * 4)()
+        dtype_code = lib.idx_dims(p, ctypes.byref(ndim), dims)
+        if dtype_code in (0x08, 0x0D):
+            shape = tuple(dims[i] for i in range(ndim.value))
+            count = int(np.prod(shape)) if shape else 0
+            out = np.empty(count, np.float32)
+            if lib.idx_read(
+                    p, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    count) == 0:
+                return out.reshape(shape)
+    # fallback (pure numpy)
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        nd = magic[3]
+        shape = tuple(int.from_bytes(f.read(4), "big") for _ in range(nd))
+        code = magic[2]
+        dt = np.dtype(">u1") if code == 0x08 else np.dtype(">f4")
+        data = np.frombuffer(f.read(), dt, count=int(np.prod(shape)))
+    return data.reshape(shape).astype(np.float32)
+
+
+class NativeFilePrefetcher:
+    """Threaded read-ahead over a list of files — the
+    AsyncDataSetIterator prefetch queue realized natively
+    (ref: AsyncDataSetIterator.java:39-127).  Yields (path, bytes) in
+    submission order; with no native lib, falls back to a Python
+    ThreadPoolExecutor pipeline with the same bounded-buffer behavior."""
+
+    def __init__(self, paths: Sequence[Union[str, Path]],
+                 capacity: int = 4, n_threads: int = 2):
+        self.paths = [str(p) for p in paths]
+        self.capacity = capacity
+        self.n_threads = n_threads
+
+    def __iter__(self):
+        lib = _native.get_lib()
+        if lib is not None:
+            arr = (ctypes.c_char_p * len(self.paths))(
+                *[p.encode() for p in self.paths])
+            handle = lib.prefetch_open(arr, len(self.paths), self.capacity,
+                                       self.n_threads)
+            if handle:
+                try:
+                    i = 0
+                    while True:
+                        data = ctypes.c_char_p()
+                        n = lib.prefetch_next(handle, ctypes.byref(data))
+                        if n < 0:
+                            break
+                        blob = ctypes.string_at(data, n)
+                        yield self.paths[i], blob
+                        i += 1
+                    return
+                finally:
+                    lib.prefetch_close(handle)
+        # Python fallback
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=self.n_threads) as ex:
+            futs = []
+            idx = 0
+            for i, p in enumerate(self.paths):
+                futs.append(ex.submit(Path(p).read_bytes))
+                if len(futs) - idx > self.capacity:
+                    yield self.paths[idx], futs[idx].result()
+                    futs[idx] = None
+                    idx += 1
+            while idx < len(futs):
+                yield self.paths[idx], futs[idx].result()
+                futs[idx] = None
+                idx += 1
+
+
+def load_npz_dataset_bytes(blob: bytes):
+    """Decode an exported .npz DataSet blob (scaleout.data format)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    with np.load(_io.BytesIO(blob)) as z:
+        return DataSet(z["features"], z["labels"],
+                       z["features_mask"] if "features_mask" in z else None,
+                       z["labels_mask"] if "labels_mask" in z else None)

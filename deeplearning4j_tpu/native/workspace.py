@@ -1,0 +1,72 @@
+"""MemoryWorkspace — scope-based host staging arena
+(ref: nd4j MemoryWorkspace / WorkspaceConfiguration consumed at
+MultiLayerNetwork.java:117-120,1026-1032; modes NONE/SINGLE/SEPARATE in
+nn/conf/WorkspaceMode.java).
+
+On TPU the *device* side of workspaces is XLA buffer donation inside the
+jitted step (no user-visible arena needed — SURVEY.md §2.10); the *host*
+side — reusing pinned staging memory across batches instead of
+malloc/free churn in the input pipeline — is what this arena provides,
+backed by the native 64-byte-aligned bump allocator."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu import native as _native
+
+
+class MemoryWorkspace:
+    """``with MemoryWorkspace(bytes) as ws: buf = ws.alloc(shape, dtype)``
+    — buffers are valid until the scope resets (loop-scoped reuse, the
+    reference's ScopedOut semantics).  Falls back to plain numpy
+    allocation when the native library is unavailable."""
+
+    def __init__(self, size_bytes: int = 64 << 20):
+        self.size_bytes = size_bytes
+        self._handle = None
+        self._lib = _native.get_lib()
+
+    def __enter__(self) -> "MemoryWorkspace":
+        if self._lib is not None:
+            self._handle = self._lib.arena_create(self.size_bytes)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._handle:
+            self._lib.arena_destroy(self._handle)
+            self._handle = None
+        return False
+
+    # -- allocation ---------------------------------------------------------
+    def alloc(self, shape: Tuple[int, ...], dtype=np.float32) -> np.ndarray:
+        """64B-aligned array living in the arena (native) or heap
+        (fallback).  Contents are uninitialized."""
+        dtype = np.dtype(dtype)
+        n_bytes = int(np.prod(shape)) * dtype.itemsize
+        if self._handle:
+            ptr = self._lib.arena_alloc(self._handle, n_bytes)
+            if ptr:
+                # view into arena memory: valid only within this scope
+                # (exiting the `with` frees the arena — ScopedOut rules)
+                buf = (ctypes.c_char * n_bytes).from_address(ptr)
+                return np.frombuffer(buf, dtype=dtype).reshape(shape)
+        return np.empty(shape, dtype)
+
+    def reset(self) -> None:
+        """Free everything allocated in this scope at once (loop
+        iteration boundary; ref: workspace notifyScopeLeft)."""
+        if self._handle:
+            self._lib.arena_reset(self._handle)
+
+    def used_bytes(self) -> int:
+        if self._handle:
+            return int(self._lib.arena_used(self._handle))
+        return 0
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None

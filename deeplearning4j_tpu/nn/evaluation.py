@@ -268,4 +268,47 @@ class ROCMultiClass:
         return self.per_class[cls].auc()
 
 
-ROCBinary = ROC
+class ROCBinary:
+    """Per-output-column ROC for independent binary outputs (multi-label
+    networks with sigmoid heads; ref: eval/ROCBinary.java — distinct from
+    ROCMultiClass's one-vs-all over a softmax)."""
+
+    def __init__(self, threshold_steps: int = 100):
+        self.steps = threshold_steps
+        self.per_output: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        pred = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            pred = pred[:, None]
+        labels = labels.reshape(-1, labels.shape[-1])
+        pred = pred.reshape(-1, pred.shape[-1])
+        if mask is not None:
+            m = np.asarray(mask).astype(bool)
+            if m.shape == labels.shape:          # per-element mask
+                pass  # applied per column below
+            else:                                # per-example/timestep mask
+                m = m.reshape(-1)
+                labels, pred = labels[m], pred[m]
+                m = None
+        else:
+            m = None
+        for c in range(labels.shape[-1]):
+            if m is not None:
+                keep = m.reshape(-1, labels.shape[-1])[:, c]
+                self.per_output.setdefault(c, ROC(self.steps)).eval(
+                    labels[keep, c], pred[keep, c])
+            else:
+                self.per_output.setdefault(c, ROC(self.steps)).eval(
+                    labels[:, c], pred[:, c])
+
+    def num_outputs(self) -> int:
+        return len(self.per_output)
+
+    def auc(self, output: int = 0) -> float:
+        return self.per_output[output].auc()
+
+    def roc_curve(self, output: int = 0):
+        return self.per_output[output].roc_curve()

@@ -1,0 +1,22 @@
+"""Record readers + transforms — the consumed DataVec surface
+(SURVEY.md §2.10: RecordReader / transforms / image loading behind
+`RecordReaderDataSetIterator.java:54`)."""
+
+from deeplearning4j_tpu.records.readers import (
+    CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
+    CSVSequenceRecordReader, ImageRecordReader, LineRecordReader,
+    RecordReader, SequenceRecordReader)
+from deeplearning4j_tpu.records.schema import Schema
+from deeplearning4j_tpu.records.transforms import TransformProcess
+from deeplearning4j_tpu.records.iterators import (
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator)
+
+__all__ = [
+    "CollectionRecordReader", "CollectionSequenceRecordReader",
+    "CSVRecordReader", "CSVSequenceRecordReader", "ImageRecordReader",
+    "LineRecordReader", "RecordReader", "SequenceRecordReader", "Schema",
+    "TransformProcess", "RecordReaderDataSetIterator",
+    "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
+]

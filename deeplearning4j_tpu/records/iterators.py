@@ -1,0 +1,236 @@
+"""RecordReader → DataSet iterators
+(ref: deeplearning4j-core/.../datasets/datavec/
+RecordReaderDataSetIterator.java:54 (466 LoC),
+SequenceRecordReaderDataSetIterator.java,
+RecordReaderMultiDataSetIterator.java)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.records.readers import (
+    RecordReader, SequenceRecordReader)
+
+
+def _record_to_arrays(rec, label_index: Optional[int], n_labels: int,
+                      regression: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Split one record into (features, labels) following the reference's
+    labelIndex semantics; image records carry ndarray features."""
+    if label_index is None:
+        feats = rec
+        label = None
+    else:
+        li = label_index if label_index >= 0 else len(rec) + label_index
+        feats = rec[:li] + rec[li + 1:]
+        label = rec[li]
+    if len(feats) == 1 and isinstance(feats[0], np.ndarray):
+        f = feats[0].astype(np.float32)
+    else:
+        f = np.asarray([float(v) for v in feats], np.float32)
+    if label is None:
+        return f, np.zeros((0,), np.float32)
+    if regression:
+        y = np.asarray([float(label)], np.float32)
+    else:
+        y = np.zeros((n_labels,), np.float32)
+        y[int(label)] = 1.0
+    return f, y
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """(ref: RecordReaderDataSetIterator.java:54 — batchSize,
+    labelIndex, numPossibleLabels, regression)"""
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = -1,
+                 num_possible_labels: int = 0, regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.reader.reset()
+
+    def has_next(self) -> bool:
+        return self.reader.has_next()
+
+    def next(self) -> DataSet:
+        fs, ys = [], []
+        while self.reader.has_next() and len(fs) < self.batch_size:
+            f, y = _record_to_arrays(self.reader.next_record(),
+                                     self.label_index,
+                                     self.num_possible_labels,
+                                     self.regression)
+            fs.append(f)
+            ys.append(y)
+        return DataSet(np.stack(fs), np.stack(ys))
+
+    def reset(self) -> None:
+        self.reader.reset()
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Sequences → padded+masked [N, T, C] DataSets
+    (ref: SequenceRecordReaderDataSetIterator.java; alignment modes:
+    same reader for features+labels per-step, or separate readers with
+    ALIGN_END last-step labels)."""
+
+    ALIGN_END = "ALIGN_END"
+    EQUAL_LENGTH = "EQUAL_LENGTH"
+
+    def __init__(self, features_reader: SequenceRecordReader,
+                 batch_size: int, num_possible_labels: int,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 label_index: int = -1, regression: bool = False,
+                 alignment: str = "EQUAL_LENGTH"):
+        self.freader = features_reader
+        self.lreader = labels_reader
+        self.batch_size = batch_size
+        self.num_possible_labels = num_possible_labels
+        self.label_index = label_index
+        self.regression = regression
+        self.alignment = alignment
+        self.reset()
+
+    def has_next(self) -> bool:
+        return self.freader.has_next()
+
+    def _one(self):
+        fseq = self.freader.next_sequence()
+        if self.lreader is not None:
+            lseq = self.lreader.next_sequence()
+            f = np.asarray([[float(v) for v in r] for r in fseq], np.float32)
+            if self.regression:
+                y = np.asarray([[float(v) for v in r] for r in lseq],
+                               np.float32)
+            else:
+                y = np.zeros((len(lseq), self.num_possible_labels),
+                             np.float32)
+                for t, r in enumerate(lseq):
+                    y[t, int(r[0])] = 1.0
+            return f, y
+        # same reader carries features + per-step label column
+        feats, labels = [], []
+        for r in fseq:
+            li = (self.label_index if self.label_index >= 0
+                  else len(r) + self.label_index)
+            feats.append([float(v) for i, v in enumerate(r) if i != li])
+            labels.append(r[li])
+        f = np.asarray(feats, np.float32)
+        if self.regression:
+            y = np.asarray(labels, np.float32)[:, None]
+        else:
+            y = np.zeros((len(labels), self.num_possible_labels), np.float32)
+            for t, lab in enumerate(labels):
+                y[t, int(lab)] = 1.0
+        return f, y
+
+    def next(self) -> DataSet:
+        seqs = []
+        while self.freader.has_next() and len(seqs) < self.batch_size:
+            seqs.append(self._one())
+        T = max(f.shape[0] for f, _ in seqs)
+        align_end = self.alignment == self.ALIGN_END
+        Tl = T if align_end else max(y.shape[0] for _, y in seqs)
+        N = len(seqs)
+        C = seqs[0][0].shape[1]
+        L = seqs[0][1].shape[1]
+        x = np.zeros((N, T, C), np.float32)
+        y = np.zeros((N, Tl, L), np.float32)
+        fm = np.zeros((N, T), np.float32)
+        lm = np.zeros((N, Tl), np.float32)
+        for i, (f, lab) in enumerate(seqs):
+            x[i, :f.shape[0]] = f
+            fm[i, :f.shape[0]] = 1.0
+            if align_end:
+                # labels end-aligned with each example's LAST valid
+                # feature step (ref: AlignmentMode.ALIGN_END)
+                off = f.shape[0] - lab.shape[0]
+                y[i, off:f.shape[0]] = lab
+                lm[i, off:f.shape[0]] = 1.0
+            else:
+                y[i, :lab.shape[0]] = lab
+                lm[i, :lab.shape[0]] = 1.0
+        pad_free = fm.all() and lm.all()
+        return DataSet(x, y, None if pad_free else fm,
+                       None if pad_free else lm)
+
+    def reset(self) -> None:
+        self.freader.reset()
+        if self.lreader is not None:
+            self.lreader.reset()
+
+
+class RecordReaderMultiDataSetIterator:
+    """Named multi-input/multi-output assembly
+    (ref: RecordReaderMultiDataSetIterator.java — builder with
+    addReader/addInput/addOutputOneHot)."""
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            self.batch_size = batch_size
+            self.readers: Dict[str, RecordReader] = {}
+            self.inputs: List[Tuple[str, Optional[int], Optional[int]]] = []
+            self.outputs: List[Tuple[str, int, Optional[int], bool]] = []
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self.readers[name] = reader
+            return self
+
+        def add_input(self, reader_name: str, col_from: Optional[int] = None,
+                      col_to: Optional[int] = None):
+            self.inputs.append((reader_name, col_from, col_to))
+            return self
+
+        def add_output_one_hot(self, reader_name: str, column: int,
+                               num_classes: int):
+            self.outputs.append((reader_name, column, num_classes, False))
+            return self
+
+        def add_output(self, reader_name: str, col_from: Optional[int] = None,
+                       col_to: Optional[int] = None):
+            self.outputs.append((reader_name, col_from, col_to, True))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            return RecordReaderMultiDataSetIterator(self)
+
+    def __init__(self, builder: "RecordReaderMultiDataSetIterator.Builder"):
+        self.b = builder
+        self.reset()
+
+    def has_next(self) -> bool:
+        return all(r.has_next() for r in self.b.readers.values())
+
+    def next(self) -> MultiDataSet:
+        rows: List[Dict[str, list]] = []
+        while self.has_next() and len(rows) < self.b.batch_size:
+            rows.append({n: r.next_record()
+                         for n, r in self.b.readers.items()})
+        ins = []
+        for name, c0, c1 in self.b.inputs:
+            vals = [[float(v) for v in
+                     (row[name][c0:c1] if c0 is not None else row[name])]
+                    for row in rows]
+            ins.append(np.asarray(vals, np.float32))
+        outs = []
+        for name, a, b, is_range in self.b.outputs:
+            if is_range:
+                vals = [[float(v) for v in
+                         (row[name][a:b] if a is not None else row[name])]
+                        for row in rows]
+                outs.append(np.asarray(vals, np.float32))
+            else:
+                y = np.zeros((len(rows), b), np.float32)
+                for i, row in enumerate(rows):
+                    y[i, int(row[name][a])] = 1.0
+                outs.append(y)
+        return MultiDataSet(ins, outs)
+
+    def reset(self) -> None:
+        for r in self.b.readers.values():
+            r.reset()

@@ -1,0 +1,293 @@
+"""RecordReader SPI + implementations
+(ref: external DataVec consumed surface — datavec-api
+RecordReader/SequenceRecordReader and datavec-data-image's
+ImageRecordReader, as used by
+deeplearning4j-core/.../datasets/datavec/RecordReaderDataSetIterator.java:54).
+
+A record is a list of values (numbers or strings); a sequence record is
+a list of records (timesteps).  Readers stream from files/collections;
+the iterators in records/iterators.py assemble DataSets from them."""
+
+from __future__ import annotations
+
+import csv
+import io
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+Record = List[object]
+
+
+class RecordReader:
+    """(ref: datavec RecordReader — hasNext/next/reset contract)"""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_record(self) -> Record:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+class SequenceRecordReader(RecordReader):
+    """(ref: datavec SequenceRecordReader)"""
+
+    def next_sequence(self) -> List[Record]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (ref: datavec CollectionRecordReader)."""
+
+    def __init__(self, records: Iterable[Record]):
+        self.records = [list(r) for r in records]
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self.records)
+
+    def next_record(self):
+        r = self.records[self._i]
+        self._i += 1
+        return list(r)
+
+    def reset(self):
+        self._i = 0
+
+
+class CollectionSequenceRecordReader(SequenceRecordReader):
+    def __init__(self, sequences: Iterable[Iterable[Record]]):
+        self.sequences = [[list(r) for r in s] for s in sequences]
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self.sequences)
+
+    def next_sequence(self):
+        s = self.sequences[self._i]
+        self._i += 1
+        return [list(r) for r in s]
+
+    next_record = next_sequence
+
+    def reset(self):
+        self._i = 0
+
+
+class LineRecordReader(RecordReader):
+    """One line → one single-column record (ref: datavec LineRecordReader)."""
+
+    def __init__(self, path: Union[str, Path]):
+        self.path = str(path)
+        self._lines: Optional[List[str]] = None
+        self._i = 0
+
+    def _load(self):
+        if self._lines is None:
+            with open(self.path) as f:
+                self._lines = [ln.rstrip("\n") for ln in f]
+
+    def has_next(self):
+        self._load()
+        return self._i < len(self._lines)
+
+    def next_record(self):
+        self._load()
+        ln = self._lines[self._i]
+        self._i += 1
+        return [ln]
+
+    def reset(self):
+        self._i = 0
+
+
+def _parse_field(s: str):
+    """Numbers become floats (ints stay int-valued floats), everything
+    else stays a string — matching DataVec's Writable coercion at the
+    DataSet boundary."""
+    try:
+        return int(s)
+    except ValueError:
+        try:
+            return float(s)
+        except ValueError:
+            return s
+
+
+class CSVRecordReader(RecordReader):
+    """(ref: datavec CSVRecordReader — skipNumLines, delimiter, quote)"""
+
+    def __init__(self, path_or_text: Union[str, Path] = None,
+                 skip_num_lines: int = 0, delimiter: str = ",",
+                 quote: str = '"', text: Optional[str] = None):
+        self.path = None if text is not None else str(path_or_text)
+        self.text = text
+        self.skip_num_lines = skip_num_lines
+        self.delimiter = delimiter
+        self.quote = quote
+        self._rows: Optional[List[Record]] = None
+        self._i = 0
+
+    def _load(self):
+        if self._rows is not None:
+            return
+        if self.text is not None:
+            src = io.StringIO(self.text)
+        else:
+            src = open(self.path, newline="")
+        with src:
+            reader = csv.reader(src, delimiter=self.delimiter,
+                                quotechar=self.quote)
+            rows = list(reader)
+        rows = rows[self.skip_num_lines:]
+        self._rows = [[_parse_field(c) for c in row] for row in rows if row]
+
+    def has_next(self):
+        self._load()
+        return self._i < len(self._rows)
+
+    def next_record(self):
+        self._load()
+        r = self._rows[self._i]
+        self._i += 1
+        return list(r)
+
+    def reset(self):
+        self._i = 0
+
+
+class CSVSequenceRecordReader(SequenceRecordReader):
+    """One file per sequence, or one file with blank-line-separated
+    sequences (ref: datavec CSVSequenceRecordReader)."""
+
+    def __init__(self, paths: Union[str, Path, Sequence[Union[str, Path]]],
+                 skip_num_lines: int = 0, delimiter: str = ","):
+        if isinstance(paths, (str, Path)):
+            paths = [paths]
+        self.paths = [str(p) for p in paths]
+        self.skip_num_lines = skip_num_lines
+        self.delimiter = delimiter
+        self._seqs: Optional[List[List[Record]]] = None
+        self._i = 0
+
+    def _load(self):
+        if self._seqs is not None:
+            return
+        seqs: List[List[Record]] = []
+        for p in self.paths:
+            with open(p) as f:
+                lines = [ln.rstrip("\n") for ln in f][self.skip_num_lines:]
+            cur: List[Record] = []
+            multi = any(not ln.strip() for ln in lines)
+            for ln in lines:
+                if not ln.strip():
+                    if cur:
+                        seqs.append(cur)
+                        cur = []
+                    continue
+                cur.append([_parse_field(c)
+                            for c in ln.split(self.delimiter)])
+            if cur:
+                seqs.append(cur)
+            if not multi and not cur and not seqs:
+                seqs.append([])
+        self._seqs = seqs
+
+    def has_next(self):
+        self._load()
+        return self._i < len(self._seqs)
+
+    def next_sequence(self):
+        self._load()
+        s = self._seqs[self._i]
+        self._i += 1
+        return [list(r) for r in s]
+
+    next_record = next_sequence
+
+    def reset(self):
+        self._i = 0
+
+
+class ImageRecordReader(RecordReader):
+    """Images from a labelled directory tree (ref: datavec-data-image
+    ImageRecordReader + ParentPathLabelGenerator): each record is
+    [flattened CHW float array, label index].  Resizes to (height,
+    width); channels 1 = grayscale, 3 = RGB."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_from_parent_dir: bool = True):
+        self.height = height
+        self.width = width
+        self.channels = channels
+        self.label_from_parent_dir = label_from_parent_dir
+        self.labels: List[str] = []
+        self._files: List[Path] = []
+        self._i = 0
+
+    EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".pgm", ".npy"}
+
+    def initialize(self, root: Union[str, Path]) -> "ImageRecordReader":
+        root = Path(root)
+        self._files = sorted(p for p in root.rglob("*")
+                             if p.suffix.lower() in self.EXTS)
+        if self.label_from_parent_dir:
+            self.labels = sorted({p.parent.name for p in self._files})
+        self._i = 0
+        return self
+
+    def _load_image(self, path: Path) -> np.ndarray:
+        if path.suffix.lower() == ".npy":
+            arr = np.load(path)
+            if arr.ndim == 2:
+                arr = arr[None]
+            elif arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+                arr = arr.transpose(2, 0, 1)
+        else:
+            from PIL import Image
+            with Image.open(path) as im:
+                im = im.convert("L" if self.channels == 1 else "RGB")
+                im = im.resize((self.width, self.height))
+                arr = np.asarray(im, np.float32)
+            arr = arr[None] if arr.ndim == 2 else arr.transpose(2, 0, 1)
+        # pad/trim channels, then resize check
+        arr = arr[:self.channels]
+        if arr.shape != (self.channels, self.height, self.width):
+            out = np.zeros((self.channels, self.height, self.width),
+                           np.float32)
+            c = min(arr.shape[0], self.channels)
+            h = min(arr.shape[1], self.height)
+            w = min(arr.shape[2], self.width)
+            out[:c, :h, :w] = arr[:c, :h, :w]
+            arr = out
+        return arr.astype(np.float32)
+
+    def has_next(self):
+        return self._i < len(self._files)
+
+    def next_record(self):
+        p = self._files[self._i]
+        self._i += 1
+        img = self._load_image(p)
+        rec: Record = [img]
+        if self.label_from_parent_dir:
+            rec.append(self.labels.index(p.parent.name))
+        return rec
+
+    def reset(self):
+        self._i = 0
+
+    def num_labels(self) -> int:
+        return len(self.labels)

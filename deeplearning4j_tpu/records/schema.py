@@ -1,0 +1,82 @@
+"""Schema — typed column metadata for transform pipelines
+(ref: datavec-api transform Schema — consumed via the DataVec surface,
+SURVEY.md §2.10)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ColumnMetaData:
+    name: str
+    column_type: str  # Double | Integer | Categorical | String | Time
+    state_names: Optional[List[str]] = None  # for Categorical
+
+
+class Schema:
+    """Builder-style schema (ref: datavec Schema.Builder)."""
+
+    def __init__(self, columns: Optional[List[ColumnMetaData]] = None):
+        self.columns: List[ColumnMetaData] = columns or []
+
+    # -- builder ------------------------------------------------------------
+    class Builder:
+        def __init__(self):
+            self._cols: List[ColumnMetaData] = []
+
+        def add_column_double(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMetaData(name, "Double"))
+            return self
+
+        def add_column_integer(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMetaData(name, "Integer"))
+            return self
+
+        def add_column_string(self, name: str) -> "Schema.Builder":
+            self._cols.append(ColumnMetaData(name, "String"))
+            return self
+
+        def add_column_categorical(self, name: str,
+                                   *state_names: str) -> "Schema.Builder":
+            self._cols.append(
+                ColumnMetaData(name, "Categorical", list(state_names)))
+            return self
+
+        def add_columns_double(self, *names: str) -> "Schema.Builder":
+            for n in names:
+                self.add_column_double(n)
+            return self
+
+        def build(self) -> "Schema":
+            return Schema(list(self._cols))
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+    # -- queries ------------------------------------------------------------
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+    def column_type(self, name: str) -> str:
+        return self.columns[self.index_of(name)].column_type
+
+    # -- serialization ------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps([dataclasses.asdict(c) for c in self.columns])
+
+    @staticmethod
+    def from_json(s: str) -> "Schema":
+        return Schema([ColumnMetaData(**d) for d in json.loads(s)])

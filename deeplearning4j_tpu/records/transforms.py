@@ -1,0 +1,196 @@
+"""TransformProcess — schema-aware record transformation pipeline
+(ref: datavec-api TransformProcess — the ETL step between RecordReader
+and RecordReaderDataSetIterator, SURVEY.md §2.10).
+
+Each operation maps (schema, records) → (schema', records'); the builder
+records the chain, ``execute`` streams records through it on the host
+(ETL stays host-side; devices only ever see the assembled DataSet
+arrays)."""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, List, Optional
+
+from deeplearning4j_tpu.records.schema import ColumnMetaData, Schema
+
+Record = list
+
+
+class TransformProcess:
+    def __init__(self, initial_schema: Schema, ops: List[dict]):
+        self.initial_schema = initial_schema
+        self.ops = ops
+
+    # -- builder ------------------------------------------------------------
+    class Builder:
+        def __init__(self, initial_schema: Schema):
+            self.schema = initial_schema
+            self.ops: List[dict] = []
+
+        def remove_columns(self, *names: str) -> "TransformProcess.Builder":
+            self.ops.append({"op": "remove_columns", "names": list(names)})
+            return self
+
+        def keep_columns(self, *names: str) -> "TransformProcess.Builder":
+            self.ops.append({"op": "keep_columns", "names": list(names)})
+            return self
+
+        def categorical_to_integer(self, *names: str
+                                   ) -> "TransformProcess.Builder":
+            self.ops.append({"op": "categorical_to_integer",
+                             "names": list(names)})
+            return self
+
+        def categorical_to_one_hot(self, *names: str
+                                   ) -> "TransformProcess.Builder":
+            self.ops.append({"op": "categorical_to_one_hot",
+                             "names": list(names)})
+            return self
+
+        def string_to_categorical(self, name: str, state_names: List[str]
+                                  ) -> "TransformProcess.Builder":
+            self.ops.append({"op": "string_to_categorical", "name": name,
+                             "state_names": state_names})
+            return self
+
+        def double_math_op(self, name: str, op: str, scalar: float
+                           ) -> "TransformProcess.Builder":
+            self.ops.append({"op": "double_math_op", "name": name,
+                             "math": op, "scalar": scalar})
+            return self
+
+        def normalize_min_max(self, name: str, mn: float, mx: float
+                              ) -> "TransformProcess.Builder":
+            self.ops.append({"op": "normalize_min_max", "name": name,
+                             "min": mn, "max": mx})
+            return self
+
+        def filter_invalid(self) -> "TransformProcess.Builder":
+            self.ops.append({"op": "filter_invalid"})
+            return self
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self.schema, list(self.ops))
+
+    @staticmethod
+    def builder(initial_schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(initial_schema)
+
+    # -- schema propagation --------------------------------------------------
+    def final_schema(self) -> Schema:
+        schema = self.initial_schema
+        for op in self.ops:
+            schema = self._apply_schema(schema, op)
+        return schema
+
+    @staticmethod
+    def _apply_schema(schema: Schema, op: dict) -> Schema:
+        cols = list(schema.columns)
+        kind = op["op"]
+        if kind == "remove_columns":
+            cols = [c for c in cols if c.name not in op["names"]]
+        elif kind == "keep_columns":
+            cols = [c for c in cols if c.name in op["names"]]
+        elif kind == "categorical_to_integer":
+            cols = [ColumnMetaData(c.name, "Integer")
+                    if c.name in op["names"] else c for c in cols]
+        elif kind == "categorical_to_one_hot":
+            out = []
+            for c in cols:
+                if c.name in op["names"]:
+                    for s in (c.state_names or []):
+                        out.append(ColumnMetaData(f"{c.name}[{s}]", "Double"))
+                else:
+                    out.append(c)
+            cols = out
+        elif kind == "string_to_categorical":
+            cols = [ColumnMetaData(c.name, "Categorical", op["state_names"])
+                    if c.name == op["name"] else c for c in cols]
+        # math / normalize / filter keep the schema
+        return Schema(cols)
+
+    # -- execution ------------------------------------------------------------
+    def execute(self, records: List[Record]) -> List[Record]:
+        schema = self.initial_schema
+        out = [list(r) for r in records]
+        for op in self.ops:
+            out = self._apply_records(schema, out, op)
+            schema = self._apply_schema(schema, op)
+        return out
+
+    @staticmethod
+    def _apply_records(schema: Schema, records: List[Record],
+                       op: dict) -> List[Record]:
+        kind = op["op"]
+        if kind in ("remove_columns", "keep_columns"):
+            keep = [i for i, c in enumerate(schema.columns)
+                    if (c.name in op["names"]) == (kind == "keep_columns")]
+            return [[r[i] for i in keep] for r in records]
+        if kind == "categorical_to_integer":
+            idxs = {schema.index_of(n): schema.columns[schema.index_of(n)]
+                    for n in op["names"]}
+            out = []
+            for r in records:
+                r = list(r)
+                for i, col in idxs.items():
+                    r[i] = (col.state_names or []).index(r[i])
+                out.append(r)
+            return out
+        if kind == "categorical_to_one_hot":
+            out = []
+            for r in records:
+                nr: Record = []
+                for i, c in enumerate(schema.columns):
+                    if c.name in op["names"]:
+                        states = c.state_names or []
+                        hot = [0.0] * len(states)
+                        hot[states.index(r[i])] = 1.0
+                        nr.extend(hot)
+                    else:
+                        nr.append(r[i])
+                out.append(nr)
+            return out
+        if kind == "string_to_categorical":
+            i = schema.index_of(op["name"])
+            for r in records:
+                if r[i] not in op["state_names"]:
+                    raise ValueError(
+                        f"value {r[i]!r} not in states {op['state_names']}")
+            return records
+        if kind == "double_math_op":
+            i = schema.index_of(op["name"])
+            fn: Callable[[float], float] = {
+                "Add": lambda x: x + op["scalar"],
+                "Subtract": lambda x: x - op["scalar"],
+                "Multiply": lambda x: x * op["scalar"],
+                "Divide": lambda x: x / op["scalar"],
+            }[op["math"]]
+            return [[fn(v) if j == i else v for j, v in enumerate(r)]
+                    for r in records]
+        if kind == "normalize_min_max":
+            i = schema.index_of(op["name"])
+            rng = op["max"] - op["min"] or 1.0
+            return [[(v - op["min"]) / rng if j == i else v
+                     for j, v in enumerate(r)] for r in records]
+        if kind == "filter_invalid":
+            def ok(r):
+                for v, c in zip(r, schema.columns):
+                    if c.column_type in ("Double", "Integer"):
+                        if not isinstance(v, (int, float)):
+                            return False
+                        if v != v:  # NaN
+                            return False
+                return True
+            return [r for r in records if ok(r)]
+        raise ValueError(f"unknown op {kind}")
+
+    # -- serialization (ref: TransformProcess.toJson) -------------------------
+    def to_json(self) -> str:
+        return json.dumps({"schema": self.initial_schema.to_json(),
+                           "ops": self.ops})
+
+    @staticmethod
+    def from_json(s: str) -> "TransformProcess":
+        d = json.loads(s)
+        return TransformProcess(Schema.from_json(d["schema"]), d["ops"])

@@ -81,10 +81,16 @@ def batch_and_export(datasets: Iterable[DataSet], out_dir: Union[str, Path],
 
 class PathDataSetIterator(DataSetIterator):
     """Streams DataSets from exported files
-    (ref: spark/iterator/PathSparkDataSetIterator.java)."""
+    (ref: spark/iterator/PathSparkDataSetIterator.java).  With
+    ``prefetch=True`` file reads run ahead on the native threaded
+    prefetcher (native/dl4j_io.cc), decoding on the consumer thread."""
 
-    def __init__(self, paths: Sequence[Union[str, Path]]):
+    def __init__(self, paths: Sequence[Union[str, Path]],
+                 prefetch: bool = False, prefetch_capacity: int = 4):
         self.paths = [str(p) for p in paths]
+        self.prefetch = prefetch
+        self.prefetch_capacity = prefetch_capacity
+        self._stream = None
         self._i = 0
 
     @staticmethod
@@ -97,12 +103,25 @@ class PathDataSetIterator(DataSetIterator):
         return self._i < len(self.paths)
 
     def next(self) -> DataSet:
+        if self.prefetch:
+            if self._stream is None:
+                from deeplearning4j_tpu.native import NativeFilePrefetcher
+                from deeplearning4j_tpu.native.io import load_npz_dataset_bytes
+                self._decode = load_npz_dataset_bytes
+                self._stream = iter(NativeFilePrefetcher(
+                    self.paths[self._i:], capacity=self.prefetch_capacity))
+            path, blob = next(self._stream)
+            if not blob:  # native reader signals failure with empty blob
+                raise FileNotFoundError(f"unreadable dataset file: {path}")
+            self._i += 1
+            return self._decode(blob)
         ds = load_dataset(self.paths[self._i])
         self._i += 1
         return ds
 
     def reset(self) -> None:
         self._i = 0
+        self._stream = None
 
 
 def repartition_balanced(items: Sequence, n_partitions: int) -> List[List]:

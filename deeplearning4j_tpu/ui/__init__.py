@@ -1,0 +1,17 @@
+"""Training visualization stack (SURVEY.md §2.2 StatsStorage, §5
+metrics/observability): StatsListener → StatsStorage (pub/sub) →
+UIServer web dashboard — the reference's deeplearning4j-ui-parent tier
+(Play server + SBE wire format + MapDB/SQLite storage) rebuilt on
+stdlib HTTP + JSON + sqlite3."""
+
+from deeplearning4j_tpu.ui.stats_storage import (
+    FileStatsStorage, InMemoryStatsStorage, RemoteUIStatsStorageRouter,
+    SqliteStatsStorage, StatsStorage, StatsStorageEvent, StatsStorageRouter)
+from deeplearning4j_tpu.ui.stats_listener import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.ui_server import UIServer
+
+__all__ = [
+    "FileStatsStorage", "InMemoryStatsStorage", "RemoteUIStatsStorageRouter",
+    "SqliteStatsStorage", "StatsStorage", "StatsStorageEvent",
+    "StatsStorageRouter", "StatsListener", "StatsReport", "UIServer",
+]

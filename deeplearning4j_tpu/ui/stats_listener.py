@@ -1,0 +1,153 @@
+"""StatsListener — per-iteration training telemetry
+(ref: deeplearning4j-ui-model/.../ui/stats/BaseStatsListener.java:44,297
+— captures score, param/gradient/update histograms & summary stats,
+memory, GC, timing; static info: model conf, hardware/software).
+
+The reference walks the flat param view per layer; here the params
+pytree is walked per layer/param name — same report schema, pytree
+edition.  Reports post to any StatsStorageRouter (local storage or the
+remote HTTP router)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import resource
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.ui.stats_storage import StatsStorageRouter
+
+TYPE_ID = "StatsListener"  # (ref: BaseStatsListener.TYPE_ID)
+
+
+def _summary(arr: np.ndarray, bins: int = 20) -> dict:
+    a = np.asarray(arr, np.float64).reshape(-1)
+    if a.size == 0:
+        return {}
+    hist, edges = np.histogram(a, bins=bins)
+    return {
+        "mean": float(a.mean()),
+        "stdev": float(a.std()),
+        "min": float(a.min()),
+        "max": float(a.max()),
+        "mean_magnitude": float(np.abs(a).mean()),
+        "histogram": {"counts": hist.tolist(),
+                      "min": float(edges[0]), "max": float(edges[-1])},
+    }
+
+
+@dataclasses.dataclass
+class StatsReport:
+    """One iteration's record (ref: ui/stats/impl/SbeStatsReport.java —
+    JSON instead of SBE)."""
+
+    session_id: str
+    worker_id: str
+    timestamp: int
+    iteration: int
+    score: float
+    params: Dict[str, dict]
+    gradients: Dict[str, dict]
+    updates: Dict[str, dict]
+    perf: dict
+    memory: dict
+
+    def to_record(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["type_id"] = TYPE_ID
+        return d
+
+
+class StatsListener(IterationListener):
+    """(ref: ui/stats/StatsListener.java + BaseStatsListener.java)
+
+    update_frequency: post every N iterations.  Histograms of parameters
+    and parameter *updates* (deltas between posts) are collected when
+    collect_histograms; gradients are approximated by updates at the
+    engine level (the jitted step applies updates in-place — the
+    reference's separate gradient capture corresponds to the pre-LR
+    update view)."""
+
+    def __init__(self, router: StatsStorageRouter, update_frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 worker_id: Optional[str] = None,
+                 collect_histograms: bool = True):
+        self.router = router
+        self.update_frequency = max(1, update_frequency)
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        self.worker_id = worker_id or f"pid-{os.getpid()}"
+        self.collect_histograms = collect_histograms
+        self._last_params: Optional[List[dict]] = None
+        self._last_time: Optional[float] = None
+        self._static_posted = False
+
+    # -- static info (ref: BaseStatsListener initial report) ---------------
+    def _post_static(self, model) -> None:
+        import jax
+        record = {
+            "session_id": self.session_id,
+            "type_id": TYPE_ID,
+            "worker_id": self.worker_id,
+            "timestamp": int(time.time() * 1000),
+            "model_class": type(model).__name__,
+            "model_config": model.conf.to_json(),
+            "n_params": int(model.num_params()),
+            "backend": jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+        }
+        self.router.put_static_info(record)
+        self._static_posted = True
+
+    def _param_tree(self, model) -> Dict[str, np.ndarray]:
+        out = {}
+        tree = model.net_params
+        if isinstance(tree, dict):  # ComputationGraph: name → params
+            items = tree.items()
+        else:  # MultiLayerNetwork: list of per-layer dicts
+            items = ((str(i), p) for i, p in enumerate(tree))
+        for name, p in items:
+            if not p:
+                continue
+            for k, v in p.items():
+                out[f"{name}_{k}"] = np.asarray(v)
+        return out
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if not self._static_posted:
+            self._post_static(model)
+        now = time.perf_counter()
+        cur = self._param_tree(model) if self.collect_histograms else {}
+        if iteration % self.update_frequency == 0:
+            params = {k: _summary(v) for k, v in cur.items()}
+            updates, grads = {}, {}
+            if self._last_params is not None:
+                for k, v in cur.items():
+                    if k in self._last_params:
+                        delta = v - self._last_params[k]
+                        s = _summary(delta)
+                        updates[k] = s
+                        grads[k] = s  # post-LR update ≈ scaled gradient
+            dt = (now - self._last_time) if self._last_time else 0.0
+            batch = getattr(model, "last_batch_size", 0)
+            rss_mb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+            report = StatsReport(
+                session_id=self.session_id, worker_id=self.worker_id,
+                timestamp=int(time.time() * 1000), iteration=iteration,
+                score=float(model.score()),
+                params=params, gradients=grads, updates=updates,
+                perf={
+                    "duration_ms": dt * 1000.0,
+                    "samples_per_sec": batch / dt if dt > 0 else 0.0,
+                    "batches_per_sec": 1.0 / dt if dt > 0 else 0.0,
+                    "total_minibatches": iteration,
+                },
+                memory={"host_rss_mb": rss_mb})
+            self.router.put_update(report.to_record())
+        self._last_params = cur if self.collect_histograms else None
+        self._last_time = now

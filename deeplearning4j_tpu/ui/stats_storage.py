@@ -1,0 +1,276 @@
+"""StatsStorage — pub/sub persistence for training stats
+(ref: deeplearning4j-core/.../api/storage/StatsStorage.java:30,
+StatsStorageRouter.java, StatsStorageListener.java;
+impls: deeplearning4j-ui-model/.../ui/storage/InMemoryStatsStorage.java,
+FileStatsStorage.java, mapdb/MapDBStatsStorage.java, sqlite
+J7FileStatsStorage; remote: deeplearning4j-core/.../impl/
+RemoteUIStatsStorageRouter.java).
+
+Records are keyed (session_id, type_id, worker_id, timestamp) exactly as
+the reference keys its Persistables; static infos are keyed without the
+timestamp.  The SBE wire encoding is replaced by JSON — the schema, not
+the byte layout, is the capability."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sqlite3
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class StatsStorageEvent:
+    """(ref: api/storage/StatsStorageEvent.java; event types in
+    StatsStorageListener.EventType)"""
+
+    event_type: str  # NewSessionID | NewTypeID | NewWorkerID | PostStaticInfo | PostUpdate
+    session_id: str
+    type_id: str
+    worker_id: str
+    timestamp: int
+
+
+class StatsStorageRouter:
+    """Write side (ref: api/storage/StatsStorageRouter.java)."""
+
+    def put_static_info(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def put_update(self, record: dict) -> None:
+        raise NotImplementedError
+
+
+class StatsStorage(StatsStorageRouter):
+    """Read+write+listen (ref: api/storage/StatsStorage.java)."""
+
+    # -- read side ----------------------------------------------------------
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_type_ids_for_session(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def list_worker_ids_for_session(self, session_id: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_static_info(self, session_id: str, type_id: str,
+                        worker_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def get_all_updates_after(self, session_id: str, type_id: str,
+                              worker_id: str, timestamp: int) -> List[dict]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str, type_id: str,
+                          worker_id: str) -> Optional[dict]:
+        updates = self.get_all_updates_after(session_id, type_id, worker_id, -1)
+        return updates[-1] if updates else None
+
+    # -- listeners ----------------------------------------------------------
+    def __init__(self):
+        self._listeners: List[Callable[[StatsStorageEvent], None]] = []
+        self._lock = threading.Lock()
+
+    def register_stats_storage_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def deregister_stats_storage_listener(self, fn) -> None:
+        self._listeners.remove(fn)
+
+    def _notify(self, *events: StatsStorageEvent) -> None:
+        for fn in list(self._listeners):
+            for e in events:
+                fn(e)
+
+    def _events_for(self, record: dict, kind: str,
+                    is_new: Tuple[bool, bool, bool]) -> List[StatsStorageEvent]:
+        sid, tid, wid = (record["session_id"], record["type_id"],
+                         record["worker_id"])
+        ts = record.get("timestamp", 0)
+        ev = []
+        if is_new[0]:
+            ev.append(StatsStorageEvent("NewSessionID", sid, tid, wid, ts))
+        if is_new[1]:
+            ev.append(StatsStorageEvent("NewTypeID", sid, tid, wid, ts))
+        if is_new[2]:
+            ev.append(StatsStorageEvent("NewWorkerID", sid, tid, wid, ts))
+        ev.append(StatsStorageEvent(kind, sid, tid, wid, ts))
+        return ev
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """(ref: ui/storage/InMemoryStatsStorage.java)"""
+
+    def __init__(self):
+        super().__init__()
+        self._static: Dict[Tuple[str, str, str], dict] = {}
+        self._updates: Dict[Tuple[str, str, str], List[dict]] = {}
+
+    def _newness(self, sid, tid, wid):
+        keys = list(self._static) + list(self._updates)
+        return (all(k[0] != sid for k in keys),
+                all(k[:2] != (sid, tid) for k in keys),
+                all(k != (sid, tid, wid) for k in keys))
+
+    def put_static_info(self, record: dict) -> None:
+        key = (record["session_id"], record["type_id"], record["worker_id"])
+        with self._lock:
+            new = self._newness(*key)
+            self._static[key] = record
+        self._notify(*self._events_for(record, "PostStaticInfo", new))
+
+    def put_update(self, record: dict) -> None:
+        key = (record["session_id"], record["type_id"], record["worker_id"])
+        with self._lock:
+            new = self._newness(*key)
+            self._updates.setdefault(key, []).append(record)
+        self._notify(*self._events_for(record, "PostUpdate", new))
+
+    def list_session_ids(self):
+        return sorted({k[0] for k in list(self._static) + list(self._updates)})
+
+    def list_type_ids_for_session(self, session_id):
+        return sorted({k[1] for k in list(self._static) + list(self._updates)
+                       if k[0] == session_id})
+
+    def list_worker_ids_for_session(self, session_id):
+        return sorted({k[2] for k in list(self._static) + list(self._updates)
+                       if k[0] == session_id})
+
+    def get_static_info(self, session_id, type_id, worker_id):
+        return self._static.get((session_id, type_id, worker_id))
+
+    def get_all_updates_after(self, session_id, type_id, worker_id, timestamp):
+        ups = self._updates.get((session_id, type_id, worker_id), [])
+        return [u for u in ups if u.get("timestamp", 0) > timestamp]
+
+
+class SqliteStatsStorage(StatsStorage):
+    """Persistent storage on sqlite3 — the role of both
+    MapDBStatsStorage and the reference's J7 SQLite backend
+    (ref: ui/storage/mapdb/MapDBStatsStorage.java)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS static_info ("
+                "session_id TEXT, type_id TEXT, worker_id TEXT, "
+                "record TEXT, PRIMARY KEY (session_id, type_id, worker_id))")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS updates ("
+                "session_id TEXT, type_id TEXT, worker_id TEXT, "
+                "timestamp INTEGER, record TEXT)")
+            self._conn.commit()
+
+    def _newness(self, sid, tid, wid):
+        cur = self._conn.execute(
+            "SELECT "
+            "EXISTS(SELECT 1 FROM updates WHERE session_id=? UNION "
+            "       SELECT 1 FROM static_info WHERE session_id=?),"
+            "EXISTS(SELECT 1 FROM updates WHERE session_id=? AND type_id=? "
+            "UNION SELECT 1 FROM static_info WHERE session_id=? AND type_id=?),"
+            "EXISTS(SELECT 1 FROM updates WHERE session_id=? AND type_id=? "
+            "AND worker_id=? UNION SELECT 1 FROM static_info WHERE "
+            "session_id=? AND type_id=? AND worker_id=?)",
+            (sid, sid, sid, tid, sid, tid, sid, tid, wid, sid, tid, wid))
+        a, b, c = cur.fetchone()
+        return (not a, not b, not c)
+
+    def put_static_info(self, record: dict) -> None:
+        key = (record["session_id"], record["type_id"], record["worker_id"])
+        with self._lock:
+            new = self._newness(*key)
+            self._conn.execute(
+                "INSERT OR REPLACE INTO static_info VALUES (?,?,?,?)",
+                (*key, json.dumps(record)))
+            self._conn.commit()
+        self._notify(*self._events_for(record, "PostStaticInfo", new))
+
+    def put_update(self, record: dict) -> None:
+        key = (record["session_id"], record["type_id"], record["worker_id"])
+        with self._lock:
+            new = self._newness(*key)
+            self._conn.execute(
+                "INSERT INTO updates VALUES (?,?,?,?,?)",
+                (*key, record.get("timestamp", 0), json.dumps(record)))
+            self._conn.commit()
+        self._notify(*self._events_for(record, "PostUpdate", new))
+
+    def list_session_ids(self):
+        cur = self._conn.execute(
+            "SELECT DISTINCT session_id FROM updates UNION "
+            "SELECT DISTINCT session_id FROM static_info")
+        return sorted(r[0] for r in cur.fetchall())
+
+    def list_type_ids_for_session(self, session_id):
+        cur = self._conn.execute(
+            "SELECT DISTINCT type_id FROM updates WHERE session_id=? UNION "
+            "SELECT DISTINCT type_id FROM static_info WHERE session_id=?",
+            (session_id, session_id))
+        return sorted(r[0] for r in cur.fetchall())
+
+    def list_worker_ids_for_session(self, session_id):
+        cur = self._conn.execute(
+            "SELECT DISTINCT worker_id FROM updates WHERE session_id=? UNION "
+            "SELECT DISTINCT worker_id FROM static_info WHERE session_id=?",
+            (session_id, session_id))
+        return sorted(r[0] for r in cur.fetchall())
+
+    def get_static_info(self, session_id, type_id, worker_id):
+        cur = self._conn.execute(
+            "SELECT record FROM static_info WHERE session_id=? AND type_id=? "
+            "AND worker_id=?", (session_id, type_id, worker_id))
+        row = cur.fetchone()
+        return json.loads(row[0]) if row else None
+
+    def get_all_updates_after(self, session_id, type_id, worker_id, timestamp):
+        cur = self._conn.execute(
+            "SELECT record FROM updates WHERE session_id=? AND type_id=? AND "
+            "worker_id=? AND timestamp>? ORDER BY timestamp",
+            (session_id, type_id, worker_id, timestamp))
+        return [json.loads(r[0]) for r in cur.fetchall()]
+
+    def close(self):
+        self._conn.close()
+
+
+# FileStatsStorage: same persistent contract, single-file — alias the
+# sqlite implementation (ref: ui/storage/FileStatsStorage.java).
+FileStatsStorage = SqliteStatsStorage
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """POSTs records to a remote UIServer
+    (ref: deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java —
+    async HTTP posting with retry; endpoint served by UIServer's
+    /remoteReceive)."""
+
+    def __init__(self, address: str, retry_count: int = 3):
+        self.address = address.rstrip("/")
+        self.retry_count = retry_count
+
+    def _post(self, kind: str, record: dict) -> None:
+        payload = json.dumps({"kind": kind, "record": record}).encode()
+        last = None
+        for _ in range(self.retry_count):
+            try:
+                req = urllib.request.Request(
+                    self.address + "/remoteReceive", data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10):
+                    return
+            except Exception as e:
+                last = e
+        raise ConnectionError(f"remote UI post failed: {last}")
+
+    def put_static_info(self, record: dict) -> None:
+        self._post("static", record)
+
+    def put_update(self, record: dict) -> None:
+        self._post("update", record)

@@ -1,0 +1,244 @@
+"""UIServer — the training dashboard
+(ref: deeplearning4j-ui-parent/deeplearning4j-play/.../ui/play/
+PlayUIServer.java:53 (port 9000 :60), module pages
+ui/module/train/TrainModule.java:53 — overview / model / system tabs;
+remote posting endpoint consumed by RemoteUIStatsStorageRouter).
+
+Play framework + SBE is replaced by stdlib http.server + JSON; the
+dashboard is one self-contained HTML page (inline SVG charts, no
+external assets — the environment has zero egress and so must the
+browser).  Endpoints:
+
+  GET  /                       dashboard HTML
+  GET  /train/sessions         {"sessions": [...]}
+  GET  /train/overview?sid=    score vs iteration + perf + memory
+  GET  /train/model?sid=       per-layer param/update summary stats
+  GET  /train/system?sid=      static info + memory timeline
+  POST /remoteReceive          remote stats ingestion
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats_listener import TYPE_ID
+from deeplearning4j_tpu.ui.stats_storage import (
+    InMemoryStatsStorage, StatsStorage)
+
+_DASHBOARD_HTML = """<!DOCTYPE html><html><head><meta charset="utf-8">
+<title>DL4J-TPU Training UI</title><style>
+body{font-family:sans-serif;margin:0;background:#fafafa}
+header{background:#2c3e50;color:#fff;padding:10px 20px}
+nav button{margin-right:8px;padding:6px 14px;border:0;background:#3b5168;
+color:#fff;cursor:pointer}nav button.active{background:#1abc9c}
+main{padding:20px}.card{background:#fff;border:1px solid #ddd;
+border-radius:4px;padding:14px;margin-bottom:16px}
+h3{margin-top:0}svg{width:100%;height:220px}
+table{border-collapse:collapse;font-size:13px}
+td,th{border:1px solid #ddd;padding:4px 8px;text-align:right}
+th:first-child,td:first-child{text-align:left}
+</style></head><body>
+<header><b>deeplearning4j_tpu</b> — Training UI
+<select id="session"></select></header>
+<nav style="padding:8px 20px;background:#34495e">
+<button data-tab="overview" class="active">Overview</button>
+<button data-tab="model">Model</button>
+<button data-tab="system">System</button></nav>
+<main id="main"></main>
+<script>
+let tab='overview', sid=null;
+function line(points,color){if(!points.length)return '';
+ const xs=points.map(p=>p[0]),ys=points.map(p=>p[1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs)||1;
+ const y0=Math.min(...ys),y1=Math.max(...ys)||1;
+ const W=800,H=200,pad=30;
+ const px=x=>pad+(x-x0)/(x1-x0||1)*(W-2*pad);
+ const py=y=>H-pad-(y-y0)/(y1-y0||1)*(H-2*pad);
+ const d=points.map((p,i)=>(i?'L':'M')+px(p[0]).toFixed(1)+','+py(p[1]).toFixed(1)).join(' ');
+ return `<svg viewBox="0 0 ${W} ${H}"><path d="${d}" fill="none" stroke="${color}" stroke-width="2"/>
+ <text x="${pad}" y="12" font-size="11">max ${y1.toPrecision(4)}</text>
+ <text x="${pad}" y="${H-8}" font-size="11">min ${y0.toPrecision(4)}</text></svg>`;}
+async function j(u){return (await fetch(u)).json();}
+async function render(){
+ const m=document.getElementById('main');
+ if(!sid){m.innerHTML='<p>no sessions yet</p>';return;}
+ if(tab=='overview'){const d=await j('/train/overview?sid='+sid);
+  m.innerHTML=`<div class="card"><h3>Score vs iteration</h3>${line(d.score,'#e74c3c')}</div>
+  <div class="card"><h3>Samples/sec</h3>${line(d.samples_per_sec,'#2980b9')}</div>`;}
+ else if(tab=='model'){const d=await j('/train/model?sid='+sid);
+  let rows=d.layers.map(l=>`<tr><td>${l.name}</td><td>${l.mean?.toPrecision(4)??''}</td>
+  <td>${l.stdev?.toPrecision(4)??''}</td><td>${l.mean_magnitude?.toPrecision(4)??''}</td>
+  <td>${l.update_magnitude?.toPrecision(4)??''}</td></tr>`).join('');
+  m.innerHTML=`<div class="card"><h3>Parameters (latest)</h3>
+  <table><tr><th>param</th><th>mean</th><th>stdev</th><th>|mean|</th><th>|update|</th></tr>${rows}</table></div>`;}
+ else{const d=await j('/train/system?sid='+sid);
+  m.innerHTML=`<div class="card"><h3>Host RSS (MB)</h3>${line(d.memory,'#8e44ad')}</div>
+  <div class="card"><h3>Static info</h3><pre>${JSON.stringify(d.static,null,2)}</pre></div>`;}
+}
+async function refreshSessions(){const d=await j('/train/sessions');
+ const sel=document.getElementById('session');
+ if(d.sessions.length&&sel.options.length!=d.sessions.length){
+  sel.innerHTML=d.sessions.map(s=>`<option>${s}</option>`).join('');}
+ sid=sel.value||d.sessions[0];}
+document.querySelectorAll('nav button').forEach(b=>b.onclick=()=>{
+ tab=b.dataset.tab;document.querySelectorAll('nav button').forEach(x=>
+ x.classList.toggle('active',x===b));render();});
+document.getElementById('session').onchange=e=>{sid=e.target.value;render();};
+setInterval(async()=>{await refreshSessions();await render();},2000);
+refreshSessions().then(render);
+</script></body></html>"""
+
+
+class UIServer:
+    """(ref: ui/play/PlayUIServer.java — getInstance/attach pattern via
+    api/UIServer.java)"""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._storages: List[StatsStorage] = []
+        self._remote_storage = InMemoryStatsStorage()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _send(self, code, payload: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _json(self, obj):
+                self._send(200, json.dumps(obj).encode())
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                sid = q.get("sid", [None])[0]
+                try:
+                    if u.path == "/":
+                        self._send(200, _DASHBOARD_HTML.encode(),
+                                   "text/html; charset=utf-8")
+                    elif u.path == "/train/sessions":
+                        self._json({"sessions": server._session_ids()})
+                    elif u.path == "/train/overview":
+                        self._json(server._overview(sid))
+                    elif u.path == "/train/model":
+                        self._json(server._model(sid))
+                    elif u.path == "/train/system":
+                        self._json(server._system(sid))
+                    else:
+                        self._send(404, b'{"error":"not found"}')
+                except Exception as e:
+                    self._send(500, json.dumps({"error": str(e)}).encode())
+
+            def do_POST(self):
+                if self.path != "/remoteReceive":
+                    self._send(404, b'{"error":"not found"}')
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                record = body["record"]
+                if body.get("kind") == "static":
+                    server._remote_storage.put_static_info(record)
+                else:
+                    server._remote_storage.put_update(record)
+                self._json({"ok": True})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- lifecycle (ref: UIServer.getInstance / attach / detach) -----------
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        self._storages.remove(storage)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    # -- data assembly ------------------------------------------------------
+    def _all_storages(self) -> List[StatsStorage]:
+        return self._storages + [self._remote_storage]
+
+    def _session_ids(self) -> List[str]:
+        out: List[str] = []
+        for st in self._all_storages():
+            out.extend(st.list_session_ids())
+        return sorted(set(out))
+
+    def _updates(self, sid: Optional[str]) -> List[dict]:
+        if sid is None:
+            return []
+        ups: List[dict] = []
+        for st in self._all_storages():
+            for wid in st.list_worker_ids_for_session(sid):
+                ups.extend(st.get_all_updates_after(sid, TYPE_ID, wid, -1))
+        ups.sort(key=lambda r: (r.get("iteration", 0),
+                                r.get("timestamp", 0)))
+        return ups
+
+    def _static(self, sid: Optional[str]) -> Optional[dict]:
+        if sid is None:
+            return None
+        for st in self._all_storages():
+            for wid in st.list_worker_ids_for_session(sid):
+                info = st.get_static_info(sid, TYPE_ID, wid)
+                if info:
+                    return info
+        return None
+
+    def _overview(self, sid) -> dict:
+        ups = self._updates(sid)
+        return {
+            "score": [[u["iteration"], u["score"]] for u in ups],
+            "samples_per_sec": [[u["iteration"],
+                                 u["perf"]["samples_per_sec"]] for u in ups],
+            "duration_ms": [[u["iteration"], u["perf"]["duration_ms"]]
+                            for u in ups],
+        }
+
+    def _model(self, sid) -> dict:
+        ups = self._updates(sid)
+        if not ups:
+            return {"layers": []}
+        latest = ups[-1]
+        layers = []
+        for name, s in latest.get("params", {}).items():
+            upd = latest.get("updates", {}).get(name, {})
+            layers.append({
+                "name": name,
+                "mean": s.get("mean"), "stdev": s.get("stdev"),
+                "mean_magnitude": s.get("mean_magnitude"),
+                "update_magnitude": upd.get("mean_magnitude"),
+                "histogram": s.get("histogram"),
+            })
+        return {"layers": layers}
+
+    def _system(self, sid) -> dict:
+        ups = self._updates(sid)
+        return {
+            "memory": [[u["iteration"], u["memory"]["host_rss_mb"]]
+                       for u in ups],
+            "static": self._static(sid),
+        }

@@ -1,0 +1,320 @@
+// dl4j_io — native host-runtime library for deeplearning4j_tpu.
+//
+// The reference's native tier is libnd4j (C++ math kernels) plus
+// JavaCPP-bridged cuDNN/HDF5/Aeron (SURVEY.md §2.3/§2.10).  On TPU the
+// math tier is XLA behind PJRT; what remains genuinely native on the
+// host side is the data path — the role DataVec + AsyncDataSetIterator's
+// prefetch thread play (ref: datasets/iterator/AsyncDataSetIterator.java:39-127)
+// — and arena staging buffers (ref: MemoryWorkspace, nn/conf/WorkspaceMode.java).
+//
+// Exposed C ABI (consumed from Python via ctypes, no pybind11 in image):
+//   CSV  : csv_dims / csv_read        — fast numeric CSV → float32 matrix
+//   IDX  : idx_dims / idx_read        — MNIST IDX (big-endian) → float32
+//   Fetch: prefetch_open/next/close   — threaded file read-ahead queue
+//   Arena: arena_create/alloc/reset/destroy — 64B-aligned bump allocator
+//
+// Build: native/Makefile → deeplearning4j_tpu/native/libdl4j_io.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <limits>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CSV: one pass to size, one pass to fill caller-provided memory.
+// Non-numeric fields parse as NaN (the transform pipeline's filter_invalid
+// handles them); empty lines are skipped.
+
+static bool read_file(const char* path, std::string* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  f.seekg(0, std::ios::end);
+  out->resize(static_cast<size_t>(f.tellg()));
+  f.seekg(0);
+  f.read(&(*out)[0], static_cast<std::streamsize>(out->size()));
+  return true;
+}
+
+int csv_dims(const char* path, char delim, int skip_lines, long* rows,
+             long* cols) {
+  std::string data;
+  if (!read_file(path, &data)) return -1;
+  long r = 0, c = 0, cur_cols = 1;
+  bool in_line = false;
+  int skipped = 0;
+  for (size_t i = 0; i < data.size(); ++i) {
+    char ch = data[i];
+    if (ch == '\n') {
+      if (skipped < skip_lines) {
+        ++skipped;
+      } else if (in_line) {
+        ++r;
+        if (cur_cols > c) c = cur_cols;
+      }
+      cur_cols = 1;
+      in_line = false;
+    } else if (ch == delim) {
+      if (skipped >= skip_lines) ++cur_cols;
+      in_line = true;
+    } else if (ch != '\r') {
+      in_line = true;
+    }
+  }
+  if (in_line && skipped >= skip_lines) {
+    ++r;
+    if (cur_cols > c) c = cur_cols;
+  }
+  *rows = r;
+  *cols = c;
+  return 0;
+}
+
+int csv_read(const char* path, char delim, int skip_lines, float* out,
+             long rows, long cols) {
+  std::string data;
+  if (!read_file(path, &data)) return -1;
+  long r = 0;
+  int skipped = 0;
+  size_t i = 0, n = data.size();
+  while (i < n && r < rows) {
+    // one line
+    size_t line_end = data.find('\n', i);
+    if (line_end == std::string::npos) line_end = n;
+    if (skipped < skip_lines) {
+      ++skipped;
+      i = line_end + 1;
+      continue;
+    }
+    // skip blank lines
+    bool blank = true;
+    for (size_t j = i; j < line_end; ++j)
+      if (data[j] != '\r' && data[j] != ' ') { blank = false; break; }
+    if (blank) {
+      i = line_end + 1;
+      continue;
+    }
+    long c = 0;
+    size_t field_start = i;
+    for (size_t j = i; j <= line_end && c < cols; ++j) {
+      if (j == line_end || data[j] == delim) {
+        char* endp = nullptr;
+        const char* s = data.data() + field_start;
+        float v = strtof(s, &endp);
+        bool numeric = endp != s;
+        out[r * cols + c] =
+            numeric ? v : std::numeric_limits<float>::quiet_NaN();
+        ++c;
+        field_start = j + 1;
+      }
+    }
+    for (; c < cols; ++c)
+      out[r * cols + c] = std::numeric_limits<float>::quiet_NaN();
+    ++r;
+    i = line_end + 1;
+  }
+  return static_cast<int>(r);
+}
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST) files: magic [0, 0, dtype, ndim] then big-endian dims.
+
+static uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+int idx_dims(const char* path, long* ndim, long* dims /* up to 4 */) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return -1;
+  unsigned char hdr[4];
+  f.read(reinterpret_cast<char*>(hdr), 4);
+  if (!f || hdr[0] != 0 || hdr[1] != 0) return -2;
+  int nd = hdr[3];
+  if (nd < 1 || nd > 4) return -3;
+  *ndim = nd;
+  for (int d = 0; d < nd; ++d) {
+    unsigned char b[4];
+    f.read(reinterpret_cast<char*>(b), 4);
+    if (!f) return -4;
+    dims[d] = be32(b);
+  }
+  return hdr[2];  // dtype code: 0x08 ubyte, 0x0D float
+}
+
+int idx_read(const char* path, float* out, long count) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return -1;
+  unsigned char hdr[4];
+  f.read(reinterpret_cast<char*>(hdr), 4);
+  int nd = hdr[3];
+  f.seekg(4 + 4 * nd);
+  if (hdr[2] == 0x08) {
+    std::vector<unsigned char> buf(static_cast<size_t>(count));
+    f.read(reinterpret_cast<char*>(buf.data()), count);
+    if (!f) return -4;
+    for (long i = 0; i < count; ++i) out[i] = float(buf[i]);
+  } else if (hdr[2] == 0x0D) {
+    std::vector<unsigned char> buf(static_cast<size_t>(count) * 4);
+    f.read(reinterpret_cast<char*>(buf.data()), count * 4);
+    if (!f) return -4;
+    for (long i = 0; i < count; ++i) {
+      uint32_t u = be32(buf.data() + 4 * i);
+      float v;
+      memcpy(&v, &u, 4);
+      out[i] = v;
+    }
+  } else {
+    return -3;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded file prefetcher: N reader threads pull paths from a work list
+// and push (index, bytes) blobs into a bounded queue — the native
+// realization of AsyncDataSetIterator's prefetch thread + BlockingQueue
+// (ref: AsyncDataSetIterator.java:41).  Results are re-ordered so the
+// consumer sees files in submission order.
+
+struct Prefetcher {
+  std::vector<std::string> paths;
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  // completed blobs keyed by sequence index
+  std::vector<std::string*> done;
+  size_t next_to_read = 0;   // next path index for workers
+  size_t next_to_emit = 0;   // next index the consumer receives
+  size_t buffered = 0;
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+
+  ~Prefetcher() {
+    stop.store(true);
+    cv_put.notify_all();
+    cv_get.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    for (auto* s : done) delete s;
+  }
+
+  void work() {
+    for (;;) {
+      size_t idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        if (stop.load() || next_to_read >= paths.size()) return;
+        idx = next_to_read++;
+      }
+      auto* blob = new std::string();
+      read_file(paths[idx].c_str(), blob);  // empty blob on failure
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] {
+          return stop.load() || idx < next_to_emit + capacity;
+        });
+        if (stop.load()) {
+          delete blob;
+          return;
+        }
+        done[idx] = blob;
+        ++buffered;
+      }
+      cv_get.notify_all();
+    }
+  }
+};
+
+void* prefetch_open(const char** paths, long n_paths, long capacity,
+                    long n_threads) {
+  auto* p = new Prefetcher();
+  p->paths.assign(paths, paths + n_paths);
+  p->capacity = static_cast<size_t>(capacity < 1 ? 1 : capacity);
+  p->done.assign(p->paths.size(), nullptr);
+  long nt = n_threads < 1 ? 1 : n_threads;
+  for (long i = 0; i < nt; ++i)
+    p->workers.emplace_back([p] { p->work(); });
+  return p;
+}
+
+// Returns blob length (>=0) with *data owned by the prefetcher until the
+// next call; -1 when exhausted.
+long prefetch_next(void* handle, const char** data) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->next_to_emit >= p->paths.size()) return -1;
+  size_t idx = p->next_to_emit;
+  p->cv_get.wait(lk, [&] { return p->stop.load() || p->done[idx] != nullptr; });
+  if (p->stop.load()) return -1;
+  // free the previous emission
+  if (idx > 0 && p->done[idx - 1] != nullptr) {
+    delete p->done[idx - 1];
+    p->done[idx - 1] = nullptr;
+  }
+  std::string* blob = p->done[idx];
+  *data = blob->data();
+  ++p->next_to_emit;
+  --p->buffered;
+  p->cv_put.notify_all();
+  return static_cast<long>(blob->size());
+}
+
+void prefetch_close(void* handle) { delete static_cast<Prefetcher*>(handle); }
+
+// ---------------------------------------------------------------------------
+// Arena: 64-byte-aligned bump allocator for host staging buffers — the
+// MemoryWorkspace analog (scope-based reuse, no per-batch malloc churn).
+
+struct Arena {
+  char* base;
+  size_t size;
+  std::atomic<size_t> offset{0};
+};
+
+void* arena_create(long bytes) {
+  auto* a = new Arena();
+  a->size = static_cast<size_t>(bytes);
+  if (posix_memalign(reinterpret_cast<void**>(&a->base), 64, a->size) != 0) {
+    delete a;
+    return nullptr;
+  }
+  return a;
+}
+
+void* arena_alloc(void* handle, long bytes) {
+  auto* a = static_cast<Arena*>(handle);
+  size_t need = (static_cast<size_t>(bytes) + 63u) & ~size_t(63);
+  size_t off = a->offset.fetch_add(need);
+  if (off + need > a->size) {
+    a->offset.fetch_sub(need);
+    return nullptr;  // caller falls back to heap
+  }
+  return a->base + off;
+}
+
+void arena_reset(void* handle) {
+  static_cast<Arena*>(handle)->offset.store(0);
+}
+
+long arena_used(void* handle) {
+  return static_cast<long>(static_cast<Arena*>(handle)->offset.load());
+}
+
+void arena_destroy(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  free(a->base);
+  delete a;
+}
+
+}  // extern "C"

@@ -1,0 +1,179 @@
+"""UI subsystem: StatsListener → StatsStorage backends → UIServer
+endpoints → remote router; ROC HTML export
+(SURVEY.md §2.2 / §5; ref test pattern: deeplearning4j-ui-parent
+storage round-trip + Play server smoke tests)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import load_iris
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    InMemoryStatsStorage, RemoteUIStatsStorageRouter, SqliteStatsStorage,
+    StatsListener, UIServer)
+from deeplearning4j_tpu.ui.stats_listener import TYPE_ID
+
+
+def _train_with_listener(router, iters=3):
+    ds = load_iris()
+    n = NormalizerStandardize()
+    n.fit(ds)
+    ds = n.transform(ds)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    listener = StatsListener(router, session_id="sess-test")
+    net.set_listeners(listener)
+    for _ in range(iters):
+        net.fit(ds)
+    return net, listener
+
+
+def _storage_contract(storage):
+    net, lst = _train_with_listener(storage)
+    assert storage.list_session_ids() == ["sess-test"]
+    assert TYPE_ID in storage.list_type_ids_for_session("sess-test")
+    wids = storage.list_worker_ids_for_session("sess-test")
+    assert len(wids) == 1
+    static = storage.get_static_info("sess-test", TYPE_ID, wids[0])
+    assert static["model_class"] == "MultiLayerNetwork"
+    assert static["n_params"] == net.num_params()
+    ups = storage.get_all_updates_after("sess-test", TYPE_ID, wids[0], -1)
+    assert len(ups) == 3
+    latest = storage.get_latest_update("sess-test", TYPE_ID, wids[0])
+    assert latest["iteration"] == ups[-1]["iteration"]
+    assert np.isfinite(latest["score"])
+    # param summaries present with histograms
+    some = next(iter(latest["params"].values()))
+    assert "mean" in some and "histogram" in some
+    assert len(some["histogram"]["counts"]) == 20
+    # updates (deltas) appear from the second post on
+    assert latest["updates"]
+
+
+def test_in_memory_stats_storage_contract():
+    _storage_contract(InMemoryStatsStorage())
+
+
+def test_sqlite_stats_storage_contract(tmp_path):
+    st = SqliteStatsStorage(str(tmp_path / "stats.db"))
+    try:
+        _storage_contract(st)
+    finally:
+        st.close()
+
+
+def test_sqlite_storage_persists(tmp_path):
+    path = str(tmp_path / "stats.db")
+    st = SqliteStatsStorage(path)
+    _train_with_listener(st)
+    st.close()
+    st2 = SqliteStatsStorage(path)
+    try:
+        assert st2.list_session_ids() == ["sess-test"]
+        wid = st2.list_worker_ids_for_session("sess-test")[0]
+        assert len(st2.get_all_updates_after("sess-test", TYPE_ID, wid, -1)) == 3
+    finally:
+        st2.close()
+
+
+def test_storage_listener_events():
+    st = InMemoryStatsStorage()
+    events = []
+    st.register_stats_storage_listener(events.append)
+    _train_with_listener(st, iters=1)
+    kinds = [e.event_type for e in events]
+    assert "NewSessionID" in kinds
+    assert "PostStaticInfo" in kinds
+    assert "PostUpdate" in kinds
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_ui_server_endpoints():
+    """(ref: TrainModule overview/model/system routes)"""
+    st = InMemoryStatsStorage()
+    _train_with_listener(st)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        assert _get(base + "/train/sessions")["sessions"] == ["sess-test"]
+        ov = _get(base + "/train/overview?sid=sess-test")
+        assert len(ov["score"]) == 3
+        assert all(np.isfinite(s) for _, s in ov["score"])
+        model = _get(base + "/train/model?sid=sess-test")
+        assert any(l["name"].endswith("_W") for l in model["layers"])
+        system = _get(base + "/train/system?sid=sess-test")
+        assert system["static"]["model_class"] == "MultiLayerNetwork"
+        assert len(system["memory"]) == 3
+        # dashboard HTML served
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert "Training UI" in html
+    finally:
+        srv.stop()
+
+
+def test_remote_stats_router():
+    """(ref: RemoteUIStatsStorageRouter → UIServer /remoteReceive)"""
+    srv = UIServer()
+    try:
+        router = RemoteUIStatsStorageRouter(f"http://{srv.host}:{srv.port}")
+        _train_with_listener(router, iters=2)
+        base = f"http://{srv.host}:{srv.port}"
+        assert "sess-test" in _get(base + "/train/sessions")["sessions"]
+        ov = _get(base + "/train/overview?sid=sess-test")
+        assert len(ov["score"]) == 2
+    finally:
+        srv.stop()
+
+
+def test_roc_html_export(tmp_path):
+    """(ref: evaluation/EvaluationTools.java)"""
+    from deeplearning4j_tpu.nn.evaluation import ROC, ROCBinary
+    from deeplearning4j_tpu.nn.evaluation_tools import (
+        export_roc_charts_to_html_file)
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 500).astype(np.float64)
+    scores = np.clip(labels * 0.5 + rng.normal(0.25, 0.2, 500), 0, 1)
+    roc = ROC()
+    roc.eval(labels, scores)
+    assert roc.auc() > 0.8
+    out = tmp_path / "roc.html"
+    export_roc_charts_to_html_file(roc, str(out))
+    text = out.read_text()
+    assert "svg" in text and "AUC" in text
+
+    rb = ROCBinary()
+    rb.eval(np.stack([labels, 1 - labels], 1),
+            np.stack([scores, 1 - scores], 1))
+    assert rb.num_outputs() == 2
+    assert rb.auc(0) > 0.8 and rb.auc(1) > 0.8
+    export_roc_charts_to_html_file(rb, str(tmp_path / "rocb.html"))
+
+
+def test_roc_binary_elementwise_mask():
+    from deeplearning4j_tpu.nn.evaluation import ROCBinary
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 2, (40, 3)).astype(np.float64)
+    scores = np.clip(labels * 0.6 + rng.normal(0.2, 0.15, (40, 3)), 0, 1)
+    mask = rng.integers(0, 2, (40, 3)).astype(np.float64)
+    rb = ROCBinary()
+    rb.eval(labels, scores, mask=mask)  # per-element mask must not crash
+    assert rb.num_outputs() == 3
+    assert 0.0 <= rb.auc(0) <= 1.0
