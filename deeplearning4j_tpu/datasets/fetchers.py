@@ -34,20 +34,18 @@ MNIST_FILES = {
 
 def _read_idx(path: Path) -> np.ndarray:
     """Parse an IDX (ubyte) file, gzip or raw (ref: MnistManager.java).
-    Raw files go through the native parser (native/dl4j_io.cc) when the
-    library is available."""
+    Raw files go straight through native.read_idx (which carries its own
+    numpy fallback); .gz decompresses first then parses the same way."""
+    from deeplearning4j_tpu.native import read_idx
     if path.suffix != ".gz":
-        try:
-            from deeplearning4j_tpu.native import read_idx
-            return read_idx(path).astype(np.uint8)
-        except Exception:
-            pass  # fall through to the pure-Python parse
-    opener = gzip.open if path.suffix == ".gz" else open
-    with opener(path, "rb") as f:
-        magic = struct.unpack(">I", f.read(4))[0]
-        ndim = magic & 0xFF
-        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
-        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return read_idx(path).astype(np.uint8)
+    with gzip.open(path, "rb") as f:
+        raw = f.read()
+    magic = struct.unpack(">I", raw[:4])[0]
+    ndim = magic & 0xFF
+    dims = [struct.unpack(">I", raw[4 + 4 * i:8 + 4 * i])[0]
+            for i in range(ndim)]
+    data = np.frombuffer(raw, dtype=np.uint8, offset=4 + 4 * ndim)
     return data.reshape(dims)
 
 

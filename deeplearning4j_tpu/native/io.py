@@ -101,6 +101,7 @@ class NativeFilePrefetcher:
                                        self.n_threads)
             if handle:
                 try:
+                    import os
                     i = 0
                     while True:
                         data = ctypes.c_char_p()
@@ -108,6 +109,14 @@ class NativeFilePrefetcher:
                         if n < 0:
                             break
                         blob = ctypes.string_at(data, n)
+                        # the C reader signals failure with an empty blob;
+                        # distinguish it from a genuinely empty file so the
+                        # native path raises like the Python fallback does
+                        if not blob:
+                            p = self.paths[i]
+                            if not os.path.exists(p) or os.path.getsize(p):
+                                raise FileNotFoundError(
+                                    f"unreadable file in prefetch: {p}")
                         yield self.paths[i], blob
                         i += 1
                     return

@@ -283,12 +283,13 @@ class ROCBinary:
         if labels.ndim == 1:
             labels = labels[:, None]
             pred = pred[:, None]
+        orig_shape = labels.shape               # pre-flatten, for mask match
         labels = labels.reshape(-1, labels.shape[-1])
         pred = pred.reshape(-1, pred.shape[-1])
         if mask is not None:
             m = np.asarray(mask).astype(bool)
-            if m.shape == labels.shape:          # per-element mask
-                pass  # applied per column below
+            if m.shape == orig_shape:            # per-element mask
+                m = m.reshape(labels.shape)      # applied per column below
             else:                                # per-example/timestep mask
                 m = m.reshape(-1)
                 labels, pred = labels[m], pred[m]
@@ -297,7 +298,7 @@ class ROCBinary:
             m = None
         for c in range(labels.shape[-1]):
             if m is not None:
-                keep = m.reshape(-1, labels.shape[-1])[:, c]
+                keep = m[:, c]
                 self.per_output.setdefault(c, ROC(self.steps)).eval(
                     labels[keep, c], pred[keep, c])
             else:

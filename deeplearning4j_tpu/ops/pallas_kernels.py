@@ -1,0 +1,235 @@
+"""Pallas TPU kernels for the hot ops where HLO fusion isn't enough
+(SURVEY.md §7: the native-kernel tier; the reference's analog is the
+fused libnd4j Aggregate ops + cuDNN helpers, §2.3/§2.10).
+
+Two kernels:
+
+* **flash_attention** — block-wise online-softmax attention.  The dense
+  XLA path materializes the [B, H, T, T] score matrix in HBM; this
+  kernel streams K/V blocks through VMEM with running max/denominator
+  accumulation, so memory is O(T·D) and the MXU sees back-to-back
+  (BQ×D)·(D×BK) tiles.  Used by parallel/sequence.dense_attention (and
+  therefore the per-shard core of ring attention) on TPU; backward is a
+  custom_vjp that recomputes with the standard einsum formulation (XLA
+  fuses it well; forward is where the memory blow-up lived).
+
+* **fused_softmax_xent** — softmax + cross-entropy + gradient in one
+  VMEM pass per row block.  The char-RNN/output-layer hot op: avoids
+  writing the [N, V] probability matrix to HBM twice (once for loss,
+  once for grad).
+
+Both run under ``interpret=True`` off-TPU so the same code is testable
+on the CPU mesh (the reference's cuDNN-vs-builtin cross-check pattern,
+SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _interpret() -> bool:
+    return not _on_tpu()
+
+
+# ===========================================================================
+# Flash attention
+# ===========================================================================
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *,
+                      block_k: int, causal: bool, scale: float,
+                      q_offset_ref=None):
+    """One (batch*head, q-block) program: stream K/V blocks with online
+    softmax.  Block shapes: q [BQ, D], k/v [T, D], mask [1, T]."""
+    q = q_ref[...].astype(jnp.float32) * scale            # [BQ, D]
+    T = k_ref.shape[0]
+    BQ = q.shape[0]
+    qi = pl.program_id(1)
+    q_pos = qi * BQ + lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    def body(s, carry):
+        m, l, acc = carry
+        k_blk = k_ref[pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, pl.dslice(s * block_k, block_k)]
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        k_pos = s * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        if causal:
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+        scores = jnp.where(msk[None, :] > 0, scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
+        alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + p.sum(axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    D = q.shape[1]
+    m0 = jnp.full((BQ, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((BQ, 1), jnp.float32)
+    acc0 = jnp.zeros((BQ, D), jnp.float32)
+    n_blocks = T // block_k
+    if causal:
+        # only blocks whose start <= this q block's end can contribute
+        n_blocks_live = jnp.minimum(
+            n_blocks, (qi + 1) * BQ // block_k + 1)
+    else:
+        n_blocks_live = n_blocks
+    m, l, acc = lax.fori_loop(0, n_blocks_live, body, (m0, l0, acc0))
+    out_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def _flash_fwd(q, k, v, key_mask, *, causal: bool, scale: float,
+               block_q: int = 128, block_k: int = 128):
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise ValueError(f"T={T} must divide block sizes "
+                         f"({block_q}, {block_k})")
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    # mask per batch → per (batch, head) row, [BH, 1, T] blocks of [1, T]
+    mask = jnp.broadcast_to(key_mask[:, None, :], (B, H, T)).reshape(
+        B * H, 1, T).astype(jnp.float32)
+
+    grid = (B * H, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 1, T), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=_interpret(),
+    )(qf, kf, vf, mask)
+    return out.reshape(B, H, T, D)
+
+
+def _dense_reference(q, k, v, key_mask, causal, scale):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[2]
+        qi = jnp.arange(T)[:, None]
+        ki = jnp.arange(T)[None, :]
+        scores = jnp.where(qi >= ki, scores, NEG_INF)
+    scores = jnp.where(key_mask[:, None, None, :] > 0, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention(q, k, v, key_mask, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Memory-efficient exact attention.  q,k,v: [B,H,T,D]; key_mask
+    [B,T] (1=keep).  scale defaults to 1/sqrt(D)."""
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, key_mask, causal=causal, scale=s)
+
+
+def _flash_vjp_fwd(q, k, v, key_mask, causal, scale):
+    out = flash_attention(q, k, v, key_mask, causal, scale)
+    return out, (q, k, v, key_mask)
+
+
+def _flash_vjp_bwd(causal, scale, res, g):
+    q, k, v, key_mask = res
+    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+    def f(q, k, v):
+        return _dense_reference(q, k, v, key_mask, causal, s)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention_supported(q, block: int = 128) -> bool:
+    """Shape gate: last dim must be lane-tileable and T divisible by the
+    block size used; small shapes fall back to dense."""
+    B, H, T, D = q.shape
+    return T >= block and T % block == 0 and D % 128 == 0
+
+
+# ===========================================================================
+# Fused softmax cross-entropy
+# ===========================================================================
+
+def _softmax_xent_kernel(logits_ref, labels_ref, loss_ref, grad_ref):
+    """One row-block: max-sub softmax, CE loss, (p - y) gradient — one
+    HBM read of logits, one write of grad."""
+    x = logits_ref[...].astype(jnp.float32)
+    y = labels_ref[...].astype(jnp.float32)
+    m = x.max(axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    z = e.sum(axis=1, keepdims=True)
+    p = e / z
+    logp = (x - m) - jnp.log(z)
+    loss_ref[...] = -(y * logp).sum(axis=1, keepdims=True).astype(
+        loss_ref.dtype)
+    grad_ref[...] = (p - y).astype(grad_ref.dtype)
+
+
+def fused_softmax_xent(logits, labels, block_rows: Optional[int] = None):
+    """Returns (per_row_loss [N], dlogits [N, V]) in one fused pass.
+    Rows are padded to the block size; the block height adapts to V so
+    ~8 live br×V fp32 buffers (2 in, 1 out, temps) stay under the ~10 MB
+    scoped-VMEM budget."""
+    N, V = logits.shape
+    if block_rows is None:
+        budget = 10 << 20  # observed ~8 live br x V buffers in-kernel
+        block_rows = max(8, min(256, budget // (V * 4 * 8) // 8 * 8))
+    br = min(block_rows, max(8, N))
+    pad = (-N) % br
+    if pad:
+        logits = jnp.concatenate(
+            [logits, jnp.zeros((pad, V), logits.dtype)])
+        labels = jnp.concatenate(
+            [labels, jnp.zeros((pad, V), labels.dtype)])
+    Np = logits.shape[0]
+    loss, grad = pl.pallas_call(
+        _softmax_xent_kernel,
+        grid=(Np // br,),
+        in_specs=[
+            pl.BlockSpec((br, V), lambda i: (i, 0)),
+            pl.BlockSpec((br, V), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, 1), lambda i: (i, 0)),
+            pl.BlockSpec((br, V), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Np, 1), logits.dtype),
+            jax.ShapeDtypeStruct((Np, V), logits.dtype),
+        ],
+        interpret=_interpret(),
+    )(logits, labels)
+    return loss[:N, 0], grad[:N]

@@ -83,12 +83,21 @@ def cache_token():
 
 
 def dense_attention(q, k, v, *, causal: bool = False, key_mask=None,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None, allow_flash: bool = True):
     """Plain softmax attention.  q,k,v: [B, H, T, D]; key_mask: [B, Tk]
     with 1=keep (the reference's feedForwardMaskArray convention,
-    ref: nn/api/Layer.java:309)."""
+    ref: nn/api/Layer.java:309).  On TPU, tile-friendly shapes route to
+    the Pallas flash-attention kernel (ops/pallas_kernels.py) — O(T·D)
+    memory instead of the [T, T] score matrix in HBM."""
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if allow_flash and q.shape[2] == k.shape[2]:
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+        if pk._on_tpu() and pk.flash_attention_supported(q):
+            km = (key_mask if key_mask is not None
+                  else jnp.ones((q.shape[0], k.shape[2]), q.dtype))
+            return pk.flash_attention(q, k, v, km.astype(q.dtype), causal,
+                                      scale)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         Tq, Tk = scores.shape[-2], scores.shape[-1]

@@ -121,8 +121,10 @@ class StatsListener(IterationListener):
         if not self._static_posted:
             self._post_static(model)
         now = time.perf_counter()
-        cur = self._param_tree(model) if self.collect_histograms else {}
         if iteration % self.update_frequency == 0:
+            # device→host param snapshot only on posting iterations;
+            # 'updates' are deltas between consecutive POSTS
+            cur = self._param_tree(model) if self.collect_histograms else {}
             params = {k: _summary(v) for k, v in cur.items()}
             updates, grads = {}, {}
             if self._last_params is not None:
@@ -149,5 +151,5 @@ class StatsListener(IterationListener):
                 },
                 memory={"host_rss_mb": rss_mb})
             self.router.put_update(report.to_record())
-        self._last_params = cur if self.collect_histograms else None
+            self._last_params = cur if self.collect_histograms else None
         self._last_time = now

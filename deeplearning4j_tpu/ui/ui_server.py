@@ -51,6 +51,8 @@ th:first-child,td:first-child{text-align:left}
 <main id="main"></main>
 <script>
 let tab='overview', sid=null;
+function esc(s){const d=document.createElement('div');
+ d.textContent=String(s);return d.innerHTML;}
 function line(points,color){if(!points.length)return '';
  const xs=points.map(p=>p[0]),ys=points.map(p=>p[1]);
  const x0=Math.min(...xs),x1=Math.max(...xs)||1;
@@ -70,19 +72,21 @@ async function render(){
   m.innerHTML=`<div class="card"><h3>Score vs iteration</h3>${line(d.score,'#e74c3c')}</div>
   <div class="card"><h3>Samples/sec</h3>${line(d.samples_per_sec,'#2980b9')}</div>`;}
  else if(tab=='model'){const d=await j('/train/model?sid='+sid);
-  let rows=d.layers.map(l=>`<tr><td>${l.name}</td><td>${l.mean?.toPrecision(4)??''}</td>
+  let rows=d.layers.map(l=>`<tr><td>${esc(l.name)}</td><td>${l.mean?.toPrecision(4)??''}</td>
   <td>${l.stdev?.toPrecision(4)??''}</td><td>${l.mean_magnitude?.toPrecision(4)??''}</td>
   <td>${l.update_magnitude?.toPrecision(4)??''}</td></tr>`).join('');
   m.innerHTML=`<div class="card"><h3>Parameters (latest)</h3>
   <table><tr><th>param</th><th>mean</th><th>stdev</th><th>|mean|</th><th>|update|</th></tr>${rows}</table></div>`;}
  else{const d=await j('/train/system?sid='+sid);
   m.innerHTML=`<div class="card"><h3>Host RSS (MB)</h3>${line(d.memory,'#8e44ad')}</div>
-  <div class="card"><h3>Static info</h3><pre>${JSON.stringify(d.static,null,2)}</pre></div>`;}
+  <div class="card"><h3>Static info</h3><pre>${esc(JSON.stringify(d.static,null,2))}</pre></div>`;}
 }
 async function refreshSessions(){const d=await j('/train/sessions');
  const sel=document.getElementById('session');
  if(d.sessions.length&&sel.options.length!=d.sessions.length){
-  sel.innerHTML=d.sessions.map(s=>`<option>${s}</option>`).join('');}
+  sel.innerHTML='';
+  for(const s of d.sessions){const o=document.createElement('option');
+   o.textContent=s;o.value=s;sel.appendChild(o);}}
  sid=sel.value||d.sessions[0];}
 document.querySelectorAll('nav button').forEach(b=>b.onclick=()=>{
  tab=b.dataset.tab;document.querySelectorAll('nav button').forEach(x=>
@@ -143,14 +147,21 @@ class UIServer:
                 if self.path != "/remoteReceive":
                     self._send(404, b'{"error":"not found"}')
                     return
-                n = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(n))
-                record = body["record"]
-                if body.get("kind") == "static":
-                    server._remote_storage.put_static_info(record)
-                else:
-                    server._remote_storage.put_update(record)
-                self._json({"ok": True})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    record = body["record"]
+                    for key in ("session_id", "type_id", "worker_id"):
+                        if key not in record:
+                            raise KeyError(key)
+                    if body.get("kind") == "static":
+                        server._remote_storage.put_static_info(record)
+                    else:
+                        server._remote_storage.put_update(record)
+                    self._json({"ok": True})
+                except Exception as e:  # malformed payload → 400, not a
+                    self._send(400, json.dumps(  # dropped connection
+                        {"error": f"{type(e).__name__}: {e}"}).encode())
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address

@@ -67,7 +67,9 @@ int csv_dims(const char* path, char delim, int skip_lines, long* rows,
     } else if (ch == delim) {
       if (skipped >= skip_lines) ++cur_cols;
       in_line = true;
-    } else if (ch != '\r') {
+    } else if (ch != '\r' && ch != ' ' && ch != '\t') {
+      // whitespace alone must not count as a data row — csv_read skips
+      // blank lines, and dims/read must agree
       in_line = true;
     }
   }
@@ -108,12 +110,27 @@ int csv_read(const char* path, char delim, int skip_lines, float* out,
     size_t field_start = i;
     for (size_t j = i; j <= line_end && c < cols; ++j) {
       if (j == line_end || data[j] == delim) {
-        char* endp = nullptr;
+        // match Python float(): whole trimmed field must parse, and hex
+        // literals are rejected ('12abc' and '0x1A' are NaN both ways)
         const char* s = data.data() + field_start;
-        float v = strtof(s, &endp);
-        bool numeric = endp != s;
-        out[r * cols + c] =
-            numeric ? v : std::numeric_limits<float>::quiet_NaN();
+        const char* e = data.data() + j;
+        while (s < e && (*s == ' ' || *s == '\t')) ++s;
+        const char* trimmed_end = e;
+        while (trimmed_end > s && (trimmed_end[-1] == ' ' ||
+                                   trimmed_end[-1] == '\t' ||
+                                   trimmed_end[-1] == '\r'))
+          --trimmed_end;
+        float v = std::numeric_limits<float>::quiet_NaN();
+        if (trimmed_end > s) {
+          bool is_hex = (trimmed_end - s > 1) && s[0] == '0' &&
+                        (s[1] == 'x' || s[1] == 'X');
+          if (!is_hex) {
+            char* endp = nullptr;
+            float parsed = strtof(s, &endp);
+            if (endp == trimmed_end) v = parsed;
+          }
+        }
+        out[r * cols + c] = v;
         ++c;
         field_start = j + 1;
       }

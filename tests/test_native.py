@@ -143,3 +143,23 @@ def test_workspace_without_native(monkeypatch):
         arr = ws.alloc((10, 10))
         arr[:] = 1.0
         assert arr.sum() == 100.0
+
+
+def test_csv_python_float_semantics(tmp_path):
+    """Native parse must agree with Python float(): partial-numeric and
+    hex fields are NaN on both paths; inf/nan literals parse on both."""
+    p = tmp_path / "tricky.csv"
+    p.write_text("12abc,0x1A,inf\n nan , 2.5 ,3\n   \n1,2,3\n")
+    m = read_csv_matrix(p)
+    assert m.shape == (3, 3)  # whitespace-only line is not a row
+    assert np.isnan(m[0, 0]) and np.isnan(m[0, 1]) and np.isinf(m[0, 2])
+    assert np.isnan(m[1, 0]) and m[1, 1] == 2.5
+    np.testing.assert_array_equal(m[2], [1, 2, 3])
+
+
+def test_prefetcher_missing_file_raises(tmp_path):
+    ok = tmp_path / "ok.bin"
+    ok.write_bytes(b"x" * 10)
+    missing = tmp_path / "gone.bin"
+    with pytest.raises(FileNotFoundError):
+        list(NativeFilePrefetcher([ok, missing], capacity=2))

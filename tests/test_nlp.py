@@ -297,3 +297,103 @@ def test_text_serializer_tokens_with_spaces(tmp_path):
     assert loaded.has_word("new york")
     np.testing.assert_allclose(loaded.word_vector("new york"),
                                sv.word_vector("new york"), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# NLP extras: inverted index, annotation pipeline, CJK tokenizers
+# (SURVEY.md §2.7 — InvertedIndex.java, UIMA annotators, kuromoji/Korean)
+
+
+def test_inverted_index():
+    from deeplearning4j_tpu.text.invertedindex import InMemoryInvertedIndex
+    idx = InMemoryInvertedIndex()
+    d0 = idx.add_words_to_doc(None, ["the", "cat", "sat"])
+    d1 = idx.add_words_to_doc(None, ["the", "dog", "ran"])
+    assert idx.num_documents() == 2
+    assert idx.total_words() == 6
+    assert idx.documents("the") == [d0, d1]
+    assert idx.documents("cat") == [d0]
+    assert idx.document(d1) == ["the", "dog", "ran"]
+    docs = list(idx.docs())
+    assert docs[0] == ["the", "cat", "sat"]
+    batches = list(idx.batch_iter(1))
+    assert len(batches) == 2 and batches[0] == [["the", "cat", "sat"]]
+
+
+def test_annotation_pipeline():
+    from deeplearning4j_tpu.text.annotators import AnnotationPipeline
+    ctx = AnnotationPipeline().annotate(
+        "The cats were running quickly. They jumped!")
+    sents = ctx.select("sentence")
+    assert len(sents) == 2
+    toks = ctx.covered("token", sents[0])
+    assert [t.value for t in toks] == ["The", "cats", "were", "running",
+                                      "quickly", "."]
+    pos = {a.begin: a.value for a in ctx.select("pos")}
+    assert pos[toks[1].begin] == "NNS"       # cats
+    assert pos[toks[3].begin] == "VBG"       # running
+    assert pos[toks[4].begin] == "RB"        # quickly
+    stems = {a.begin: a.value for a in ctx.select("stem")}
+    assert stems[toks[1].begin] == "cat"
+    assert stems[toks[3].begin] == "run"
+
+
+def test_porter_stemmer():
+    from deeplearning4j_tpu.text.annotators import porter_stem
+    cases = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "motoring": "motor", "sing": "sing", "conflated": "conflat",
+        "hopping": "hop", "relational": "relat", "happy": "happi",
+        "generalization": "gener",
+    }
+    for w, expect in cases.items():
+        assert porter_stem(w) == expect, (w, porter_stem(w), expect)
+
+
+def test_japanese_tokenizer():
+    from deeplearning4j_tpu.text.cjk import JapaneseTokenizerFactory
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私は日本語を勉強します。").get_tokens()
+    # script boundaries + function-word segmentation, punctuation dropped
+    assert "は" in toks and "を" in toks
+    assert "。" not in "".join(toks)
+    assert "".join(toks) == "私は日本語を勉強します"
+    # user dictionary drives kanji segmentation
+    tf2 = JapaneseTokenizerFactory(user_dict={"日本語", "勉強"})
+    toks2 = tf2.create("私は日本語を勉強します").get_tokens()
+    assert "日本語" in toks2 and "勉強" in toks2
+    # katakana + latin mixed
+    toks3 = tf.create("TPUでディープラーニング").get_tokens()
+    assert "TPU" in toks3 and "ディープラーニング" in toks3
+
+
+def test_korean_tokenizer():
+    from deeplearning4j_tpu.text.cjk import KoreanTokenizerFactory
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("고양이는 집에 있다").get_tokens()
+    assert "고양이" in toks and "는" in toks  # josa split
+    assert "집" in toks and "에" in toks
+    toks2 = KoreanTokenizerFactory(strip_josa=False).create(
+        "고양이는 집에").get_tokens()
+    assert "고양이는" in toks2 and "집에" in toks2
+
+
+def test_cjk_tokenizers_feed_word2vec():
+    """CJK factories plug into the same Word2Vec pipeline
+    (the reference's tokenizerFactory seam)."""
+    from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+    from deeplearning4j_tpu.text.cjk import JapaneseTokenizerFactory
+    from deeplearning4j_tpu.text.sentence_iterators import (
+        CollectionSentenceIterator)
+    corpus = ["猫は魚が好きです", "犬は骨が好きです", "猫は犬と遊びます"] * 5
+    b = (Word2Vec.Builder()
+         .iterate(CollectionSentenceIterator(corpus))
+         .tokenizer_factory(JapaneseTokenizerFactory()))
+    b.conf.layer_size = 8
+    b.conf.min_word_frequency = 1
+    b.conf.seed = 1
+    w2v = b.build()
+    w2v.fit()
+    assert w2v.word_vector("猫") is not None
+    assert w2v.word_vector("好き") is not None or w2v.word_vector("は") is not None

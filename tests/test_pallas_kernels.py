@@ -1,0 +1,108 @@
+"""Pallas kernel numerics vs the XLA reference implementations, run in
+interpret mode on CPU (the TPU-vs-interpreter cross-check of SURVEY.md
+§4; the same kernels compile natively on the chip)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+
+def _qkv(B=2, H=2, T=256, D=128, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(B, H, T, D)).astype(np.float32) * 0.3)
+    return mk(), mk(), mk()
+
+
+def _mask(B=2, T=256, pad_from=None):
+    m = np.ones((B, T), np.float32)
+    if pad_from is not None:
+        m[:, pad_from:] = 0.0
+    return jnp.asarray(m)
+
+
+def test_flash_matches_dense():
+    q, k, v = _qkv()
+    km = _mask()
+    out = pk.flash_attention(q, k, v, km)
+    ref = pk._dense_reference(q, k, v, km, False, 1.0 / (128 ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_causal_matches_dense():
+    q, k, v = _qkv(seed=1)
+    km = _mask()
+    out = pk.flash_attention(q, k, v, km, True)
+    ref = pk._dense_reference(q, k, v, km, True, 1.0 / (128 ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_key_mask():
+    q, k, v = _qkv(seed=2)
+    km = _mask(pad_from=180)
+    out = pk.flash_attention(q, k, v, km)
+    ref = pk._dense_reference(q, k, v, km, False, 1.0 / (128 ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_grads():
+    q, k, v = _qkv(B=1, H=1, seed=3)
+    km = _mask(B=1)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, km, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            pk._dense_reference(q, k, v, km, True, 1.0 / (128 ** 0.5)) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_flash_supported_gate():
+    q, _, _ = _qkv(T=256, D=128)
+    assert pk.flash_attention_supported(q)
+    q_small = jnp.zeros((2, 2, 64, 128))
+    assert not pk.flash_attention_supported(q_small)
+    q_odd = jnp.zeros((2, 2, 256, 96))
+    assert not pk.flash_attention_supported(q_odd)
+
+
+def test_fused_softmax_xent():
+    rng = np.random.default_rng(0)
+    N, V = 100, 512
+    logits = jnp.asarray(rng.normal(size=(N, V)).astype(np.float32))
+    y = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, N)])
+    loss, grad = pk.fused_softmax_xent(logits, y)
+    # reference
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ref_loss = -(y * logp).sum(-1)
+    ref_grad = jax.nn.softmax(logits, -1) - y
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_softmax_xent_ragged_rows():
+    rng = np.random.default_rng(1)
+    N, V = 37, 128  # N not a multiple of the row block
+    logits = jnp.asarray(rng.normal(size=(N, V)).astype(np.float32))
+    y = jnp.asarray(np.eye(V, dtype=np.float32)[rng.integers(0, V, N)])
+    loss, grad = pk.fused_softmax_xent(logits, y, block_rows=16)
+    assert loss.shape == (N,)
+    assert grad.shape == (N, V)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    np.testing.assert_allclose(np.asarray(loss),
+                               np.asarray(-(y * logp).sum(-1)),
+                               rtol=1e-5, atol=1e-5)
