@@ -1,0 +1,15 @@
+"""Cloud helpers (ref: deeplearning4j-aws — aws/s3/{reader,uploader}
+S3Downloader/S3Uploader over the AWS SDK, aws/ec2 instance provisioning,
+aws/dataset S3-backed datasets; SURVEY.md §2.6).
+
+boto3 is not baked into this image and egress is disabled, so the S3
+surface is gated (clear error + ``s3_available()``) with a local-path
+scheme ("file://" and plain paths) that keeps dataset plumbing working
+in air-gapped runs.  EC2 provisioning has no TPU-native equivalent —
+capacity comes from the TPU slice, so provision via your cloud tooling;
+the class documents that mapping rather than shelling out."""
+
+from deeplearning4j_tpu.aws.s3 import (
+    S3Downloader, S3Uploader, s3_available)
+
+__all__ = ["S3Downloader", "S3Uploader", "s3_available"]

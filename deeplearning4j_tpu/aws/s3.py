@@ -1,0 +1,82 @@
+"""S3 object IO (ref: deeplearning4j-aws/.../aws/s3/reader/S3Downloader.java,
+uploader/S3Uploader.java — bucket list/download/upload surface).
+
+``s3://bucket/key`` URIs require boto3 (gated); ``file://`` URIs and
+plain paths work everywhere so the same call sites run in air-gapped
+environments (this image has zero egress)."""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import List, Tuple, Union
+from urllib.parse import urlparse
+
+
+def s3_available() -> bool:
+    try:
+        import boto3  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _parse(uri: str) -> Tuple[str, str, str]:
+    """→ (scheme, bucket-or-root, key-or-path)"""
+    u = urlparse(str(uri))
+    if u.scheme == "s3":
+        return "s3", u.netloc, u.path.lstrip("/")
+    if u.scheme == "file":
+        return "file", "", u.path
+    return "file", "", str(uri)
+
+
+def _require_boto3():
+    if not s3_available():
+        raise ImportError(
+            "boto3 is not installed (and this environment has no egress); "
+            "use file:// URIs or plain paths for local storage")
+    import boto3
+    return boto3.client("s3")
+
+
+class S3Downloader:
+    """(ref: aws/s3/reader/S3Downloader.java)"""
+
+    def download(self, uri: str, dest: Union[str, Path]) -> Path:
+        scheme, bucket, key = _parse(uri)
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        if scheme == "s3":
+            _require_boto3().download_file(bucket, key, str(dest))
+        else:
+            shutil.copyfile(key, dest)
+        return dest
+
+    def list_objects(self, uri: str) -> List[str]:
+        scheme, bucket, key = _parse(uri)
+        if scheme == "s3":
+            client = _require_boto3()
+            keys: List[str] = []
+            kwargs = {"Bucket": bucket, "Prefix": key}
+            while True:  # paginate past the 1000-key page limit
+                resp = client.list_objects_v2(**kwargs)
+                keys.extend(o["Key"] for o in resp.get("Contents", []))
+                if not resp.get("IsTruncated"):
+                    return keys
+                kwargs["ContinuationToken"] = resp["NextContinuationToken"]
+        root = Path(key)
+        return sorted(str(p) for p in root.rglob("*") if p.is_file())
+
+
+class S3Uploader:
+    """(ref: aws/s3/uploader/S3Uploader.java)"""
+
+    def upload(self, src: Union[str, Path], uri: str) -> str:
+        scheme, bucket, key = _parse(uri)
+        if scheme == "s3":
+            _require_boto3().upload_file(str(src), bucket, key)
+        else:
+            Path(key).parent.mkdir(parents=True, exist_ok=True)
+            shutil.copyfile(src, key)
+        return uri

@@ -41,7 +41,9 @@ def read_csv_matrix(path: Union[str, Path], delimiter: str = ",",
             vals = []
             for c in line.rstrip("\n").split(delimiter):
                 try:
-                    vals.append(float(c))
+                    # '_' separators are a Python-literal-ism, not CSV;
+                    # reject so native and fallback parses agree
+                    vals.append(float("nan") if "_" in c else float(c))
                 except ValueError:
                     vals.append(float("nan"))
             rows_py.append(vals)

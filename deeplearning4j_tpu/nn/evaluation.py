@@ -30,6 +30,24 @@ class ConfusionMatrix:
         return str(self.matrix)
 
 
+class Prediction:
+    """One example's (actual, predicted, metadata) triple
+    (ref: eval/meta/Prediction.java — per-example attribution so
+    misclassified examples can be traced back to their source records)."""
+
+    __slots__ = ("actual", "predicted", "record_meta_data")
+
+    def __init__(self, actual: int, predicted: int, record_meta_data=None):
+        self.actual = actual
+        self.predicted = predicted
+        self.record_meta_data = record_meta_data
+
+    def __repr__(self):
+        return (f"Prediction(actual={self.actual}, "
+                f"predicted={self.predicted}, "
+                f"meta={self.record_meta_data!r})")
+
+
 class Evaluation:
     """Multi-class classification metrics (ref: eval/Evaluation.java)."""
 
@@ -43,23 +61,52 @@ class Evaluation:
         if self.confusion is None:
             self.n_classes = self.n_classes or n
             self.confusion = ConfusionMatrix(self.n_classes)
+        if not hasattr(self, "predictions"):
+            self.predictions: List["Prediction"] = []
 
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, record_meta_data=None):
         """labels: one-hot [N,C] (or [N,T,C] with mask [N,T]);
-        predictions: probabilities same shape."""
+        predictions: probabilities same shape.  record_meta_data: one
+        metadata object per example — recorded per prediction for
+        attribution (ref: eval/meta/Prediction.java,
+        Evaluation.eval(..., List<RecordMetaData>))."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        meta = record_meta_data
         if labels.ndim == 3:  # time series: flatten valid steps
             if mask is not None:
                 m = np.asarray(mask).astype(bool).reshape(-1)
             else:
                 m = np.ones(labels.shape[0] * labels.shape[1], dtype=bool)
+            if meta is not None:  # replicate per timestep, then mask
+                T = labels.shape[1]
+                meta = [md for md in meta for _ in range(T)]
+                meta = [md for md, keep in zip(meta, m) if keep]
             labels = labels.reshape(-1, labels.shape[-1])[m]
             predictions = predictions.reshape(-1, predictions.shape[-1])[m]
         self._ensure(labels.shape[-1])
         a = np.argmax(labels, axis=-1)
         p = np.argmax(predictions, axis=-1)
         np.add.at(self.confusion.matrix, (a, p), 1)
+        if meta is not None:
+            for actual, predicted, md in zip(a, p, meta):
+                self.predictions.append(
+                    Prediction(int(actual), int(predicted), md))
+
+    # -- per-example attribution (ref: eval/meta/) -------------------------
+    def get_prediction_errors(self) -> List["Prediction"]:
+        """(ref: Evaluation.getPredictionErrors)"""
+        return [p for p in getattr(self, "predictions", [])
+                if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List["Prediction"]:
+        return [p for p in getattr(self, "predictions", [])
+                if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int
+                                           ) -> List["Prediction"]:
+        return [p for p in getattr(self, "predictions", [])
+                if p.predicted == cls]
 
     def merge(self, other: "Evaluation") -> "Evaluation":
         """Combine counts from another Evaluation (ref:
@@ -71,6 +118,7 @@ class Evaluation:
             raise ValueError(
                 f"class-count mismatch: {self.n_classes} vs {other.n_classes}")
         self.confusion.matrix += other.confusion.matrix
+        self.predictions.extend(getattr(other, "predictions", []))
         return self
 
     # ---- metrics ----

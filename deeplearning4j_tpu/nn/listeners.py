@@ -85,3 +85,43 @@ class CollectScoresIterationListener(IterationListener):
     def iteration_done(self, model, iteration):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, float(model.score())))
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter/update magnitude stats, optionally written
+    as TSV (ref: optimize/listeners/ParamAndGradientIterationListener.java
+    — mean magnitudes of params & gradients per iteration to file)."""
+
+    def __init__(self, iterations: int = 1, file_path=None,
+                 delimiter: str = "\t"):
+        self.iterations = max(1, iterations)
+        self.file_path = file_path
+        self.delimiter = delimiter
+        self.history: List[dict] = []
+        self._last = None
+        self._wrote_header = False
+
+    def iteration_done(self, model, iteration):
+        import numpy as np
+        if iteration % self.iterations:
+            return
+        params = np.asarray(model.params())
+        rec = {
+            "iteration": iteration,
+            "score": float(model.score()),
+            "param_mean_magnitude": float(np.abs(params).mean()),
+        }
+        if self._last is not None and self._last.shape == params.shape:
+            rec["update_mean_magnitude"] = float(
+                np.abs(params - self._last).mean())
+        self._last = params
+        self.history.append(rec)
+        if self.file_path:
+            cols = ["iteration", "score", "param_mean_magnitude",
+                    "update_mean_magnitude"]
+            with open(self.file_path, "a") as f:
+                if not self._wrote_header:
+                    f.write(self.delimiter.join(cols) + "\n")
+                    self._wrote_header = True
+                f.write(self.delimiter.join(
+                    str(rec.get(c, "")) for c in cols) + "\n")

@@ -9,7 +9,8 @@ Two kernels:
   kernel streams K/V blocks through VMEM with running max/denominator
   accumulation, so memory is O(T·D) and the MXU sees back-to-back
   (BQ×D)·(D×BK) tiles.  Used by parallel/sequence.dense_attention (and
-  therefore the per-shard core of ring attention) on TPU; backward is a
+  therefore the per-shard core of Ulysses sequence parallelism; the
+  ring path keeps its own block-streaming body) on TPU; backward is a
   custom_vjp that recomputes with the standard einsum formulation (XLA
   fuses it well; forward is where the memory blow-up lived).
 
@@ -52,8 +53,7 @@ def _interpret() -> bool:
 # ===========================================================================
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *,
-                      block_k: int, causal: bool, scale: float,
-                      q_offset_ref=None):
+                      block_k: int, causal: bool, scale: float):
     """One (batch*head, q-block) program: stream K/V blocks with online
     softmax.  Block shapes: q [BQ, D], k/v [T, D], mask [1, T]."""
     q = q_ref[...].astype(jnp.float32) * scale            # [BQ, D]

@@ -1,0 +1,78 @@
+"""ParallelWrapper CLI
+(ref: parallelism/main/ParallelWrapperMain.java:136 — jcommander flags
+--modelPath --dataSetIteratorFactoryClazz --workers --prefetchSize
+--averagingFrequency --reportScore ... → argparse here).
+
+Usage:
+    python -m deeplearning4j_tpu.parallel.main \
+        --model-path model.zip --data-dir ./batches \
+        --workers-per-axis data=4 fsdp=2 --averaging-frequency 1 \
+        --epochs 2 --output-path trained.zip
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dl4j-tpu-parallel",
+        description="Data-parallel training over the device mesh "
+                    "(ParallelWrapperMain analog)")
+    p.add_argument("--model-path", required=True,
+                   help="checkpoint .zip (ModelSerializer format)")
+    p.add_argument("--data-dir", required=True,
+                   help="directory of exported .npz DataSet minibatches")
+    p.add_argument("--output-path", default=None,
+                   help="where to save the trained model (default: in place)")
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--averaging-frequency", type=int, default=1,
+                   help="1 = per-step gradient all-reduce (recommended); "
+                        "N>1 = reference parameter-averaging compat")
+    p.add_argument("--no-average-updaters", action="store_true")
+    p.add_argument("--prefetch-size", type=int, default=4)
+    p.add_argument("--workers-per-axis", nargs="*", default=[],
+                   metavar="AXIS=N",
+                   help="mesh layout, e.g. data=4 fsdp=2 seq=1")
+    p.add_argument("--report-score", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from deeplearning4j_tpu.nn.serialization import load_model, write_model
+    from deeplearning4j_tpu.parallel import (
+        MeshConfig, ParallelWrapper, make_mesh)
+    from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
+
+    axes = {}
+    for spec in args.workers_per_axis:
+        k, _, v = spec.partition("=")
+        axes[k] = int(v)
+    mesh = make_mesh(MeshConfig(**axes)) if axes else make_mesh()
+
+    model = load_model(args.model_path)
+    wrapper = ParallelWrapper(
+        model, mesh,
+        averaging_frequency=args.averaging_frequency,
+        average_updaters=not args.no_average_updaters,
+        prefetch_buffer=args.prefetch_size)
+    it = PathDataSetIterator.from_dir(args.data_dir)
+    wrapper.fit(it, epochs=args.epochs)
+
+    out = args.output_path or args.model_path
+    write_model(model, out)
+    result = {"model_path": out, "score": float(model.score()),
+              "iterations": int(model.iteration),
+              "mesh": {k: int(v) for k, v in mesh.shape.items()}}
+    if args.report_score:
+        print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,17 +63,27 @@ def data_sharded(mesh: Mesh) -> NamedSharding:
 
 
 def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...]) -> NamedSharding:
-    """FSDP-style param sharding: shard the largest divisible axis over
-    'fsdp' (no-op when fsdp=1); replicate over 'data'."""
+    """Parameter layout over the mesh:
+
+    * 'model' (tensor parallelism): the LAST axis of ≥2-D params (a
+      matmul's output features) shards over 'model' — GSPMD then
+      partitions the matmuls and inserts the activation collectives
+      (Megatron column-parallel layout, scaling-book recipe).
+    * 'fsdp' (ZeRO): the largest remaining divisible axis shards over
+      'fsdp'.
+    * 'data': always replicated.
+    """
     fsdp = mesh.shape["fsdp"]
-    if fsdp == 1:
-        return NamedSharding(mesh, P())
-    best = None
-    for i, d in enumerate(arr_shape):
-        if d % fsdp == 0 and (best is None or d > arr_shape[best]):
-            best = i
-    if best is None:
-        return NamedSharding(mesh, P())
+    model = mesh.shape["model"]
     spec = [None] * len(arr_shape)
-    spec[best] = "fsdp"
+    if model > 1 and len(arr_shape) >= 2 and arr_shape[-1] % model == 0:
+        spec[-1] = "model"
+    if fsdp > 1:
+        best = None
+        for i, d in enumerate(arr_shape):
+            if spec[i] is None and d % fsdp == 0 and (
+                    best is None or d > arr_shape[best]):
+                best = i
+        if best is not None:
+            spec[best] = "fsdp"
     return NamedSharding(mesh, P(*spec))

@@ -22,13 +22,21 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 
 
 def export_dataset(ds: DataSet, path: Union[str, Path]) -> None:
-    """(ref: spark/data/DataSetExportFunction.java)"""
+    """(ref: spark/data/DataSetExportFunction.java).  Write is atomic
+    (temp file + rename) so streaming consumers never observe a
+    half-written archive."""
     arrays = {"features": ds.features, "labels": ds.labels}
     if ds.features_mask is not None:
         arrays["features_mask"] = ds.features_mask
     if ds.labels_mask is not None:
         arrays["labels_mask"] = ds.labels_mask
-    np.savez(path, **arrays)
+    path = Path(path)
+    if path.suffix != ".npz":
+        path = path.with_suffix(path.suffix + ".npz")
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
 
 
 def load_dataset(path: Union[str, Path]) -> DataSet:

@@ -1,0 +1,15 @@
+"""Streaming ingestion (ref: dl4j-streaming — Kafka+Camel routes,
+streaming/{kafka,routes,conversion}; SURVEY.md §2.6).
+
+The durable capability is "training consumes records as they arrive".
+Two sources: a directory watcher (filesystem as the queue — works
+everywhere, zero deps) and a Kafka consumer (gated on kafka-python
+being installed; it is not baked into this image)."""
+
+from deeplearning4j_tpu.streaming.directory import (
+    DirectoryWatchDataSetIterator)
+from deeplearning4j_tpu.streaming.kafka import (
+    KafkaConnectionInformation, KafkaDataSetIterator, kafka_available)
+
+__all__ = ["DirectoryWatchDataSetIterator", "KafkaConnectionInformation",
+           "KafkaDataSetIterator", "kafka_available"]

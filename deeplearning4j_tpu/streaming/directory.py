@@ -1,0 +1,67 @@
+"""Directory-watch streaming source: new .npz DataSet files appearing in
+a directory are consumed in arrival order — the Camel-route role
+(ref: dl4j-streaming/.../streaming/routes/DL4jServeRouteBuilder.java:
+camel endpoint → DataSet conversion → training consumer) with the
+filesystem as the transport."""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Optional, Set, Union
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+from deeplearning4j_tpu.scaleout.data import load_dataset
+
+
+class DirectoryWatchDataSetIterator(DataSetIterator):
+    """Blocking iterator over a growing directory of exported DataSets.
+
+    ``has_next`` polls until a new file arrives, the idle timeout
+    expires, or a sentinel file named ``_DONE`` appears (the producer's
+    end-of-stream marker)."""
+
+    def __init__(self, directory: Union[str, Path], pattern: str = "*.npz",
+                 poll_interval: float = 0.05,
+                 idle_timeout: Optional[float] = 10.0):
+        self.directory = Path(directory)
+        self.pattern = pattern
+        self.poll_interval = poll_interval
+        self.idle_timeout = idle_timeout
+        self._seen: Set[str] = set()
+        self._queue: list = []
+
+    def _scan(self) -> None:
+        for p in sorted(self.directory.glob(self.pattern)):
+            key = p.name
+            if key not in self._seen:
+                self._seen.add(key)
+                self._queue.append(p)
+
+    def _done(self) -> bool:
+        return (self.directory / "_DONE").exists()
+
+    def has_next(self) -> bool:
+        deadline = (time.monotonic() + self.idle_timeout
+                    if self.idle_timeout is not None else None)
+        while True:
+            self._scan()
+            if self._queue:
+                return True
+            if self._done():
+                return False
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(self.poll_interval)
+
+    def next(self) -> DataSet:
+        if not self._queue:
+            if not self.has_next():
+                raise StopIteration
+        return load_dataset(self._queue.pop(0))
+
+    def reset(self) -> None:
+        # streaming source: reset replays everything seen so far
+        self._seen.clear()
+        self._queue.clear()

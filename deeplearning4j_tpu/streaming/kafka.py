@@ -1,0 +1,88 @@
+"""Kafka streaming source (ref: dl4j-streaming/.../streaming/kafka/
+NDArrayKafkaClient.java, NDArrayPublisher/Consumer — Kafka topics
+carrying serialized arrays).
+
+kafka-python is NOT baked into this image, so the consumer is gated:
+``kafka_available()`` reports the capability, construction raises a
+clear error when absent, and the wire format (npz bytes per message)
+matches scaleout.data's export so producers are trivial."""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def kafka_available() -> bool:
+    try:
+        import kafka  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@dataclasses.dataclass
+class KafkaConnectionInformation:
+    """(ref: streaming/kafka/KafkaConnectionInformation.java)"""
+
+    zookeeper_host: str = "localhost"
+    zookeeper_port: int = 2181
+    kafka_broker_list: str = "localhost:9092"
+    topic_name: str = "dl4j"
+    group_id: str = "dl4j-tpu"
+
+
+def decode_dataset_message(payload: bytes) -> DataSet:
+    """npz bytes → DataSet (the NDArray serde role)."""
+    with np.load(io.BytesIO(payload)) as z:
+        return DataSet(z["features"], z["labels"],
+                       z["features_mask"] if "features_mask" in z else None,
+                       z["labels_mask"] if "labels_mask" in z else None)
+
+
+class KafkaDataSetIterator(DataSetIterator):
+    """(ref: streaming/kafka/NDArrayConsumer.java — consume → convert →
+    feed training)"""
+
+    def __init__(self, connection: KafkaConnectionInformation,
+                 poll_timeout_ms: int = 1000,
+                 max_messages: Optional[int] = None):
+        if not kafka_available():
+            raise ImportError(
+                "kafka-python is not installed in this environment; use "
+                "streaming.DirectoryWatchDataSetIterator, or install "
+                "kafka-python to enable the Kafka source")
+        from kafka import KafkaConsumer
+        self.connection = connection
+        self.poll_timeout_ms = poll_timeout_ms
+        self.max_messages = max_messages
+        self._consumed = 0
+        self._consumer = KafkaConsumer(
+            connection.topic_name,
+            bootstrap_servers=connection.kafka_broker_list.split(","),
+            group_id=connection.group_id)
+        self._pending: list = []
+
+    def has_next(self) -> bool:
+        if self.max_messages is not None and self._consumed >= self.max_messages:
+            return False
+        if self._pending:
+            return True
+        polled = self._consumer.poll(timeout_ms=self.poll_timeout_ms)
+        for records in polled.values():
+            self._pending.extend(r.value for r in records)
+        return bool(self._pending)
+
+    def next(self) -> DataSet:
+        payload = self._pending.pop(0)
+        self._consumed += 1
+        return decode_dataset_message(payload)
+
+    def reset(self) -> None:
+        self._consumed = 0

@@ -21,6 +21,7 @@ class InMemoryInvertedIndex:
         self._lock = threading.Lock()
         self._docs: Dict[int, List[str]] = {}
         self._postings: Dict[str, List[int]] = {}
+        self._posting_sets: Dict[str, set] = {}
         self._next_doc = 0
 
     # -- write side (ref: addWordsToDoc / addWordToDoc) ---------------------
@@ -33,9 +34,10 @@ class InMemoryInvertedIndex:
             self._next_doc = max(self._next_doc, doc_id + 1)
             self._docs.setdefault(doc_id, []).extend(words)
             for w in words:
-                posting = self._postings.setdefault(w, [])
-                if not posting or posting[-1] != doc_id:
-                    posting.append(doc_id)
+                seen = self._posting_sets.setdefault(w, set())
+                if doc_id not in seen:  # dedup even under interleaved adds
+                    seen.add(doc_id)
+                    self._postings.setdefault(w, []).append(doc_id)
         return doc_id
 
     # -- read side ----------------------------------------------------------

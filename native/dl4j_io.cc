@@ -122,8 +122,10 @@ int csv_read(const char* path, char delim, int skip_lines, float* out,
           --trimmed_end;
         float v = std::numeric_limits<float>::quiet_NaN();
         if (trimmed_end > s) {
-          bool is_hex = (trimmed_end - s > 1) && s[0] == '0' &&
-                        (s[1] == 'x' || s[1] == 'X');
+          const char* digits = s;
+          if (*digits == '+' || *digits == '-') ++digits;  // signed hex too
+          bool is_hex = (trimmed_end - digits > 1) && digits[0] == '0' &&
+                        (digits[1] == 'x' || digits[1] == 'X');
           if (!is_hex) {
             char* endp = nullptr;
             float parsed = strtof(s, &endp);

@@ -113,3 +113,37 @@ def test_parallel_inference_batching():
             np.testing.assert_allclose(results[i][0], expected[i], rtol=1e-4)
     finally:
         pi.shutdown()
+
+
+def test_tensor_parallel_model_axis():
+    """dp×tp mesh: last weight axis sharded over 'model' (Megatron
+    column-parallel via GSPMD) — trains and matches dp-only numerics."""
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+    ds = load_iris()
+    n = NormalizerStandardize(); n.fit(ds); ds = n.transform(ds).shuffle(seed=0)
+    ds = ds.get_range(0, 144)  # batches of 24 divide both 4- and 8-way
+
+    def conf():
+        return (NeuralNetConfiguration.builder()
+                .seed(42).learning_rate(0.1).updater("adam")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+
+    import numpy as np
+    tp_net = MultiLayerNetwork(conf()).init()
+    tp_mesh = make_mesh(MeshConfig(data=4, model=2))
+    ParallelWrapper(tp_net, tp_mesh).fit(
+        ListDataSetIterator(ds, 24), epochs=3)
+
+    dp_net = MultiLayerNetwork(conf()).init()
+    dp_mesh = make_mesh(MeshConfig(data=8))
+    ParallelWrapper(dp_net, dp_mesh).fit(
+        ListDataSetIterator(ds, 24), epochs=3)
+
+    np.testing.assert_allclose(
+        np.asarray(tp_net.params()), np.asarray(dp_net.params()),
+        rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(tp_net.score()))

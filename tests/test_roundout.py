@@ -1,0 +1,149 @@
+"""Round-out surface: ParallelWrapper CLI, streaming sources, S3 gated
+helpers, eval metadata attribution, ParamAndGradient listener
+(SURVEY.md §2.1 eval meta, §2.4 CLI, §2.6 streaming/AWS)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+
+
+def _tiny_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+
+
+def _tiny_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return DataSet(x, y)
+
+
+def test_parallel_wrapper_cli(tmp_path):
+    """(ref: parallelism/main/ParallelWrapperMain.java)"""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.serialization import load_model, write_model
+    from deeplearning4j_tpu.parallel.main import main
+    from deeplearning4j_tpu.scaleout.data import export_dataset
+
+    model_path = str(tmp_path / "model.zip")
+    write_model(MultiLayerNetwork(_tiny_conf()).init(), model_path)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    for i, b in enumerate(_tiny_data(96).batch_by(32)):
+        export_dataset(b, data_dir / f"b{i}.npz")
+
+    out_path = str(tmp_path / "trained.zip")
+    rc = main(["--model-path", model_path, "--data-dir", str(data_dir),
+               "--output-path", out_path, "--epochs", "5",
+               "--workers-per-axis", "data=8", "--report-score"])
+    assert rc == 0
+    trained = load_model(out_path)
+    ds = _tiny_data(96)
+    final = float(trained.score(ds))
+    fresh = float(MultiLayerNetwork(_tiny_conf()).init().score(ds))
+    assert np.isfinite(final) and final < fresh  # training happened
+
+
+def test_directory_watch_streaming(tmp_path):
+    """(ref: dl4j-streaming Camel routes — filesystem transport)"""
+    from deeplearning4j_tpu.scaleout.data import export_dataset
+    from deeplearning4j_tpu.streaming import DirectoryWatchDataSetIterator
+
+    def producer():
+        for i, b in enumerate(_tiny_data(48).batch_by(16)):
+            export_dataset(b, tmp_path / f"s{i}.npz")
+            time.sleep(0.05)
+        (tmp_path / "_DONE").touch()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    it = DirectoryWatchDataSetIterator(tmp_path, idle_timeout=10.0)
+    seen = 0
+    while it.has_next():
+        ds = it.next()
+        assert ds.num_examples() == 16
+        seen += 1
+    t.join()
+    assert seen == 3
+
+
+def test_kafka_gated():
+    from deeplearning4j_tpu.streaming import (
+        KafkaConnectionInformation, KafkaDataSetIterator, kafka_available)
+    from deeplearning4j_tpu.streaming.kafka import decode_dataset_message
+    import io
+    assert not kafka_available()  # not baked into this image
+    with pytest.raises(ImportError, match="kafka-python"):
+        KafkaDataSetIterator(KafkaConnectionInformation())
+    # wire format decodes regardless of the transport
+    buf = io.BytesIO()
+    ds = _tiny_data(4)
+    np.savez(buf, features=ds.features, labels=ds.labels)
+    out = decode_dataset_message(buf.getvalue())
+    np.testing.assert_array_equal(out.features, ds.features)
+
+
+def test_s3_local_scheme(tmp_path):
+    """(ref: aws/s3 — file:// fallback keeps call sites working)"""
+    from deeplearning4j_tpu.aws import S3Downloader, S3Uploader, s3_available
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"weights")
+    up = S3Uploader()
+    uri = str(tmp_path / "store" / "artifact.bin")
+    up.upload(src, uri)
+    down = S3Downloader()
+    dest = down.download(uri, tmp_path / "restored.bin")
+    assert dest.read_bytes() == b"weights"
+    listed = down.list_objects(str(tmp_path / "store"))
+    assert any(l.endswith("artifact.bin") for l in listed)
+    if not s3_available():
+        with pytest.raises(ImportError, match="boto3"):
+            down.download("s3://bucket/key", tmp_path / "x")
+
+
+def test_evaluation_metadata_attribution():
+    """(ref: eval/meta/Prediction.java + Evaluation meta overloads)"""
+    from deeplearning4j_tpu.nn.evaluation import Evaluation
+    labels = np.eye(2)[[0, 1, 0, 1]]
+    preds = np.eye(2)[[0, 0, 0, 1]].astype(float) * 0.9 + 0.05
+    meta = [f"rec-{i}" for i in range(4)]
+    ev = Evaluation()
+    ev.eval(labels, preds, record_meta_data=meta)
+    errors = ev.get_prediction_errors()
+    assert len(errors) == 1
+    assert errors[0].record_meta_data == "rec-1"
+    assert errors[0].actual == 1 and errors[0].predicted == 0
+    assert len(ev.get_predictions_by_actual_class(0)) == 2
+    assert len(ev.get_predictions_by_predicted_class(0)) == 3
+
+
+def test_param_and_gradient_listener(tmp_path):
+    from deeplearning4j_tpu.nn.listeners import (
+        ParamAndGradientIterationListener)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(_tiny_conf()).init()
+    out = tmp_path / "stats.tsv"
+    lst = ParamAndGradientIterationListener(file_path=str(out))
+    net.set_listeners(lst)
+    ds = _tiny_data()
+    for _ in range(3):
+        net.fit(ds)
+    assert len(lst.history) == 3
+    assert "update_mean_magnitude" in lst.history[-1]
+    lines = out.read_text().strip().splitlines()
+    assert lines[0].startswith("iteration")
+    assert len(lines) == 4
