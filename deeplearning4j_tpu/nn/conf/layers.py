@@ -677,6 +677,115 @@ class SelfAttentionLayer(Layer):
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
 
+@register_layer
+@dataclasses.dataclass
+class MixtureOfExpertsLayer(Layer):
+    """Sparse mixture-of-experts feed-forward block (GShard-style top-1
+    dispatch).  No reference analog — DL4J predates MoE; this layer
+    exists so the mesh's 'expert' axis is a first-class layout: expert
+    weight stacks [E, ...] shard over 'expert'
+    (parallel/mesh.param_sharding) and XLA partitions the dispatch/
+    combine einsums into expert-parallel all-to-alls.
+
+    Routing: softmax gate → top-1 expert per token, fixed capacity
+    ``capacity_factor·N/E`` per expert; overflow tokens pass through
+    unchanged (residual).  Aux load-balancing loss is returned in state
+    under "moe_aux_loss" (mean over experts of fraction·probability,
+    scaled by ``aux_loss_weight``)."""
+
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_experts: int = 4
+    hidden: Optional[int] = None       # expert MLP width (default 4×n_out)
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    def initialize(self, key, input_type, dtype=jnp.float32):
+        n_in = self.n_in or input_type.size
+        if self.n_out != n_in:
+            raise ValueError("MoE block is residual: n_out must equal n_in "
+                             f"(got n_in={n_in}, n_out={self.n_out})")
+        H = self.hidden or 4 * self.n_out
+        kg, k1, k2 = jax.random.split(key, 3)
+        E = self.n_experts
+        params = {
+            "Wg": self._winit(kg, (n_in, E), dtype),
+            "W1": self._winit(k1, (E, n_in, H), dtype, fan_in=n_in,
+                              fan_out=H),
+            "b1": jnp.zeros((E, H), dtype),
+            "W2": self._winit(k2, (E, H, self.n_out), dtype, fan_in=H,
+                              fan_out=self.n_out),
+            "b2": jnp.zeros((E, self.n_out), dtype),
+        }
+        # aux loss lives in state from step 0 so the state pytree
+        # structure never changes (jit/sharding trees are built once)
+        state = {"moe_aux_loss": jnp.zeros((), dtype)}
+        return params, state, input_type
+
+    def forward(self, params, state, x, *, train, rng, mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        shape = x.shape
+        D = shape[-1]
+        tokens = x.reshape(-1, D)                       # [N, D]
+        N = tokens.shape[0]
+        E = self.n_experts
+        C = max(1, int(self.capacity_factor * N / E))
+
+        gates = jax.nn.softmax(tokens @ params["Wg"], axis=-1)   # [N, E]
+        top_p = gates.max(axis=-1)                               # [N]
+        top_e = gates.argmax(axis=-1)                            # [N]
+        onehot = jax.nn.one_hot(top_e, E, dtype=x.dtype)         # [N, E]
+        # padding tokens must not claim capacity or train the gate
+        if mask is not None and x.ndim == 3:
+            tok_mask = mask.reshape(-1).astype(x.dtype)          # [N]
+            onehot = onehot * tok_mask[:, None]
+        else:
+            tok_mask = None
+
+        # position of each token within its expert's queue
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [N, E]
+        in_cap = (pos < C).astype(x.dtype) * onehot
+        pos_idx = pos.sum(axis=-1).astype(jnp.int32)             # [N]
+        cap_oh = jax.nn.one_hot(pos_idx, C, dtype=x.dtype)       # [N, C]
+        dispatch = in_cap[:, :, None] * cap_oh[:, None, :]       # [N, E, C]
+
+        # dispatch → per-expert batch, expert MLP, combine (GShard einsums;
+        # the E dimension is sharded over 'expert' — XLA inserts a2a)
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        h = jax.nn.gelu(
+            jnp.einsum("ecd,edh->ech", expert_in, params["W1"])
+            + params["b1"][:, None, :])
+        expert_out = (jnp.einsum("ech,eho->eco", h, params["W2"])
+                      + params["b2"][:, None, :])
+        combine = dispatch * top_p[:, None, None]
+        routed = jnp.einsum("nec,eco->no", combine, expert_out)
+
+        # residual: routed contribution is zero for overflow/unrouted
+        # tokens, so they pass through unchanged
+        out = tokens + routed
+        out = out.reshape(shape[:-1] + (self.n_out,))
+
+        # load-balance aux loss (Switch/GShard): E·Σ_e fraction_e·prob_e
+        # — averaged over VALID tokens only
+        if tok_mask is not None:
+            n_valid = jnp.maximum(tok_mask.sum(), 1.0)
+            frac = onehot.sum(axis=0) / n_valid
+            prob = (gates * tok_mask[:, None]).sum(axis=0) / n_valid
+        else:
+            frac = onehot.mean(axis=0)
+            prob = gates.mean(axis=0)
+        aux = self.aux_loss_weight * E * jnp.sum(frac * prob)
+        new_state = dict(state) if state else {}
+        new_state["moe_aux_loss"] = aux
+        out = self._act(out)
+        if mask is not None and out.ndim == 3:
+            out = out * mask[:, :, None].astype(out.dtype)
+        return out, new_state, mask
+
+    def output_type(self, input_type):
+        return input_type
+
+
 # ==========================================================================
 # Misc
 # ==========================================================================

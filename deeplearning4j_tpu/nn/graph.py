@@ -213,6 +213,11 @@ class ComputationGraph:
                     score = score + (jnp.mean(per_ex) if g.mini_batch
                                      else jnp.sum(per_ex))
                 score = score + self._reg_penalty(p)
+                # aux losses surfaced by layers through state (e.g. MoE
+                # load balancing) — same convention as MultiLayerNetwork
+                for s in new_states.values():
+                    if isinstance(s, dict) and "moe_aux_loss" in s:
+                        score = score + s["moe_aux_loss"]
                 return score, new_states
 
             (score, new_states), grads = jax.value_and_grad(

@@ -219,6 +219,11 @@ class MultiLayerNetwork:
                     per_ex = out_layer.compute_score(y, preout, lm)
                 score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
                 score = score + self._reg_penalty(p)
+                # auxiliary losses surfaced by layers through their state
+                # (e.g. MoE load-balancing, nn/conf/layers.py MoE layer)
+                for s in new_states:
+                    if isinstance(s, dict) and "moe_aux_loss" in s:
+                        score = score + s["moe_aux_loss"]
                 if not g.minimize:
                     score = -score
                 return score, new_states

@@ -69,14 +69,24 @@ def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...]) -> NamedSharding:
       matmul's output features) shards over 'model' — GSPMD then
       partitions the matmuls and inserts the activation collectives
       (Megatron column-parallel layout, scaling-book recipe).
+    * 'expert' (MoE): the FIRST axis of ≥3-D params shards over
+      'expert' — expert weight stacks are [E, in, out]
+      (MixtureOfExperts layer), and GSPMD turns the dispatch/combine
+      einsums into expert-parallel all-to-alls.  The ndim≥3 gate keeps
+      plain [in, out] matrices (whose fan-in merely happens to divide E)
+      replicated.
     * 'fsdp' (ZeRO): the largest remaining divisible axis shards over
       'fsdp'.
     * 'data': always replicated.
     """
     fsdp = mesh.shape["fsdp"]
     model = mesh.shape["model"]
+    expert = mesh.shape["expert"]
     spec = [None] * len(arr_shape)
-    if model > 1 and len(arr_shape) >= 2 and arr_shape[-1] % model == 0:
+    if expert > 1 and len(arr_shape) >= 3 and arr_shape[0] % expert == 0:
+        spec[0] = "expert"
+    if (model > 1 and len(arr_shape) >= 2 and spec[-1] is None
+            and arr_shape[-1] % model == 0):
         spec[-1] = "model"
     if fsdp > 1:
         best = None
