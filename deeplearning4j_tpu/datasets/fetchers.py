@@ -121,6 +121,77 @@ def load_cifar10(train: bool = True, num_examples: Optional[int] = None) -> Data
     return DataSet(images, labels)
 
 
+def load_lfw(num_examples: int = 1000, n_labels: int = 40,
+             image_hw: Tuple[int, int] = (64, 64), train: bool = True
+             ) -> DataSet:
+    """LFW faces: features [N,3,H,W], one-hot person labels
+    (ref: datasets/fetchers/LFWDataFetcher + LFWDataSetIterator).  With
+    no cached copy (zero egress), deterministic class-separable
+    synthetic faces stand in, like the MNIST/CIFAR fallbacks."""
+    base = CACHE_DIR / "lfw"
+    if base.exists():
+        from deeplearning4j_tpu.records.readers import ImageRecordReader
+        rr = ImageRecordReader(image_hw[0], image_hw[1], 3).initialize(base)
+        records = list(zip(rr._files, range(len(rr._files))))
+        if records:  # empty/garbage cache dir → synthetic fallback below
+            # deterministic 80/20 train/test split by position
+            split = max(1, int(0.8 * len(records)))
+            chosen = records[:split] if train else records[split:]
+            xs, ys = [], []
+            for path, _ in chosen[:num_examples]:
+                xs.append(rr._load_image(path))
+                ys.append(rr.labels.index(path.parent.name))
+            labels = np.eye(max(rr.num_labels(), 1),
+                            dtype=np.float32)[np.asarray(ys)]
+            return DataSet(np.stack(xs) / 255.0, labels)
+    n = min(num_examples, 4096)
+    images, labels = _synthetic_images(n, n_labels, image_hw, 3,
+                                       seed=5 if train else 6)
+    return DataSet(images, labels)
+
+
+def load_curves(num_examples: int = 10000) -> DataSet:
+    """The "curves" dataset (28×28 grayscale parametric curves used by
+    the original deep-autoencoder work; ref:
+    datasets/fetchers/CurvesDataFetcher.java:37-51 — S3 download there,
+    deterministic synthesis here: features double as labels, it is an
+    autoencoder dataset)."""
+    n = min(num_examples, 8192)
+    rng = np.random.default_rng(12)
+    t = np.linspace(0.0, 1.0, 28, dtype=np.float32)
+    images = np.zeros((n, 1, 28, 28), np.float32)
+    for i in range(n):
+        # random cubic Bézier curve rasterized onto the 28x28 grid
+        pts = rng.uniform(2, 26, size=(4, 2)).astype(np.float32)
+        b = ((1 - t)[:, None] ** 3 * pts[0]
+             + 3 * ((1 - t) ** 2 * t)[:, None] * pts[1]
+             + 3 * ((1 - t) * t ** 2)[:, None] * pts[2]
+             + (t ** 3)[:, None] * pts[3])
+        xi = np.clip(b[:, 0].astype(int), 0, 27)
+        yi = np.clip(b[:, 1].astype(int), 0, 27)
+        images[i, 0, yi, xi] = 1.0
+    flat = images.reshape(n, -1)
+    return DataSet(flat, flat)  # autoencoder: labels == features
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    """(ref: datasets/iterator/impl/LFWDataSetIterator.java)"""
+
+    def __init__(self, batch_size: int, num_examples: int = 1000,
+                 n_labels: int = 40, image_hw: Tuple[int, int] = (64, 64),
+                 train: bool = True):
+        ds = load_lfw(num_examples, n_labels, image_hw, train)
+        super().__init__(ds.batch_by(batch_size))
+
+
+class CurvesDataSetIterator(ListDataSetIterator):
+    """(ref: CurvesDataFetcher consumed via BaseDatasetIterator)"""
+
+    def __init__(self, batch_size: int, num_examples: int = 10000):
+        ds = load_curves(num_examples)
+        super().__init__(ds.batch_by(batch_size))
+
+
 def load_iris() -> DataSet:
     """The Iris dataset, bundled inline (150 examples — the reference bundles
     it as a resource; ref: IrisDataSetIterator)."""

@@ -175,16 +175,20 @@ class AsyncDataSetIterator(DataSetIterator):
         self._started = False  # worker starts lazily on first use, so a
         # reset() right after construction doesn't drain a prefetch pass
 
+    def _transform(self, d):
+        if self.device_put:
+            import jax
+            d = DataSet(jax.device_put(d.features), jax.device_put(d.labels),
+                        None if d.features_mask is None else jax.device_put(d.features_mask),
+                        None if d.labels_mask is None else jax.device_put(d.labels_mask))
+        return d
+
     def _worker(self):
         try:
             while self.underlying.has_next():
-                d = self.underlying.next()
-                if self.device_put:
-                    import jax
-                    d = DataSet(jax.device_put(d.features), jax.device_put(d.labels),
-                                None if d.features_mask is None else jax.device_put(d.features_mask),
-                                None if d.labels_mask is None else jax.device_put(d.labels_mask))
-                self._queue.put(d)
+                self._queue.put(self._transform(self.underlying.next()))
+        except BaseException as e:  # re-raised on the consumer thread —
+            self._worker_exc = e    # a dead worker must not look like EOF
         finally:
             self._queue.put(self._SENTINEL)
 
@@ -209,6 +213,10 @@ class AsyncDataSetIterator(DataSetIterator):
         if item is self._SENTINEL:
             self._exhausted = True
             self._peek = None
+            exc = getattr(self, "_worker_exc", None)
+            if exc is not None:
+                self._worker_exc = None
+                raise exc
         else:
             self._peek = item
 
@@ -235,3 +243,60 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def batch_size(self):
         return self.underlying.batch_size()
+
+
+class MultiDataSetIterator:
+    """Iterator contract for multi-input/output batches
+    (ref: nd4j MultiDataSetIterator consumed by ComputationGraph.fit)."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self):
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class ListMultiDataSetIterator(MultiDataSetIterator):
+    """Pre-built MultiDataSet minibatches."""
+
+    def __init__(self, batches):
+        self._data = list(batches)
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self._data)
+
+    def next(self):
+        d = self._data[self._i]
+        self._i += 1
+        return d
+
+    def reset(self):
+        self._i = 0
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Background-prefetch wrapper for MultiDataSet iterators
+    (ref: datasets/iterator/AsyncMultiDataSetIterator.java).  Shares the
+    whole thread/queue/sentinel machinery with AsyncDataSetIterator —
+    only the item transform differs (MultiDataSets pass through)."""
+
+    def __init__(self, underlying: MultiDataSetIterator,
+                 queue_size: int = 4):
+        super().__init__(underlying, queue_size=queue_size,
+                         device_put=False)
+
+    def _transform(self, d):
+        return d
+
+    def batch_size(self):  # MultiDataSet iterators need not expose this
+        fn = getattr(self.underlying, "batch_size", None)
+        return fn() if fn else 0

@@ -1,0 +1,85 @@
+"""Pretrained-model helper
+(ref: deeplearning4j-modelimport keras/trainedmodels/TrainedModels.java
+(VGG16 enum + ImageNet preprocessing/decoding) and
+TrainedModelHelper.java (download + import)).
+
+Zero-egress environment: weights are loaded from a LOCAL Keras .h5 file
+(the same artifact the reference downloads) or from a cache directory;
+the download step itself is gated with a clear error naming the cache
+path.  Preprocessing/decoding match the reference (Caffe-style BGR mean
+subtraction for VGG16)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+CACHE_DIR = Path.home() / ".deeplearning4j_tpu" / "trainedmodels"
+
+# Mean pixel (BGR) used by VGG16's Caffe preprocessing
+# (ref: TrainedModels.VGG16.getPreProcessor → VGG16ImagePreProcessor).
+VGG16_BGR_MEAN = np.array([103.939, 116.779, 123.68], np.float32)
+
+
+class TrainedModels:
+    """Enum-style registry (ref: keras/trainedmodels/TrainedModels.java)."""
+
+    VGG16 = "vgg16"
+    ALL = (VGG16,)
+
+    _FILES = {VGG16: "vgg16_weights.h5"}
+
+    @classmethod
+    def weights_file(cls, model: str) -> Path:
+        return CACHE_DIR / cls._FILES[model]
+
+
+def vgg16_preprocess(images: np.ndarray) -> np.ndarray:
+    """RGB [N,3,H,W] in [0,255] → BGR mean-subtracted
+    (ref: VGG16ImagePreProcessor.preProcess)."""
+    x = np.asarray(images, np.float32)
+    bgr = x[:, ::-1, :, :].copy()               # RGB→BGR on channel axis
+    for c in range(3):
+        bgr[:, c] -= VGG16_BGR_MEAN[c]
+    return bgr
+
+
+def decode_predictions(probs: np.ndarray, top: int = 5,
+                       labels: Optional[List[str]] = None
+                       ) -> List[List[Tuple[str, float]]]:
+    """Top-k (label, probability) per row (ref: TrainedModels
+    decodePredictions).  Default labels are positional placeholders;
+    pass the ImageNet class list to get named classes."""
+    probs = np.asarray(probs)
+    out = []
+    for row in probs:
+        idx = np.argsort(-row)[:top]
+        out.append([(labels[i] if labels else f"class_{i}", float(row[i]))
+                    for i in idx])
+    return out
+
+
+class TrainedModelHelper:
+    """(ref: keras/trainedmodels/TrainedModelHelper.java)"""
+
+    def __init__(self, model: str = TrainedModels.VGG16):
+        if model not in TrainedModels.ALL:
+            raise ValueError(f"unknown pretrained model {model!r}")
+        self.model = model
+
+    def load_model(self, weights_path: Optional[str] = None):
+        """Import the pretrained network.  ``weights_path`` overrides the
+        cache location; with neither present the error names the cache
+        path to drop the file into (this environment cannot download)."""
+        path = Path(weights_path) if weights_path else (
+            TrainedModels.weights_file(self.model))
+        if not path.exists():
+            raise FileNotFoundError(
+                f"pretrained weights for {self.model} not found at {path}; "
+                "this environment has no network egress — place the Keras "
+                f".h5 weights file there (the artifact the reference "
+                "downloads from its model zoo) and retry")
+        from deeplearning4j_tpu.keras_import import KerasModelImport
+        return KerasModelImport.import_keras_model_and_weights(str(path))

@@ -417,6 +417,16 @@ class ComputationGraphConfiguration:
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
 
+    def to_yaml(self) -> str:
+        """(ref: ComputationGraphConfiguration.toYaml)"""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
     @staticmethod
     def from_dict(d: dict) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration(

@@ -113,6 +113,16 @@ class MultiLayerConfiguration:
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
 
+    def to_yaml(self) -> str:
+        """(ref: MultiLayerConfiguration.toYaml — Jackson YAML mapper)"""
+        import yaml
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration(

@@ -1,0 +1,250 @@
+"""Line-search optimizer family
+(ref: optimize/Solver.java:43, optimize/solvers/BaseOptimizer.java,
+BackTrackLineSearch.java (369 LoC), ConjugateGradient.java, LBFGS.java,
+LineGradientDescent.java; enum nn/api/OptimizationAlgorithm.java).
+
+The reference's normal path is SGD (the jitted train step in
+nn/multilayer.py); these full-batch second-order-ish methods are the
+rest of the ConvexOptimizer surface.  They operate on the flat parameter
+vector through ONE jitted value-and-grad of the network's score — each
+outer iteration is a handful of XLA calls, with the line search's
+repeated evaluations hitting the same compiled program."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import params as param_util
+
+
+def _flat_objective(net, dataset) -> Tuple[Callable, Callable]:
+    """→ (value_fn(flat)→score, vg_fn(flat)→(score, grad_flat)), both
+    jitted once.  Mask semantics match MultiLayerNetwork._build_score_fn
+    (features_mask/labels_mask respected), so these optimizers minimize
+    exactly what net.score(dataset) reports."""
+    template = net.net_params
+    fmask = dataset.features_mask
+    lmask = dataset.labels_mask
+
+    def score_of(params_tree):
+        out_layer = net.layers[-1]
+        g = net.conf.global_conf
+        preout, _, m, feats = net._forward_to_preout(
+            params_tree, net.net_state, dataset.features, fmask, False,
+            jax.random.PRNGKey(0))
+        lm = lmask if lmask is not None else (
+            m if (m is not None and m.ndim == preout.ndim - 1) else None)
+        if getattr(out_layer, "requires_features_for_score", False):
+            per_ex = out_layer.compute_score_with_features(
+                dataset.labels, preout, feats, params_tree[-1], lm)
+        else:
+            per_ex = out_layer.compute_score(dataset.labels, preout, lm)
+        score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
+        return score + net._reg_penalty(params_tree)
+
+    def value(flat):
+        return score_of(param_util.unflatten(flat, template))
+
+    def vg(flat):
+        s, g = jax.value_and_grad(score_of)(
+            param_util.unflatten(flat, template))
+        return s, param_util.flatten(g)
+
+    return jax.jit(value), jax.jit(vg)
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking along a search direction
+    (ref: optimize/solvers/BackTrackLineSearch.java — step max, alpha
+    shrink, sufficient-decrease c1)."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_iterations: int = 20, initial_step: float = 1.0,
+                 max_step: float = 100.0):
+        self.c1 = c1
+        self.shrink = shrink
+        self.max_iterations = max_iterations
+        self.initial_step = initial_step
+        self.max_step = max_step
+
+    def optimize(self, value_fn: Callable, vg: Callable, flat, score, grad,
+                 direction) -> Tuple[jnp.ndarray, float, jnp.ndarray, float]:
+        """→ (new_flat, new_score, new_grad, step_used); falls back to
+        step 0 (no move) when no decrease is found.  Trial points pay
+        only a forward pass; the gradient is computed once for the
+        accepted point."""
+        slope = float(jnp.vdot(grad, direction))
+        if slope >= 0:  # not a descent direction: flip to steepest
+            direction = -grad
+            slope = float(jnp.vdot(grad, direction))
+        dnorm = float(jnp.linalg.norm(direction))
+        step = min(self.initial_step,
+                   self.max_step / dnorm if dnorm > 0 else self.initial_step)
+        for _ in range(self.max_iterations):
+            cand = flat + step * direction
+            s = value_fn(cand)
+            if float(s) <= float(score) + self.c1 * step * slope:
+                s, g = vg(cand)
+                return cand, float(s), g, step
+            step *= self.shrink
+        return flat, float(score), grad, 0.0
+
+
+class _BaseLineSearchOptimizer:
+    """(ref: optimize/solvers/BaseOptimizer.java gradientAndScore loop)"""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.line_search = line_search or BackTrackLineSearch()
+        self.score_history: List[float] = []
+
+    def optimize(self, net, dataset) -> float:
+        value_fn, vg = _flat_objective(net, dataset)
+        flat = net.params()
+        score, grad = vg(flat)
+        score = float(score)
+        state = self._init_state(flat, grad)
+        for it in range(self.max_iterations):
+            direction, state = self._direction(flat, grad, state)
+            flat_new, score_new, grad_new, step = self.line_search.optimize(
+                value_fn, vg, flat, score, grad, direction)
+            self.score_history.append(score_new)
+            if step == 0.0 or abs(score - score_new) < self.tolerance:
+                flat, score, grad = flat_new, score_new, grad_new
+                break
+            state = self._post_step(state, flat, flat_new, grad, grad_new)
+            flat, score, grad = flat_new, score_new, grad_new
+        net.set_params(flat)
+        net._score = score
+        return score
+
+    # -- strategy hooks -----------------------------------------------------
+    def _init_state(self, flat, grad):
+        return None
+
+    def _direction(self, flat, grad, state):
+        raise NotImplementedError
+
+    def _post_step(self, state, flat_old, flat_new, grad_old, grad_new):
+        return state
+
+
+class LineGradientDescent(_BaseLineSearchOptimizer):
+    """Steepest descent + line search
+    (ref: optimize/solvers/LineGradientDescent.java)."""
+
+    def _direction(self, flat, grad, state):
+        return -grad, state
+
+
+class ConjugateGradient(_BaseLineSearchOptimizer):
+    """Polak-Ribière nonlinear CG
+    (ref: optimize/solvers/ConjugateGradient.java)."""
+
+    def _init_state(self, flat, grad):
+        return {"prev_grad": grad, "prev_dir": -grad, "first": True}
+
+    def _direction(self, flat, grad, state):
+        if state["first"]:
+            state = dict(state, first=False)
+            return -grad, state
+        pg = state["prev_grad"]
+        beta = float(jnp.vdot(grad, grad - pg)
+                     / jnp.maximum(jnp.vdot(pg, pg), 1e-20))
+        beta = max(beta, 0.0)  # PR+ restart
+        d = -grad + beta * state["prev_dir"]
+        return d, state
+
+    def _post_step(self, state, flat_old, flat_new, grad_old, grad_new):
+        d = flat_new - flat_old
+        dn = float(jnp.linalg.norm(d))
+        return {"prev_grad": grad_new,
+                "prev_dir": d / dn if dn > 0 else -grad_new,
+                "first": False}
+
+
+class LBFGS(_BaseLineSearchOptimizer):
+    """Limited-memory BFGS, two-loop recursion
+    (ref: optimize/solvers/LBFGS.java — default memory m=4..10)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 memory: int = 10,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        super().__init__(max_iterations, tolerance, line_search)
+        self.memory = memory
+
+    def _init_state(self, flat, grad):
+        return {"s": [], "y": []}
+
+    def _direction(self, flat, grad, state):
+        s_list, y_list = state["s"], state["y"]
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(s_list), reversed(y_list)):
+            rho = 1.0 / float(jnp.maximum(jnp.vdot(y, s), 1e-20))
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if y_list:
+            y = y_list[-1]
+            s = s_list[-1]
+            gamma = float(jnp.vdot(s, y)
+                          / jnp.maximum(jnp.vdot(y, y), 1e-20))
+            q = gamma * q
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        return -q, state
+
+    def _post_step(self, state, flat_old, flat_new, grad_old, grad_new):
+        s = flat_new - flat_old
+        y = grad_new - grad_old
+        if float(jnp.vdot(s, y)) > 1e-10:  # curvature condition
+            state["s"].append(s)
+            state["y"].append(y)
+            if len(state["s"]) > self.memory:
+                state["s"].pop(0)
+                state["y"].pop(0)
+        return state
+
+
+class StochasticGradientDescent:
+    """The normal path — delegates to the jitted train step
+    (ref: optimize/solvers/StochasticGradientDescent.java:53-75)."""
+
+    def __init__(self, max_iterations: int = 1):
+        self.max_iterations = max_iterations
+
+    def optimize(self, net, dataset) -> float:
+        for _ in range(self.max_iterations):
+            net.fit(dataset)
+        return float(net.score())
+
+
+class Solver:
+    """(ref: optimize/Solver.java — builds a ConvexOptimizer from the
+    configured OptimizationAlgorithm)"""
+
+    ALGOS = {
+        "STOCHASTIC_GRADIENT_DESCENT": StochasticGradientDescent,
+        "LINE_GRADIENT_DESCENT": LineGradientDescent,
+        "CONJUGATE_GRADIENT": ConjugateGradient,
+        "LBFGS": LBFGS,
+    }
+
+    def __init__(self, algorithm: str = "STOCHASTIC_GRADIENT_DESCENT",
+                 **kwargs):
+        key = algorithm.upper()
+        if key not in self.ALGOS:
+            raise ValueError(f"unknown optimization algorithm {algorithm!r}; "
+                             f"one of {sorted(self.ALGOS)}")
+        self.optimizer = self.ALGOS[key](**kwargs)
+
+    def optimize(self, net, dataset) -> float:
+        return self.optimizer.optimize(net, dataset)

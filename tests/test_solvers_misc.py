@@ -1,0 +1,190 @@
+"""Line-search optimizer family, YAML config serde, LFW/Curves fetchers,
+pretrained-model helper (SURVEY.md §2.1 solvers, config system; §2.2
+fetchers; §2.9 trained models)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.fetchers import (
+    CurvesDataSetIterator, LFWDataSetIterator, load_curves, load_iris,
+    load_lfw)
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.solvers import (
+    LBFGS, ConjugateGradient, LineGradientDescent, Solver)
+
+
+def _net_and_data(seed=1):
+    ds = load_iris()
+    n = NormalizerStandardize()
+    n.fit(ds)
+    ds = n.transform(ds)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_in=12, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init(), ds
+
+
+@pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient,
+                                 LBFGS])
+def test_line_search_optimizers_reduce_loss(cls):
+    """(ref: BackTrackLineSearch/ConjugateGradient/LBFGS/
+    LineGradientDescent full-batch optimizers)"""
+    net, ds = _net_and_data()
+    before = float(net.score(ds))
+    opt = cls(max_iterations=40)
+    final = opt.optimize(net, ds)
+    assert np.isfinite(final)
+    assert final < before * 0.5, (before, final)
+    # score history is monotone non-increasing under Armijo
+    hist = opt.score_history
+    assert all(b <= a + 1e-6 for a, b in zip(hist, hist[1:]))
+    # params actually written back
+    assert abs(float(net.score(ds)) - final) < 1e-5
+
+
+def test_lbfgs_beats_one_gd_iteration():
+    net1, ds = _net_and_data(seed=2)
+    net2, _ = _net_and_data(seed=2)
+    gd = LineGradientDescent(max_iterations=5)
+    lb = LBFGS(max_iterations=5)
+    s_gd = gd.optimize(net1, ds)
+    s_lb = lb.optimize(net2, ds)
+    assert s_lb <= s_gd * 1.1  # curvature info should not hurt
+
+
+def test_solver_facade():
+    """(ref: optimize/Solver.java + OptimizationAlgorithm enum)"""
+    net, ds = _net_and_data(seed=3)
+    s = Solver("CONJUGATE_GRADIENT", max_iterations=20).optimize(net, ds)
+    assert np.isfinite(s)
+    with pytest.raises(ValueError, match="unknown optimization"):
+        Solver("NEWTON")
+    s2 = Solver("STOCHASTIC_GRADIENT_DESCENT",
+                max_iterations=3).optimize(net, ds)
+    assert np.isfinite(s2)
+
+
+def test_yaml_round_trip():
+    """(ref: MultiLayerConfiguration.toYaml/fromYaml)"""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    y = conf.to_yaml()
+    assert "DenseLayer" in y
+    conf2 = MultiLayerConfiguration.from_yaml(y)
+    assert conf2.to_json() == conf.to_json()
+    # the round-tripped config builds an identical network
+    n1 = MultiLayerNetwork(conf).init()
+    n2 = MultiLayerNetwork(conf2).init()
+    assert n1.num_params() == n2.num_params()
+
+
+def test_graph_yaml_round_trip():
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration, GraphBuilder)
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    conf = (GraphBuilder(GlobalConf(seed=1, learning_rate=0.1))
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .build())
+    conf2 = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    assert conf2.to_json() == conf.to_json()
+
+
+def test_lfw_fetcher():
+    """(ref: LFWDataSetIterator — synthetic fallback, class-separable)"""
+    it = LFWDataSetIterator(32, num_examples=128, n_labels=8)
+    ds = it.next()
+    assert ds.features.shape == (32, 3, 64, 64)
+    assert ds.labels.shape == (32, 8)
+    assert ds.labels.sum() == 32
+
+
+def test_curves_fetcher():
+    """(ref: CurvesDataFetcher.java — autoencoder dataset)"""
+    ds = load_curves(num_examples=64)
+    assert ds.features.shape == (64, 784)
+    np.testing.assert_array_equal(ds.features, ds.labels)
+    # curves are sparse binary rasters
+    assert 0 < ds.features.mean() < 0.2
+    assert set(np.unique(ds.features)) <= {0.0, 1.0}
+    it = CurvesDataSetIterator(16, num_examples=64)
+    assert it.next().num_examples() == 16
+
+
+def test_trained_models_helper(tmp_path):
+    """(ref: TrainedModels.java / TrainedModelHelper.java)"""
+    from deeplearning4j_tpu.models.trained_models import (
+        TrainedModelHelper, TrainedModels, decode_predictions,
+        vgg16_preprocess)
+    # preprocessing: RGB→BGR + mean subtraction
+    img = np.full((1, 3, 2, 2), 128.0, np.float32)
+    out = vgg16_preprocess(img)
+    np.testing.assert_allclose(out[0, 0], 128.0 - 103.939, atol=1e-4)
+    np.testing.assert_allclose(out[0, 2], 128.0 - 123.68, atol=1e-4)
+    # decode
+    probs = np.array([[0.1, 0.7, 0.2]])
+    top = decode_predictions(probs, top=2, labels=["cat", "dog", "fox"])
+    assert top[0][0] == ("dog", pytest.approx(0.7))
+    # missing weights → actionable error naming the path
+    helper = TrainedModelHelper(TrainedModels.VGG16)
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        helper.load_model(str(tmp_path / "missing.h5"))
+    with pytest.raises(ValueError):
+        TrainedModelHelper("resnet152")
+
+
+def test_async_multi_dataset_iterator():
+    """(ref: AsyncMultiDataSetIterator.java)"""
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncMultiDataSetIterator, ListMultiDataSetIterator)
+    rng = np.random.default_rng(0)
+    batches = [MultiDataSet([rng.normal(size=(4, 3)).astype(np.float32)],
+                            [np.eye(2, dtype=np.float32)[
+                                rng.integers(0, 2, 4)]])
+               for _ in range(5)]
+    it = AsyncMultiDataSetIterator(ListMultiDataSetIterator(batches), 2)
+    seen = []
+    while it.has_next():
+        seen.append(it.next())
+    assert len(seen) == 5
+    np.testing.assert_array_equal(seen[0].features[0], batches[0].features[0])
+    it.reset()
+    assert it.has_next()
+    assert sum(1 for _ in it) == 5
+
+    # ComputationGraph.fit consumes it
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    conf = (GraphBuilder(GlobalConf(seed=1, learning_rate=0.1,
+                                    updater="adam"))
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=3, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                          activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .build())
+    g = ComputationGraph(conf).init()
+    it.reset()
+    g.fit(it)
+    assert np.isfinite(float(g.score()))
