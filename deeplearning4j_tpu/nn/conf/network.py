@@ -55,6 +55,10 @@ class GlobalConf:
     lr_policy_steps: Optional[float] = None
     lr_policy_power: Optional[float] = None
     learning_rate_schedule: Optional[dict] = None
+    # Mixed-precision policy for the compiled step: None = auto (bf16
+    # compute on TPU, f32 elsewhere); 'float32' | 'bfloat16' | 'float64'.
+    # Master params/updater state stay float32 either way (ops/dtypes.py).
+    precision: Optional[str] = None
 
 
 _MERGE_FIELDS = [
@@ -227,6 +231,15 @@ class Builder:
         self._g.gradient_normalization = mode
         self._g.gradient_normalization_threshold = float(threshold)
         return self
+
+    def precision(self, p: Optional[str]):
+        """Mixed-precision policy: 'bfloat16' (TPU fast path), 'float32',
+        'float64', or None/'auto' (bf16 on TPU, f32 elsewhere)."""
+        self._g.precision = p
+        return self
+
+    def data_type(self, p: Optional[str]):  # reference-style alias
+        return self.precision(p)
 
     def learning_rate_policy(self, policy: str, decay_rate=None, steps=None,
                              power=None, schedule: Optional[dict] = None):

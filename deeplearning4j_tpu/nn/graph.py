@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration, GraphVertexConf, LayerVertex)
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, LossLayer
 from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.ops import dtypes as dtype_ops
 from deeplearning4j_tpu.ops import updaters as upd_ops
 from deeplearning4j_tpu.nn.multilayer import (
     BIAS_KEYS, WEIGHT_KEYS, _updater_for)
@@ -181,6 +182,7 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _build_step_raw(self):
         g = self.conf.global_conf
+        policy = dtype_ops.resolve(g.precision)
         out_confs = self._output_layer_confs()
         if not out_confs:
             raise ValueError("ComputationGraph.fit() needs >=1 output layer "
@@ -191,12 +193,17 @@ class ComputationGraph:
         out_pos = {n: self.conf.network_outputs.index(n) for n in out_names}
 
         def step(params, state, opts, xs, ys, fmasks, lmasks, it, rng):
+            xs_c, fmasks_c = policy.cast_to_compute((xs, fmasks))
+
             def loss_fn(p):
-                inputs = dict(zip(self.conf.network_inputs, xs))
-                masks = dict(zip(self.conf.network_inputs, fmasks)) \
-                    if fmasks is not None else {}
+                pc = policy.cast_to_compute(p)
+                inputs = dict(zip(self.conf.network_inputs, xs_c))
+                masks = dict(zip(self.conf.network_inputs, fmasks_c)) \
+                    if fmasks_c is not None else {}
                 acts, preouts, new_states, out_masks = self._forward_all(
-                    p, state, inputs, masks, True, rng, preout_for=out_names)
+                    pc, state, inputs, masks, True, rng, preout_for=out_names)
+                preouts = {n: policy.cast_to_accum(v) for n, v in preouts.items()}
+                new_states = policy.cast_to_param(new_states)
                 score = 0.0
                 for name in out_names:
                     oi = out_pos[name]
@@ -282,9 +289,10 @@ class ComputationGraph:
 
     def _check_trace_token(self):
         """See MultiLayerNetwork._check_trace_token — retrace when the
-        ambient sequence-parallel regime changes."""
+        ambient sequence-parallel regime or precision policy changes."""
         from deeplearning4j_tpu.parallel import sequence as seq_ops
-        tok = seq_ops.cache_token()
+        tok = (seq_ops.cache_token(),
+               dtype_ops.resolve(self.conf.global_conf.precision))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -328,11 +336,15 @@ class ComputationGraph:
             self.init()
         self._check_trace_token()
         if self._output_fn is None:
+            policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
             def out_fn(params, state, xs):
-                ins = dict(zip(self.conf.network_inputs, xs))
-                acts, _, _, _ = self._forward_all(params, state, ins, {},
+                pc, xs_c = policy.cast_to_compute((params, xs))
+                ins = dict(zip(self.conf.network_inputs, xs_c))
+                acts, _, _, _ = self._forward_all(pc, state, ins, {},
                                                   False, jax.random.PRNGKey(0))
-                return tuple(acts[n] for n in self.conf.network_outputs)
+                return tuple(policy.cast_to_param(acts[n])
+                             for n in self.conf.network_outputs)
             self._output_fn = jax.jit(out_fn)
         state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
                  for n, s in self.net_state.items()}
@@ -349,16 +361,19 @@ class ComputationGraph:
             out_confs = self._output_layer_confs()
             out_pos = {n: self.conf.network_outputs.index(n) for n in out_confs}
             g = self.conf.global_conf
+            policy = dtype_ops.resolve(g.precision)
 
             def score_fn(params, state, xs, ys):
-                inputs = dict(zip(self.conf.network_inputs, xs))
+                pc, xs_c = policy.cast_to_compute((params, xs))
+                inputs = dict(zip(self.conf.network_inputs, xs_c))
                 _, preouts, _, _ = self._forward_all(
-                    params, state, inputs, {}, False, jax.random.PRNGKey(0),
+                    pc, state, inputs, {}, False, jax.random.PRNGKey(0),
                     preout_for=list(out_confs))
                 total = 0.0
                 for name, lc in out_confs.items():
-                    per_ex = lc.compute_score(ys[out_pos[name]], preouts[name],
-                                              None)
+                    per_ex = lc.compute_score(
+                        ys[out_pos[name]],
+                        policy.cast_to_accum(preouts[name]), None)
                     total = total + (jnp.mean(per_ex) if g.mini_batch
                                      else jnp.sum(per_ex))
                 return total + self._reg_penalty(params)

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.listeners import IterationListener, TrainingListener
+from deeplearning4j_tpu.ops import dtypes as dtype_ops
 from deeplearning4j_tpu.ops import updaters as upd_ops
 
 WEIGHT_KEYS = {"W", "RW", "f_W", "f_RW", "b_W", "b_RW"}
@@ -181,12 +182,14 @@ class MultiLayerNetwork:
         return total
 
     def _check_trace_token(self):
-        """Invalidate cached jitted functions when the ambient
-        sequence-parallel regime changed (parallel/sequence.sequence_mesh)
-        — the shard_map collectives are baked into the traced program, so
-        a cached step from another regime is silently wrong."""
+        """Invalidate cached jitted functions when ambient trace-relevant
+        state changed: the sequence-parallel regime
+        (parallel/sequence.sequence_mesh — shard_map collectives are baked
+        into the traced program) or the mixed-precision policy
+        (ops/dtypes.set_default_policy — compute dtypes are baked in too)."""
         from deeplearning4j_tpu.parallel import sequence as seq_ops
-        tok = seq_ops.cache_token()
+        tok = (seq_ops.cache_token(),
+               dtype_ops.resolve(self.conf.global_conf.precision))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -199,22 +202,35 @@ class MultiLayerNetwork:
 
     def _build_step_raw(self):
         """The pure (un-jitted) train step — ParallelWrapper re-jits it with
-        mesh shardings or vmaps it for parameter-averaging compat."""
+        mesh shardings or vmaps it for parameter-averaging compat.
+
+        Mixed precision (the reference trains f32; the TPU-native fast path
+        is bf16 on the MXU): the policy from conf.precision / ops.dtypes
+        casts params+inputs to the compute dtype INSIDE the loss closure, so
+        jax.grad differentiates through the cast and yields float32 master
+        gradients; updater state and the loss/softmax accumulation stay
+        float32, and carried state (BN stats, RNN carries) is upcast back."""
         g = self.conf.global_conf
+        policy = dtype_ops.resolve(g.precision)
         out_layer = self.layers[-1]
         if not isinstance(out_layer, (BaseOutputLayer, LossLayer)):
             raise ValueError("Last layer must be an output/loss layer to fit()")
 
         def step(params, state, opts, x, y, fmask, lmask, it, rng):
+            xc, fmc = policy.cast_to_compute((x, fmask))
+
             def loss_fn(p):
+                pc = policy.cast_to_compute(p)
                 preout, new_states, m, feats = self._forward_to_preout(
-                    p, state, x, fmask, True, rng,
+                    pc, state, xc, fmc, True, rng,
                     stateful_rnn=(self.conf.backprop_type == "truncatedbptt"))
+                preout = policy.cast_to_accum(preout)
+                new_states = policy.cast_to_param(new_states)
                 lm = lmask if lmask is not None else (
                     m if (m is not None and m.ndim == preout.ndim - 1) else None)
                 if getattr(out_layer, "requires_features_for_score", False):
                     per_ex = out_layer.compute_score_with_features(
-                        y, preout, feats, p[-1], lm)
+                        y, preout, policy.cast_to_accum(feats), p[-1], lm)
                 else:
                     per_ex = out_layer.compute_score(y, preout, lm)
                 score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
@@ -267,15 +283,18 @@ class MultiLayerNetwork:
     def _build_score_fn(self):
         out_layer = self.layers[-1]
         g = self.conf.global_conf
+        policy = dtype_ops.resolve(g.precision)
 
         def score_fn(params, state, x, y, fmask, lmask):
+            pc, xc, fmc = policy.cast_to_compute((params, x, fmask))
             preout, _, m, feats = self._forward_to_preout(
-                params, state, x, fmask, False, jax.random.PRNGKey(0))
+                pc, state, xc, fmc, False, jax.random.PRNGKey(0))
+            preout = policy.cast_to_accum(preout)
             lm = lmask if lmask is not None else (
                 m if (m is not None and m.ndim == preout.ndim - 1) else None)
             if getattr(out_layer, "requires_features_for_score", False):
                 per_ex = out_layer.compute_score_with_features(
-                    y, preout, feats, params[-1], lm)
+                    y, preout, policy.cast_to_accum(feats), params[-1], lm)
             else:
                 per_ex = out_layer.compute_score(y, preout, lm)
             score = jnp.mean(per_ex) if g.mini_batch else jnp.sum(per_ex)
@@ -284,10 +303,13 @@ class MultiLayerNetwork:
         return jax.jit(score_fn)
 
     def _build_output_fn(self):
+        policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
         def output_fn(params, state, x, fmask):
-            out, _, _ = self._forward(params, state, x, fmask, False,
+            pc, xc, fmc = policy.cast_to_compute((params, x, fmask))
+            out, _, _ = self._forward(pc, state, xc, fmc, False,
                                       jax.random.PRNGKey(0))
-            return out
+            return policy.cast_to_param(out)
         return jax.jit(output_fn)
 
     # ------------------------------------------------------------------

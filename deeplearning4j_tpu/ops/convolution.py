@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning4j_tpu.ops import dtypes as dtype_ops
+
 _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
@@ -38,7 +40,7 @@ def conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0), dilation=(1, 1),
     float64 inputs (gradient checks on CPU) accumulate in f64.
     """
     if accum_dtype is None:
-        accum_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        accum_dtype = dtype_ops.accum_dtype_for(x.dtype)
     padding = _same_pad(w.shape[2:], stride, pad, "same" if border_mode == "same" else "explicit")
     y = lax.conv_general_dilated(
         x, w,
@@ -104,7 +106,7 @@ def conv1d(x, w, b=None, stride=1, pad=0, dilation=1,
     data).  One conv HLO on the MXU; NWC layout is TPU-friendly (channels
     minor → lane dimension)."""
     if accum_dtype is None:
-        accum_dtype = jnp.promote_types(x.dtype, jnp.float32)
+        accum_dtype = dtype_ops.accum_dtype_for(x.dtype)
     padding = "SAME" if border_mode == "same" else [(pad, pad)]
     y = lax.conv_general_dilated(
         x, w,

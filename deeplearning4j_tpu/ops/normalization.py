@@ -22,13 +22,18 @@ def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
     reference's BatchNormalization.decay (momentum on running stats).
     """
     axes = (0,) if x.ndim == 2 else (0, 2, 3)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    # Batch statistics accumulate in float32 even under a bf16 compute
+    # policy — bf16 mean/var over large N·H·W loses too many bits.
+    stat_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    xs = x.astype(stat_dtype)
+    mean = jnp.mean(xs, axis=axes)
+    var = jnp.var(xs, axis=axes)
     shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
-    xn = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    xn = ((xs - mean.reshape(shape))
+          / jnp.sqrt(var.reshape(shape) + eps)).astype(x.dtype)
     y = gamma.reshape(shape) * xn + beta.reshape(shape)
-    new_mean = decay * running_mean + (1 - decay) * mean
-    new_var = decay * running_var + (1 - decay) * var
+    new_mean = decay * running_mean.astype(stat_dtype) + (1 - decay) * mean
+    new_var = decay * running_var.astype(stat_dtype) + (1 - decay) * var
     return y, new_mean, new_var
 
 

@@ -1,0 +1,172 @@
+"""Mixed-precision policy tests (VERDICT r1 item 2).
+
+The engine casts params+inputs to the compute dtype inside the loss
+closure (ops/dtypes.Policy), keeps float32 master params/updater state,
+and accumulates the loss in float32.  On this CPU test mesh the auto
+policy is FLOAT32, so these tests force bfloat16 explicitly and assert
+(a) the compiled step really computes in bf16 (jaxpr inspection),
+(b) master params/optimizer state stay f32, (c) training still learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+    SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops import dtypes as dtype_ops
+
+
+def _toy_net(precision):
+    return (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("adam")
+            .precision(precision)
+            .list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+
+
+def _toy_data(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    labels = rng.integers(0, 3, n)
+    x[np.arange(n), labels] += 2.5  # separable
+    y = np.eye(3, dtype=np.float32)[labels]
+    return x, y
+
+
+def test_policy_resolution():
+    assert dtype_ops.resolve("float32") is dtype_ops.FLOAT32
+    assert dtype_ops.resolve("float") is dtype_ops.FLOAT32  # reference name
+    assert dtype_ops.resolve("bf16") is dtype_ops.BF16
+    assert dtype_ops.resolve("half") is dtype_ops.BF16  # no fp16 on TPU
+    assert dtype_ops.resolve("double") is dtype_ops.FLOAT64
+    # auto on the CPU test backend is f32
+    assert dtype_ops.resolve(None) is dtype_ops.FLOAT32
+    with pytest.raises(ValueError):
+        dtype_ops.resolve("int7")
+
+
+def test_cast_to_compute_leaves_f64_and_ints_alone():
+    p = dtype_ops.BF16
+    with jax.enable_x64(True):
+        tree = {"w": jnp.ones((2, 2), jnp.float32),
+                "idx": jnp.zeros((3,), jnp.int32),
+                "check": jnp.ones((2,), jnp.float64)}
+        out = p.cast_to_compute(tree)
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["idx"].dtype == jnp.int32
+        assert out["check"].dtype == jnp.float64  # gradient-check path untouched
+
+
+def test_bf16_step_computes_in_bf16_with_f32_master():
+    net = MultiLayerNetwork(_toy_net("bfloat16")).init()
+    x, y = _toy_data()
+    # (a) the traced step contains bf16 compute
+    step = net._build_step_raw()
+    jaxpr = str(jax.make_jaxpr(step)(
+        net.net_params, net.net_state, net.opt_states,
+        jnp.asarray(x), jnp.asarray(y), None, None,
+        jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)))
+    assert "bf16" in jaxpr, "no bfloat16 compute in the compiled step"
+    # the dense matmul itself runs in bf16 (not just a stray cast)
+    assert "dot_general" in jaxpr
+
+    net.fit(x, y)
+    # (b) master params, updater state, BN running stats all stay f32
+    for leaf in jax.tree_util.tree_leaves(net.net_params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(net.opt_states):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(net.net_state):
+        assert leaf.dtype == jnp.float32
+    assert np.isfinite(net.score())
+
+
+def test_bf16_training_learns():
+    net = MultiLayerNetwork(_toy_net("bfloat16")).init()
+    x, y = _toy_data()
+    net.fit(x, y)
+    first = net.score()
+    for _ in range(30):
+        net.fit(x, y)
+    assert net.score() < first
+    acc = (net.predict(x) == np.argmax(y, axis=1)).mean()
+    assert acc > 0.8
+
+
+def test_bf16_output_returns_f32():
+    net = MultiLayerNetwork(_toy_net("bfloat16")).init()
+    x, _ = _toy_data(8)
+    out = net.output(x)
+    assert out.dtype == jnp.float32
+    assert out.shape == (8, 3)
+
+
+def test_bf16_matches_f32_direction():
+    """One bf16 step moves params in (approximately) the f32 direction."""
+    x, y = _toy_data(32)
+    updates = {}
+    for prec in ("float32", "bfloat16"):
+        net = MultiLayerNetwork(_toy_net(prec)).init()
+        before = np.asarray(net.params())
+        net.fit(x, y)
+        updates[prec] = np.asarray(net.params()) - before
+    # identical seeds → identical init; update directions near-parallel
+    # (elementwise comparison is meaningless under Adam's sign-normalized
+    # steps, where a bf16-rounded tiny gradient can flip an element)
+    a, b = updates["float32"], updates["bfloat16"]
+    cos = a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.98, cos
+
+
+def test_bf16_cnn_step():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05).updater("sgd")
+            .precision("bfloat16")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3), activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(16, 1, 8, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    net.fit(x, y)
+    assert np.isfinite(net.score())
+    for leaf in jax.tree_util.tree_leaves(net.net_params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_computation_graph():
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = GlobalConf(seed=5, learning_rate=0.1, updater="adam",
+                   precision="bfloat16")
+    conf = (GraphBuilder(g)
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=8, n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x, y = _toy_data(32)
+    net.fit(x, y)
+    assert np.isfinite(net.score())
+    for leaf in jax.tree_util.tree_leaves(net.net_params):
+        assert leaf.dtype == jnp.float32
+    out = net.output(x)[0]
+    assert out.dtype == jnp.float32
