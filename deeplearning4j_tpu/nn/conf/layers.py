@@ -65,6 +65,7 @@ class Layer:
     l1_bias: Optional[float] = None
     l2_bias: Optional[float] = None
     dropout: Optional[float] = None
+    use_drop_connect: Optional[bool] = None
     updater: Optional[str] = None
     momentum: Optional[float] = None
     rho: Optional[float] = None
@@ -99,9 +100,27 @@ class Layer:
         return act_ops.get(self.activation or "identity")(x)
 
     def _maybe_dropout(self, x, train: bool, rng):
+        # DropConnect reuses the dropout probability on WEIGHTS instead of
+        # activations — mutually exclusive with input dropout (ref:
+        # util/Dropout.java applyDropConnect vs applyDropout; BaseLayer
+        # applies one or the other depending on conf.isUseDropConnect())
+        if self.use_drop_connect:
+            return x
         if train and self.dropout and 0.0 < self.dropout < 1.0 and rng is not None:
             return norm_ops.dropout(x, self.dropout, rng)
         return x
+
+    def _maybe_drop_connect(self, params: dict, train: bool, rng):
+        """DropConnect (Wan et al.; ref: util/Dropout.java:applyDropConnect):
+        zero each weight with retain probability ``dropout``, inverted
+        scaling, leaving biases intact."""
+        if not (self.use_drop_connect and train and self.dropout
+                and 0.0 < self.dropout < 1.0 and rng is not None and
+                "W" in params):
+            return params
+        return {**params,
+                "W": norm_ops.dropout(params["W"], self.dropout,
+                                      jax.random.fold_in(rng, 0x0D20))}
 
     def _winit(self, key, shape, dtype, fan_in=None, fan_out=None):
         return initializers.init(
@@ -146,7 +165,8 @@ class DenseLayer(Layer):
 
     def forward(self, params, state, x, *, train, rng, mask=None):
         x = self._maybe_dropout(x, train, rng)
-        return self._act(x @ params["W"] + params["b"]), state, mask
+        p = self._maybe_drop_connect(params, train, rng)
+        return self._act(x @ p["W"] + p["b"]), state, mask
 
     def output_type(self, input_type):
         return InputType.feed_forward(self.n_out)
@@ -303,7 +323,8 @@ class ConvolutionLayer(Layer):
 
     def forward(self, params, state, x, *, train, rng, mask=None):
         x = self._maybe_dropout(x, train, rng)
-        y = conv_ops.conv2d(x, params["W"], params["b"], self.stride,
+        p = self._maybe_drop_connect(params, train, rng)
+        y = conv_ops.conv2d(x, p["W"], p["b"], self.stride,
                             self.padding, self.dilation, self.convolution_mode)
         return self._act(y), state, mask
 

@@ -64,9 +64,9 @@ class GlobalConf:
 _MERGE_FIELDS = [
     "activation", "weight_init", "bias_init", "dist", "learning_rate",
     "bias_learning_rate", "l1", "l2", "l1_bias", "l2_bias", "dropout",
-    "updater", "momentum", "rho", "rms_decay", "adam_mean_decay",
-    "adam_var_decay", "epsilon", "gradient_normalization",
-    "gradient_normalization_threshold",
+    "use_drop_connect", "updater", "momentum", "rho", "rms_decay",
+    "adam_mean_decay", "adam_var_decay", "epsilon",
+    "gradient_normalization", "gradient_normalization_threshold",
 ]
 
 
@@ -217,6 +217,12 @@ class Builder:
 
     def drop_out(self, v):
         self._g.dropout = float(v); return self
+
+    def use_drop_connect(self, on: bool = True):
+        """Reuse the dropout probability on weights instead of activations
+        (ref: NeuralNetConfiguration.Builder.useDropConnect /
+        util/Dropout.java applyDropConnect)."""
+        self._g.use_drop_connect = bool(on); return self
 
     def minimize(self, on: bool = True):
         self._g.minimize = bool(on); return self

@@ -175,6 +175,30 @@ class EarlyStoppingResult:
     best_model: object
 
 
+def validate_termination_conditions(cfg: EarlyStoppingConfiguration) -> None:
+    """A configuration with no termination condition at all would train
+    forever — reject it up front (advisor round-1 finding; the reference's
+    builder documents that at least one condition is required)."""
+    if (not cfg.epoch_termination_conditions
+            and not cfg.iteration_termination_conditions):
+        raise ValueError(
+            "EarlyStoppingConfiguration requires at least one epoch or "
+            "iteration termination condition — otherwise fit() never stops")
+
+
+def check_score_free_epoch_conditions(cfg: EarlyStoppingConfiguration,
+                                      epoch: int):
+    """Score-independent epoch conditions (MaxEpochs) must fire on EVERY
+    epoch, not only on evaluate_every_n_epochs boundaries — otherwise
+    MaxEpochs(3) with evaluate_every_n_epochs=5 overshoots (or loops
+    forever).  Returns the fired condition or None."""
+    for cond in cfg.epoch_termination_conditions:
+        if isinstance(cond, MaxEpochsTerminationCondition) \
+                and cond.terminate(epoch, math.nan):
+            return cond
+    return None
+
+
 class EarlyStoppingTrainer:
     """(ref: trainer/EarlyStoppingTrainer.java / BaseEarlyStoppingTrainer.fit :76)"""
 
@@ -185,6 +209,7 @@ class EarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        validate_termination_conditions(cfg)
         best_score = math.inf
         best_epoch = -1
         score_vs_epoch = {}
@@ -225,6 +250,11 @@ class EarlyStoppingTrainer:
                         stop = True
                         break
                 if stop:
+                    break
+            else:
+                fired = check_score_free_epoch_conditions(cfg, epoch)
+                if fired is not None:
+                    reason, details = "EpochTerminationCondition", repr(fired)
                     break
             epoch += 1
         best = cfg.model_saver.get_best()

@@ -129,6 +129,95 @@ def _fd_check_one(arr, analytic, eval_with, epsilon, max_rel_error,
     return failures, len(idxs)
 
 
+def check_computation_graph_gradients(
+        graph, inputs, labels, *, epsilon: float = 1e-6,
+        max_rel_error: float = 1e-3, min_abs_error: float = 1e-8,
+        fmasks=None, lmasks=None, subset: Optional[int] = 64,
+        seed: int = 0, print_results: bool = False) -> bool:
+    """ComputationGraph analog of :func:`check_gradients` — rebuilds the
+    training score exactly as ComputationGraph._build_step_raw's loss
+    closure does (multi-output sum, masks, regularization, MoE aux loss)
+    and central-differences every vertex's params in f64 on CPU
+    (ref: GradientCheckUtil.checkGradients(ComputationGraph...):238,
+    GradientCheckTestsComputationGraph.java).
+
+    inputs/labels: list-like ordered by network_inputs/network_outputs.
+    """
+    with jax.enable_x64(True):
+        return _check_cg_x64(graph, inputs, labels, epsilon=epsilon,
+                             max_rel_error=max_rel_error,
+                             min_abs_error=min_abs_error, fmasks=fmasks,
+                             lmasks=lmasks, subset=subset, seed=seed,
+                             print_results=print_results)
+
+
+def _check_cg_x64(graph, inputs, labels, *, epsilon, max_rel_error,
+                  min_abs_error, fmasks, lmasks, subset, seed,
+                  print_results) -> bool:
+    if graph.net_params is None:
+        graph.init()
+    g = graph.conf.global_conf
+    rng = jax.random.PRNGKey(seed)
+    out_confs = graph._output_layer_confs()
+    out_names = list(out_confs)
+    out_pos = {n: graph.conf.network_outputs.index(n) for n in out_names}
+
+    to64 = lambda t: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: jnp.asarray(np.asarray(a), jnp.float64)
+        if np.asarray(a).dtype.kind == "f" else jnp.asarray(a), t)
+    params64 = to64(graph.net_params)
+    state64 = to64(graph.net_state)
+    xs64 = [jnp.asarray(np.asarray(x), jnp.float64) for x in inputs]
+    ys64 = [jnp.asarray(np.asarray(y), jnp.float64) for y in labels]
+
+    def score(p):
+        ins = dict(zip(graph.conf.network_inputs, xs64))
+        masks = (dict(zip(graph.conf.network_inputs, fmasks))
+                 if fmasks is not None else {})
+        acts, preouts, new_states, out_masks = graph._forward_all(
+            p, state64, ins, masks, True, rng, preout_for=out_names)
+        # the SAME loss assembly the training step compiles
+        # (ComputationGraph._assemble_training_score) — no drift between
+        # checked and trained functions
+        return graph._assemble_training_score(
+            p, preouts, new_states, out_masks, ys64, lmasks,
+            out_confs, out_pos)
+
+    score_jit = jax.jit(score)
+    analytic = jax.grad(score)(params64)
+
+    nprng = np.random.default_rng(seed)
+    total_checked = 0
+    failures = []
+    for name in graph.order:
+        lp = params64[name]
+        if not lp:
+            continue
+        for k in param_util.ordered_keys(lp):
+            if np.asarray(lp[k]).dtype.kind != "f":
+                continue
+
+            def eval_with(arr, name=name, k=k):
+                pp = dict(params64)
+                pp[name] = {**pp[name], k: jnp.asarray(arr)}
+                return float(score_jit(pp))
+
+            fails, checked = _fd_check_one(
+                lp[k], np.asarray(analytic[name][k]), eval_with,
+                epsilon, max_rel_error, min_abs_error, subset, nprng)
+            total_checked += checked
+            failures.extend((f"vertex {name} {k}", i, a, num, rel)
+                            for i, a, num, rel in fails)
+
+    if print_results or failures:
+        print(f"CG gradient check: {total_checked} params checked, "
+              f"{len(failures)} failures")
+        for label, i, a, num, rel in failures[:20]:
+            print(f"  {label}[{i}]: analytic={a:.3e} numeric={num:.3e} "
+                  f"rel={rel:.3e}")
+    return not failures
+
+
 def check_pretrain_gradients(layer, params, x, *, epsilon: float = 1e-6,
                              max_rel_error: float = 1e-3,
                              min_abs_error: float = 1e-8,

@@ -143,7 +143,8 @@ class ComputationGraph:
                 x = ins[0]
                 if train:
                     x = lc._maybe_dropout(x, True, r)
-                pre = lc.preoutput(params[name], x)
+                pre = lc.preoutput(
+                    lc._maybe_drop_connect(params[name], train, r), x)
                 preouts[name] = pre
                 new_states[name] = state[name]
                 acts[name] = lc._act(pre)
@@ -180,6 +181,45 @@ class ComputationGraph:
         return total
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_label_mask(preout, lm, out_mask):
+        """Label-mask resolution shared by the training step and the
+        gradient checker (nn/gradientcheck._check_cg_x64) so the checked
+        function IS the trained function.  compute_score owns any
+        [..., None] expansion (RnnOutputLayer expands [N,T] itself);
+        only an already-expanded [N,T,1] TIME mask is squeezed — a
+        per-example [N,1] mask on a 2-D output broadcasts as-is."""
+        if lm is None:
+            lm = out_mask if (out_mask is not None
+                              and out_mask.ndim == preout.ndim - 1) else None
+        if lm is not None and preout.ndim == 3 and lm.ndim == 3 \
+                and lm.shape[-1] == 1:
+            lm = lm[..., 0]
+        return lm
+
+    def _assemble_training_score(self, params, preouts, new_states,
+                                 out_masks, ys, lmasks, out_confs, out_pos):
+        """Multi-output training score from forward results: per-output
+        loss (masked), minibatch reduction, regularization penalty, and
+        layer-surfaced aux losses (MoE load balancing).  Single source of
+        truth for the step AND the gradient checker."""
+        g = self.conf.global_conf
+        score = 0.0
+        for name, lc in out_confs.items():
+            oi = out_pos[name]
+            pre = preouts[name]
+            lm = self._resolve_label_mask(
+                pre, lmasks[oi] if lmasks is not None else None,
+                out_masks.get(name))
+            per_ex = lc.compute_score(ys[oi], pre, lm)
+            score = score + (jnp.mean(per_ex) if g.mini_batch
+                             else jnp.sum(per_ex))
+        score = score + self._reg_penalty(params)
+        for s in new_states.values():
+            if isinstance(s, dict) and "moe_aux_loss" in s:
+                score = score + s["moe_aux_loss"]
+        return score
+
     def _build_step_raw(self):
         g = self.conf.global_conf
         policy = dtype_ops.resolve(g.precision)
@@ -204,27 +244,9 @@ class ComputationGraph:
                     pc, state, inputs, masks, True, rng, preout_for=out_names)
                 preouts = {n: policy.cast_to_accum(v) for n, v in preouts.items()}
                 new_states = policy.cast_to_param(new_states)
-                score = 0.0
-                for name in out_names:
-                    oi = out_pos[name]
-                    lc = out_confs[name]
-                    y = ys[oi]
-                    lm = lmasks[oi] if lmasks is not None else None
-                    if lm is None:
-                        m = out_masks.get(name)
-                        pre = preouts[name]
-                        lm = m if (m is not None and m.ndim == pre.ndim - 1) else None
-                    if lm is not None and preouts[name].ndim == 3:
-                        lm = lm[..., None] if lm.ndim == 2 else lm
-                    per_ex = lc.compute_score(y, preouts[name], lm)
-                    score = score + (jnp.mean(per_ex) if g.mini_batch
-                                     else jnp.sum(per_ex))
-                score = score + self._reg_penalty(p)
-                # aux losses surfaced by layers through state (e.g. MoE
-                # load balancing) — same convention as MultiLayerNetwork
-                for s in new_states.values():
-                    if isinstance(s, dict) and "moe_aux_loss" in s:
-                        score = score + s["moe_aux_loss"]
+                score = self._assemble_training_score(
+                    p, preouts, new_states, out_masks, ys, lmasks,
+                    out_confs, out_pos)
                 return score, new_states
 
             (score, new_states), grads = jax.value_and_grad(
@@ -300,6 +322,10 @@ class ComputationGraph:
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
             self.init()
+        if self.conf.backprop_type == "truncatedbptt" \
+                and any(f.ndim == 3 for f in mds.features):
+            self._fit_tbptt(mds)
+            return
         self._check_trace_token()
         if self._step_fn is None:
             self._step_fn = self._build_step()
@@ -327,6 +353,82 @@ class ComputationGraph:
             return
         self.net_state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
                           for n, s in self.net_state.items()}
+
+    def _fit_tbptt(self, mds: MultiDataSet):
+        """Truncated BPTT over time segments with carried RNN state —
+        the graph analog of MultiLayerNetwork._fit_tbptt
+        (ref: ComputationGraph.doTruncatedBPTT :1476).  Time-major-3D
+        features [N, T, C] are segmented along T; the per-vertex
+        rnn_state carries across segments inside one batch and is
+        cleared between batches."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        self.last_batch_size = mds.num_examples()
+        T = max(f.shape[1] for f in mds.features if f.ndim == 3)
+        L = self.conf.tbptt_fwd_length
+        self.rnn_clear_previous_state()
+
+        def seg(arr, sl):
+            return arr[:, sl] if (arr is not None and arr.ndim == 3) else arr
+
+        def seg_mask(m, sl):
+            # masks are [N, T] (or [N, T, 1]); slice any mask whose time
+            # axis matches the full length — 2-D masks included
+            # (MultiLayerNetwork._fit_tbptt slices its masks the same way)
+            if m is None or m.ndim < 2 or m.shape[1] != T:
+                return m
+            return m[:, sl]
+
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            xs = tuple(jnp.asarray(seg(f, sl)) for f in mds.features)
+            ys = tuple(jnp.asarray(seg(l, sl)) for l in mds.labels)
+            fm = (tuple(None if m is None else jnp.asarray(seg_mask(m, sl))
+                        for m in mds.features_masks)
+                  if mds.features_masks is not None else None)
+            lm = (tuple(None if m is None else jnp.asarray(seg_mask(m, sl))
+                        for m in mds.labels_masks)
+                  if mds.labels_masks is not None else None)
+            self._key, sub = jax.random.split(self._key)
+            (self.net_params, self.net_state, self.opt_states,
+             score) = self._step_fn(
+                self.net_params, self.net_state, self.opt_states, xs, ys,
+                fm, lm, jnp.asarray(self.iteration, jnp.int32), sub)
+            self._score = score
+            self.iteration += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------
+    # Stateful RNN inference (ref: ComputationGraph.rnnTimeStep :1569)
+    # ------------------------------------------------------------------
+    def rnn_time_step(self, *inputs):
+        """Single/multi-step stateful inference: each call consumes
+        [N, T, C] sequences, returns the network outputs, and carries
+        every recurrent vertex's hidden state to the next call."""
+        if self.net_params is None:
+            self.init()
+        ins = dict(zip(self.conf.network_inputs,
+                       (jnp.asarray(x) for x in inputs)))
+        acts, _, new_states, _ = self._forward_all(
+            self.net_params, self.net_state, ins, {}, False,
+            jax.random.PRNGKey(0))
+        merged = {}
+        for name, old in self.net_state.items():
+            s = dict(old)
+            ns = new_states.get(name, {})
+            if isinstance(ns, dict) and "rnn_state" in ns:
+                s["rnn_state"] = ns["rnn_state"]
+            merged[name] = s
+        self.net_state = merged
+        return tuple(acts[n] for n in self.conf.network_outputs)
+
+    def rnn_clear_previous_state(self):
+        """(ref: ComputationGraph.rnnClearPreviousState :1608)"""
+        self._strip_rnn_state()
 
     # ------------------------------------------------------------------
     def output(self, *inputs, train: bool = False):

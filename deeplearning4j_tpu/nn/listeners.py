@@ -87,6 +87,55 @@ class CollectScoresIterationListener(IterationListener):
             self.scores.append((iteration, float(model.score())))
 
 
+class ProfilerListener(IterationListener):
+    """Capture a ``jax.profiler`` trace directory every N iterations —
+    SURVEY §5's prescribed deep-observability analog of the reference's
+    PerformanceListener timing logs (ref: PerformanceListener.java:119-122):
+    instead of wall-clock numbers, a full XPlane/TensorBoard trace of
+    XLA ops, host↔device transfers, and compilation events is written
+    under ``log_dir/iter<N>/`` for `trace_iterations` steps.
+
+    View with TensorBoard's profile plugin or xprof (`tensorboard
+    --logdir <log_dir>`)."""
+
+    def __init__(self, log_dir, frequency: int = 100,
+                 trace_iterations: int = 3):
+        self.log_dir = str(log_dir)
+        self.frequency = max(1, frequency)
+        self.trace_iterations = max(1, trace_iterations)
+        self._tracing_until: Optional[int] = None
+        self.trace_dirs: List[str] = []
+
+    def _start(self, iteration: int) -> None:
+        import os
+        import jax
+        path = os.path.join(self.log_dir, f"iter{iteration}")
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._tracing_until = iteration + self.trace_iterations
+        self.trace_dirs.append(path)
+
+    def _stop(self) -> None:
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._tracing_until = None
+
+    def iteration_done(self, model, iteration):
+        if self._tracing_until is not None:
+            if iteration >= self._tracing_until:
+                self._stop()
+            return
+        if iteration % self.frequency == 0:
+            self._start(iteration)
+
+    def close(self) -> None:
+        """Stop a trace left open mid-capture (end of training)."""
+        if self._tracing_until is not None:
+            self._stop()
+
+
 class ParamAndGradientIterationListener(IterationListener):
     """Per-iteration parameter/update magnitude stats, optionally written
     as TSV (ref: optimize/listeners/ParamAndGradientIterationListener.java

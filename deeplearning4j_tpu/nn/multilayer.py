@@ -151,7 +151,9 @@ class MultiLayerNetwork:
             x, mask = self.conf.preprocessors[n - 1](x, mask)
         if train:
             x = last._maybe_dropout(x, True, jax.random.fold_in(rng, n - 1))
-        preout = last.preoutput(params[-1], x)
+        preout = last.preoutput(
+            last._maybe_drop_connect(params[-1], train,
+                                     jax.random.fold_in(rng, n - 1)), x)
         new_states.append(state[-1])
         return preout, new_states, mask, x
 

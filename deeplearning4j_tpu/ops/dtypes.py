@@ -109,12 +109,11 @@ def set_default_policy(policy: Policy | None) -> None:
 def default_policy() -> Policy:
     if _default_policy is not None:
         return _default_policy
-    import jax
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        backend = "cpu"
-    return BF16 if backend == "tpu" else FLOAT32
+    # Capability probe, not backend-name string match: experimental PJRT
+    # plugins (the tunneled 'axon' platform) can register TPU devices
+    # under another backend name (ops/platform.py).
+    from deeplearning4j_tpu.ops import platform
+    return BF16 if platform.is_tpu() else FLOAT32
 
 
 def resolve(name: str | None) -> Policy:

@@ -72,9 +72,49 @@ def xent(labels, preout, activation="sigmoid", mask=None):
     return _reduce_features(per, mask)
 
 
+def _fused_xent_wanted(labels, preout, mask) -> bool:
+    """Dispatch gate for the Pallas fused softmax+CE kernel
+    (ops/pallas_kernels.softmax_xent_rows): TPU only, wide-vocab rows
+    where the saved HBM round-trips pay for the kernel launch, and only
+    row-level masks (a per-class mask needs the elementwise path).
+    DL4J_FUSED_XENT=1|0 overrides for testing."""
+    import os
+    env = os.environ.get("DL4J_FUSED_XENT")
+    if env == "0":
+        return False
+    if preout.ndim < 2 or preout.shape != labels.shape:
+        return False
+    if mask is not None and mask.ndim == preout.ndim \
+            and mask.shape[-1] == preout.shape[-1] and preout.shape[-1] != 1:
+        return False  # genuine per-class mask
+    if env == "1":
+        return True
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    V = preout.shape[-1]
+    n_rows = 1
+    for d in preout.shape[:-1]:
+        n_rows *= d
+    return pk._on_tpu() and V >= 128 and n_rows * V >= (1 << 16)
+
+
 def mcxent(labels, preout, activation="softmax", mask=None):
-    """Multi-class cross-entropy.  Stable fused path when activation is softmax."""
+    """Multi-class cross-entropy.  Stable fused path when activation is
+    softmax; above the size threshold the softmax+CE+grad runs as one
+    Pallas VMEM pass (ref analog: the fused libnd4j SoftMaxWithLoss op)."""
     if activation == "softmax":
+        if _fused_xent_wanted(labels, preout, mask):
+            from deeplearning4j_tpu.ops import pallas_kernels as pk
+            V = preout.shape[-1]
+            rows = pk.softmax_xent_rows(
+                preout.reshape(-1, V), labels.reshape(-1, V)
+            ).reshape(labels.shape[:-1])
+            if mask is not None:
+                m = mask
+                if m.ndim == rows.ndim + 1 and m.shape[-1] == 1:
+                    m = m[..., 0]
+                rows = rows * m
+            axes = tuple(range(1, rows.ndim))
+            return jnp.sum(rows, axis=axes) if axes else rows
         logz = jax.nn.logsumexp(preout, axis=-1, keepdims=True)
         per = -labels * (preout - logz)
     else:

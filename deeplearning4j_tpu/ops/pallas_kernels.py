@@ -10,9 +10,12 @@ Two kernels:
   accumulation, so memory is O(T·D) and the MXU sees back-to-back
   (BQ×D)·(D×BK) tiles.  Used by parallel/sequence.dense_attention (and
   therefore the per-shard core of Ulysses sequence parallelism; the
-  ring path keeps its own block-streaming body) on TPU; backward is a
-  custom_vjp that recomputes with the standard einsum formulation (XLA
-  fuses it well; forward is where the memory blow-up lived).
+  ring path keeps its own block-streaming body) on TPU.  Backward is
+  blockwise too (FlashAttention-2 recomputation from the saved per-row
+  logsumexp): dq and dk/dv kernels rebuild each [BQ, BK] probability
+  tile on the fly, so TRAINING memory is O(T·D) as well — no dense
+  [T, T] rematerialization.  Head dims that aren't multiples of the
+  128-lane width are zero-padded outside the custom_vjp.
 
 * **fused_softmax_xent** — softmax + cross-entropy + gradient in one
   VMEM pass per row block.  The char-RNN/output-layer hot op: avoids
@@ -38,10 +41,11 @@ NEG_INF = -1e30
 
 
 def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+    # Device-capability probe (ops/platform.py), not a backend-name match:
+    # the bench chip registers via the experimental 'axon' PJRT plugin and
+    # a string compare against "tpu" would force interpret-mode emulation.
+    from deeplearning4j_tpu.ops import platform
+    return platform.is_tpu()
 
 
 def _interpret() -> bool:
@@ -49,13 +53,18 @@ def _interpret() -> bool:
 
 
 # ===========================================================================
-# Flash attention
+# Flash attention — forward AND blockwise backward (O(T) HBM both ways).
+#
+# Forward saves per-row logsumexp; backward recomputes attention weights
+# block-by-block from (q, k, lse) — the FlashAttention-2 recomputation
+# scheme — so training never materializes the [T, T] score matrix.
 # ===========================================================================
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, lse_ref, *,
                       block_k: int, causal: bool, scale: float):
     """One (batch*head, q-block) program: stream K/V blocks with online
-    softmax.  Block shapes: q [BQ, D], k/v [T, D], mask [1, T]."""
+    softmax.  Block shapes: q [BQ, D], k/v [T, D], mask [1, T]; outputs
+    out [BQ, D] and per-row logsumexp lse [BQ]."""
     q = q_ref[...].astype(jnp.float32) * scale            # [BQ, D]
     T = k_ref.shape[0]
     BQ = q.shape[0]
@@ -96,6 +105,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *,
         n_blocks_live = n_blocks
     m, l, acc = lax.fori_loop(0, n_blocks_live, body, (m0, l0, acc0))
     out_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+    # lse for backward recomputation; fully-masked rows get NEG_INF (the
+    # backward kernels re-apply the mask so these rows contribute nothing)
+    lse_ref[...] = jnp.where(
+        l[:, 0] > 0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
+        NEG_INF)
 
 
 def _flash_fwd(q, k, v, key_mask, *, causal: bool, scale: float,
@@ -114,7 +128,7 @@ def _flash_fwd(q, k, v, key_mask, *, causal: bool, scale: float,
         B * H, 1, T).astype(jnp.float32)
 
     grid = (B * H, T // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
                           scale=scale),
         grid=grid,
@@ -124,11 +138,178 @@ def _flash_fwd(q, k, v, key_mask, *, causal: bool, scale: float,
             pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, 1, T), lambda b, i: (b, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, mask)
+    return out.reshape(B, H, T, D), lse
+
+
+def _recompute_p(q_blk, k_blk, lse_blk, mask_blk, q_pos, k_pos, causal,
+                 scale):
+    """Shared backward helper: rebuild the softmax probabilities for one
+    (q-block, k-block) tile from saved logsumexp.  All f32."""
+    s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    live = mask_blk > 0                                   # [1, BK]
+    if causal:
+        live = jnp.logical_and(live, q_pos >= k_pos)      # [BQ, BK]
+    # where() (not exp of a masked score) so fully-masked rows whose lse
+    # is NEG_INF don't produce exp(-inf - -inf) = 1
+    p = jnp.exp(s - lse_blk[:, None])
+    return jnp.where(live, p, 0.0)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref, *, block_k: int, causal: bool,
+                     scale: float):
+    """dQ for one q block: stream K/V blocks, recompute p, accumulate
+    dq += (p ∘ (dO·Vᵀ − δ)) · K · scale."""
+    q = q_ref[...].astype(jnp.float32)                    # [BQ, D]
+    do = do_ref[...].astype(jnp.float32)                  # [BQ, D]
+    lse = lse_ref[...]                                    # [BQ]
+    delta = delta_ref[...]                                # [BQ]
+    T = k_ref.shape[0]
+    BQ, D = q.shape
+    qi = pl.program_id(1)
+    q_pos = qi * BQ + lax.broadcasted_iota(jnp.int32, (BQ, 1), 0)
+
+    def body(s, dq):
+        k_blk = k_ref[pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.dslice(s * block_k, block_k), :].astype(jnp.float32)
+        msk = mask_ref[0, pl.dslice(s * block_k, block_k)][None, :]
+        k_pos = s * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        p = _recompute_p(q, k_blk, lse, msk, q_pos, k_pos, causal, scale)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])                    # [BQ, BK]
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    n_blocks = T // block_k
+    if causal:
+        n_blocks_live = jnp.minimum(n_blocks, (qi + 1) * BQ // block_k + 1)
+    else:
+        n_blocks_live = n_blocks
+    dq = lax.fori_loop(0, n_blocks_live, body, jnp.zeros((BQ, D), jnp.float32))
+    dq_ref[...] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, *, block_q: int,
+                      causal: bool, scale: float):
+    """dK/dV for one k block: stream Q/dO blocks, recompute pᵀ,
+    dv += pᵀ·dO and dk += (p ∘ (dO·Vᵀ − δ))ᵀ·Q · scale."""
+    k_blk = k_ref[...].astype(jnp.float32)                # [BK, D]
+    v_blk = v_ref[...].astype(jnp.float32)                # [BK, D]
+    msk = mask_ref[...]                                   # [1, BK]
+    T = q_ref.shape[0]
+    BK, D = k_blk.shape
+    ki = pl.program_id(1)
+    k_pos = ki * BK + lax.broadcasted_iota(jnp.int32, (1, BK), 1)
+
+    def body(s, carry):
+        dk, dv = carry
+        q_blk = q_ref[pl.dslice(s * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.dslice(s * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.dslice(s * block_q, block_q)]
+        delta_blk = delta_ref[pl.dslice(s * block_q, block_q)]
+        q_pos = s * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0)
+        p = _recompute_p(q_blk, k_blk, lse_blk, msk, q_pos, k_pos, causal,
+                         scale)                            # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+        dp = jax.lax.dot_general(do_blk, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None])
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+        return dk, dv
+
+    n_blocks = T // block_q
+    if causal:
+        # q blocks strictly before this k block contribute nothing
+        start = ki * BK // block_q
+    else:
+        start = 0
+    dk, dv = lax.fori_loop(start, n_blocks, body,
+                           (jnp.zeros((BK, D), jnp.float32),
+                            jnp.zeros((BK, D), jnp.float32)))
+    dk_ref[...] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, key_mask, out, lse, g, *, causal: bool,
+               scale: float, block_q: int = 128, block_k: int = 128):
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    dof = g.reshape(B * H, T, D)
+    mask = jnp.broadcast_to(key_mask[:, None, :], (B, H, T)).reshape(
+        B * H, 1, T).astype(jnp.float32)
+    # δ_i = Σ_d dO·O — a cheap elementwise reduction XLA fuses on its own
+    delta = jnp.sum(dof.astype(jnp.float32) *
+                    out.reshape(B * H, T, D).astype(jnp.float32), axis=-1)
+
+    common_specs = [
+        pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),      # k or q
+        pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),      # v
+        pl.BlockSpec((None, 1, T), lambda b, i: (b, 0, 0)),      # mask
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=(B * H, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),  # q
+            *common_specs,                                             # k,v,mask
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),        # lse
+            pl.BlockSpec((None, block_q), lambda b, i: (b, i)),        # delta
+        ],
         out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         interpret=_interpret(),
-    )(qf, kf, vf, mask)
-    return out.reshape(B, H, T, D)
+    )(qf, kf, vf, mask, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale),
+        grid=(B * H, T // block_k),
+        in_specs=[
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),        # q
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),  # k
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),  # v
+            pl.BlockSpec((None, 1, block_k), lambda b, i: (b, 0, i)),  # mask
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),        # do
+            pl.BlockSpec((None, T), lambda b, i: (b, 0)),              # lse
+            pl.BlockSpec((None, T), lambda b, i: (b, 0)),              # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, block_k, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, mask, dof, lse, delta)
+    return (dq.reshape(B, H, T, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
 
 
 def _dense_reference(q, k, v, key_mask, causal, scale):
@@ -144,39 +325,53 @@ def _dense_reference(q, k, v, key_mask, causal, scale):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, key_mask, causal: bool = False,
-                    scale: Optional[float] = None):
-    """Memory-efficient exact attention.  q,k,v: [B,H,T,D]; key_mask
-    [B,T] (1=keep).  scale defaults to 1/sqrt(D)."""
-    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    return _flash_fwd(q, k, v, key_mask, causal=causal, scale=s)
+def _flash_core(q, k, v, key_mask, causal: bool, scale: float):
+    out, _ = _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, key_mask, causal, scale):
-    out = flash_attention(q, k, v, key_mask, causal, scale)
-    return out, (q, k, v, key_mask)
+    out, lse = _flash_fwd(q, k, v, key_mask, causal=causal, scale=scale)
+    return out, (q, k, v, key_mask, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, res, g):
-    q, k, v, key_mask = res
-    s = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-
-    def f(q, k, v):
-        return _dense_reference(q, k, v, key_mask, causal, s)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
+    q, k, v, key_mask, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, key_mask, out, lse, g,
+                            causal=causal, scale=scale)
     return dq, dk, dv, None
 
 
-flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+_flash_core.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+LANE = 128
+
+
+def flash_attention(q, k, v, key_mask, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Memory-efficient exact attention, differentiable with O(T) HBM in
+    both directions.  q,k,v: [B,H,T,D]; key_mask [B,T] (1=keep).  scale
+    defaults to 1/sqrt(D) of the ORIGINAL head dim; head dims that are
+    not lane-tileable (64, 96, ...) are zero-padded to the next multiple
+    of 128 — zero k/v columns change neither scores nor outputs, and the
+    pad/slice sits outside the custom_vjp so gradients pass through."""
+    D = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    pad = (-D) % LANE
+    if pad:
+        widths = [(0, 0)] * 3 + [(0, pad)]
+        q = jnp.pad(q, widths)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    out = _flash_core(q, k, v, key_mask, causal, s)
+    return out[..., :D] if pad else out
 
 
 def flash_attention_supported(q, block: int = 128) -> bool:
-    """Shape gate: last dim must be lane-tileable and T divisible by the
-    block size used; small shapes fall back to dense."""
+    """Shape gate: T must tile into blocks; any head dim works (lane
+    padding), but tiny ones waste >4x MXU lanes — fall back to dense."""
     B, H, T, D = q.shape
-    return T >= block and T % block == 0 and D % 128 == 0
+    return T >= block and T % block == 0 and D >= 32
 
 
 # ===========================================================================
@@ -184,8 +379,9 @@ def flash_attention_supported(q, block: int = 128) -> bool:
 # ===========================================================================
 
 def _softmax_xent_kernel(logits_ref, labels_ref, loss_ref, grad_ref):
-    """One row-block: max-sub softmax, CE loss, (p - y) gradient — one
-    HBM read of logits, one write of grad."""
+    """One row-block: max-sub softmax, CE loss, (p·Σy − y) gradient — one
+    HBM read of logits, one write of grad.  The Σy factor keeps the
+    gradient exact for soft/unnormalized label rows (d/dx of Σy·logZ)."""
     x = logits_ref[...].astype(jnp.float32)
     y = labels_ref[...].astype(jnp.float32)
     m = x.max(axis=1, keepdims=True)
@@ -195,7 +391,8 @@ def _softmax_xent_kernel(logits_ref, labels_ref, loss_ref, grad_ref):
     logp = (x - m) - jnp.log(z)
     loss_ref[...] = -(y * logp).sum(axis=1, keepdims=True).astype(
         loss_ref.dtype)
-    grad_ref[...] = (p - y).astype(grad_ref.dtype)
+    grad_ref[...] = (p * y.sum(axis=1, keepdims=True) - y).astype(
+        grad_ref.dtype)
 
 
 def fused_softmax_xent(logits, labels, block_rows: Optional[int] = None):
@@ -233,3 +430,27 @@ def fused_softmax_xent(logits, labels, block_rows: Optional[int] = None):
         interpret=_interpret(),
     )(logits, labels)
     return loss[:N, 0], grad[:N]
+
+
+@jax.custom_vjp
+def softmax_xent_rows(logits, labels):
+    """Differentiable fused softmax+CE: per-row loss [N] whose VJP reuses
+    the gradient the forward kernel already produced — one VMEM pass
+    total, vs softmax→log→mul→sum + their transposes on the dense path.
+    Called from ops/losses.mcxent above the dispatch threshold."""
+    loss, _ = fused_softmax_xent(logits, labels)
+    return loss
+
+
+def _sxr_fwd(logits, labels):
+    loss, grad = fused_softmax_xent(logits, labels)
+    return loss, grad
+
+
+def _sxr_bwd(grad, g):
+    # labels cotangent is never consumed (labels are data); zeros keeps the
+    # vjp signature total and XLA dead-code-eliminates it
+    return grad * g[:, None], jnp.zeros_like(grad)
+
+
+softmax_xent_rows.defvjp(_sxr_fwd, _sxr_bwd)

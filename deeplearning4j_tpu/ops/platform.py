@@ -1,0 +1,77 @@
+"""TPU capability detection + chip peak-FLOPs table.
+
+Round-2 lesson: the bench machine's chip is exposed through an
+experimental PJRT plugin (platform name ``axon``), and any gate written
+as ``jax.default_backend() == "tpu"`` risks reading False there even
+though the device IS a TPU ("TPU v5 lite").  Everything that keys
+behavior off "are we on TPU" (auto mixed precision in ops/dtypes.py,
+Pallas interpret-mode in ops/pallas_kernels.py) goes through
+:func:`is_tpu`, which probes the *devices* (platform + device_kind)
+rather than trusting the backend registry name, and honors an explicit
+``DL4J_TPU=0|1`` env override for debugging.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+
+def is_tpu() -> bool:
+    """True when the default JAX backend is TPU hardware, however the
+    PJRT plugin chooses to register itself."""
+    env = os.environ.get("DL4J_TPU")
+    if env is not None and env != "":
+        return env not in ("0", "false", "False")
+    return _probe_is_tpu()
+
+
+@functools.lru_cache(maxsize=1)
+def _probe_is_tpu() -> bool:
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            return True
+        for d in jax.devices():
+            platform = (getattr(d, "platform", "") or "").lower()
+            kind = (getattr(d, "device_kind", "") or "").lower()
+            if "tpu" in platform or "tpu" in kind:
+                return True
+    except Exception:
+        pass
+    return False
+
+
+def device_kind() -> str:
+    """Device-kind string of the first device ('' when unavailable)."""
+    try:
+        import jax
+        return getattr(jax.devices()[0], "device_kind", "") or ""
+    except Exception:
+        return ""
+
+
+# Dense per-chip peak FLOP/s with bf16 inputs / f32 MXU accumulation
+# (published cloud specs).  Keys are matched as substrings of the
+# lower-cased device_kind.
+_BF16_PEAK = {
+    "v6": 918e12,       # Trillium / v6e
+    "v5p": 459e12,
+    "v5 lite": 197e12,  # v5e reports device_kind "TPU v5 lite"
+    "v5e": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def peak_flops_bf16(kind: str | None = None) -> float | None:
+    """Per-chip dense bf16 peak FLOP/s for MFU math; None when the chip
+    is unknown (callers must then report MFU as unavailable rather than
+    inventing a denominator)."""
+    k = (kind if kind is not None else device_kind()).lower()
+    # longest-key-first so "v5p"/"v5 lite" win over any shorter alias
+    for name in sorted(_BF16_PEAK, key=len, reverse=True):
+        if name in k:
+            return _BF16_PEAK[name]
+    return None

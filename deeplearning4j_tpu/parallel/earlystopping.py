@@ -1,0 +1,117 @@
+"""Early stopping composed with mesh data-parallel training.
+
+(ref: deeplearning4j-scaleout/deeplearning4j-scaleout-parallelwrapper/
+src/main/java/org/deeplearning4j/parallelism/EarlyStoppingParallelTrainer.java:1-372
+— the reference wraps a ParallelWrapper, installs an
+AveragingIterationListener to watch per-iteration scores, and drives the
+standard early-stopping epoch loop around parallel fit passes.)
+
+Here one "epoch" is one ParallelWrapper.fit pass — the compiled
+mesh-sharded step with its gradient psum over ICI — and scoring between
+epochs runs on the (replicated) driver-side params, so the score the
+termination conditions see is the post-all-reduce model exactly as the
+reference's post-averaging model.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from deeplearning4j_tpu.nn.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingResult,
+    check_score_free_epoch_conditions, validate_termination_conditions)
+from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+class _Terminate(Exception):
+    """Control-flow signal: abort the current parallel fit pass NOW (a
+    NaN score must not keep training for the rest of the epoch)."""
+
+
+class _IterationWatcher(IterationListener):
+    """Per-iteration hook inside the parallel fit pass — the analog of
+    the reference's AveragingIterationListener (EarlyStoppingParallelTrainer.java:303):
+    checks iteration termination conditions on every mesh step and
+    aborts the wrapper loop mid-pass by raising."""
+
+    def __init__(self, conditions):
+        self.conditions = conditions
+        self.fired = None
+
+    def iteration_done(self, model, iteration):
+        if self.fired is not None:
+            return
+        s = float(model.score())
+        for cond in self.conditions:
+            if cond.terminate(iteration, s):
+                self.fired = cond
+                raise _Terminate()
+
+
+class EarlyStoppingParallelTrainer:
+    """(ref: parallelism/EarlyStoppingParallelTrainer.java)"""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_data, wrapper: Optional[ParallelWrapper] = None,
+                 mesh=None, averaging_frequency: int = 1):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+        self.wrapper = wrapper if wrapper is not None else ParallelWrapper(
+            model, mesh=mesh, averaging_frequency=averaging_frequency)
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        validate_termination_conditions(cfg)
+        net = self.model
+        watcher = _IterationWatcher(cfg.iteration_termination_conditions)
+        saved_listeners = list(net.listeners)
+        net.listeners = saved_listeners + [watcher]
+        best_score, best_epoch = math.inf, -1
+        score_vs_epoch = {}
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+        try:
+            while True:
+                try:
+                    self.wrapper.fit(self.train_data, epochs=1)
+                except _Terminate:
+                    pass
+                if watcher.fired is not None:
+                    reason = "IterationTerminationCondition"
+                    details = repr(watcher.fired)
+                    break
+                if epoch % cfg.evaluate_every_n_epochs == 0:
+                    score = cfg.score_calculator.calculate_score(net)
+                    score_vs_epoch[epoch] = score
+                    if score < best_score:
+                        best_score, best_epoch = score, epoch
+                        cfg.model_saver.save_best(net)
+                    if cfg.save_last_model:
+                        cfg.model_saver.save_latest(net)
+                    stop = False
+                    for cond in cfg.epoch_termination_conditions:
+                        if cond.terminate(epoch, score):
+                            reason, details = ("EpochTerminationCondition",
+                                               repr(cond))
+                            stop = True
+                            break
+                    if stop:
+                        break
+                else:
+                    fired = check_score_free_epoch_conditions(cfg, epoch)
+                    if fired is not None:
+                        reason = "EpochTerminationCondition"
+                        details = repr(fired)
+                        break
+                epoch += 1
+        finally:
+            net.listeners = saved_listeners
+        best = cfg.model_saver.get_best()
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            total_epochs=epoch + 1, best_model_epoch=best_epoch,
+            best_model_score=best_score, score_vs_epoch=score_vs_epoch,
+            best_model=best if best is not None else net)

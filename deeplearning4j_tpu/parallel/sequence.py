@@ -126,9 +126,14 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
     idx = lax.axis_index(axis_name)
     q_pos = idx * Tl + jnp.arange(Tl)                      # global q positions
 
-    m = jnp.full((B, H, Tl), NEG_INF, q.dtype)             # running row max
-    l = jnp.zeros((B, H, Tl), q.dtype)                     # running denom
-    o = jnp.zeros((B, H, Tl, D), q.dtype)                  # weighted accum
+    # Online-softmax statistics accumulate at >=f32 regardless of the
+    # compute dtype: bf16 running max/denominator drifts visibly vs the
+    # dense/Pallas paths (which accumulate f32), and the f64 gradient-check
+    # path keeps its width (advisor round-1 finding).
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    m = jnp.full((B, H, Tl), NEG_INF, acc_dt)              # running row max
+    l = jnp.zeros((B, H, Tl), acc_dt)                      # running denom
+    o = jnp.zeros((B, H, Tl, D), acc_dt)                   # weighted accum
     if key_mask is None:
         key_mask = jnp.ones((B, Tl), q.dtype)
 
@@ -142,7 +147,8 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
         m, l, o, k, v, mask = carry
         src = (idx - s) % S
         k_pos = src * Tl + jnp.arange(Tl)                  # global k positions
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=acc_dt) * scale
         if causal:
             scores = jnp.where(q_pos[:, None] >= k_pos[None, :],
                                scores, NEG_INF)
@@ -153,7 +159,8 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
         alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
         p = jnp.exp(scores - m_new[..., None])
         l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v,
+                                              preferred_element_type=acc_dt)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         mask = lax.ppermute(mask, axis_name, perm)
@@ -161,7 +168,7 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
 
     (m, l, o, _, _, _), _ = lax.scan(
         body, (m, l, o, k, v, key_mask), jnp.arange(S))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, *, mesh: Mesh, causal: bool = False,

@@ -92,8 +92,10 @@ class ParallelWrapper:
         return self._fit_param_averaging(iterator, epochs)
 
     def _fit_allreduce(self, iterator, epochs: int):
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
         from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
         m = self.model
+        is_graph = type(m).__name__ == "ComputationGraph"
         if m.net_params is None:
             m.init()
         if self._sharded_step is None:
@@ -105,20 +107,34 @@ class ParallelWrapper:
             it.reset()
             while it.has_next():
                 ds = it.next()
+                # ComputationGraph steps take TUPLES of inputs/labels
+                # (MultiDataSet); normalize DataSet→MultiDataSet for it
+                if is_graph and isinstance(ds, DataSet):
+                    ds = MultiDataSet([ds.features], [ds.labels],
+                                      [ds.features_mask], [ds.labels_mask])
                 n = ds.num_examples()
                 if n % self.n_data:
-                    # pad to divisibility (masked examples get zero weight
-                    # via duplication; simplest: drop remainder like the
-                    # reference's round-robin feeding)
-                    n = (n // self.n_data) * self.n_data
+                    n_new = (n // self.n_data) * self.n_data
+                    self._warn_remainder(n - n_new, n)
+                    n = n_new
                     if n == 0:
                         continue
-                x = jax.device_put(np.asarray(ds.features[:n]), batch_sh)
-                y = jax.device_put(np.asarray(ds.labels[:n]), batch_sh)
-                fm = (jax.device_put(np.asarray(ds.features_mask[:n]), batch_sh)
-                      if ds.features_mask is not None else None)
-                lm = (jax.device_put(np.asarray(ds.labels_mask[:n]), batch_sh)
-                      if ds.labels_mask is not None else None)
+                if isinstance(ds, MultiDataSet):
+                    put_all = lambda arrs: (  # noqa: E731
+                        None if arrs is None else tuple(
+                            None if a is None else
+                            self._put_batch(a[:n], batch_sh) for a in arrs))
+                    x = put_all(ds.features)
+                    y = put_all(ds.labels)
+                    fm = put_all(ds.features_masks)
+                    lm = put_all(ds.labels_masks)
+                else:
+                    x = self._put_batch(ds.features[:n], batch_sh)
+                    y = self._put_batch(ds.labels[:n], batch_sh)
+                    fm = (self._put_batch(ds.features_mask[:n], batch_sh)
+                          if ds.features_mask is not None else None)
+                    lm = (self._put_batch(ds.labels_mask[:n], batch_sh)
+                          if ds.labels_mask is not None else None)
                 m._key, sub = jax.random.split(m._key)
                 (m.net_params, m.net_state, m.opt_states, score) = self._sharded_step(
                     m.net_params, m.net_state, m.opt_states, x, y, fm, lm,
@@ -158,6 +174,33 @@ class ParallelWrapper:
         jit_avg = jax.jit(average, donate_argnums=(0, 1))
         return jit_step, jit_avg, dev_axis
 
+    @staticmethod
+    def _put_batch(arr, batch_sh):
+        """Place one batch onto the mesh.  Multi-process (the cluster
+        tier, scaleout/multislice.py): each host feeds its process-LOCAL
+        rows and the global array is assembled across hosts — the Spark
+        executors-feed-disjoint-partitions pattern
+        (ref: spark/impl/paramavg/ParameterAveragingTrainingMaster.java
+        executeTraining split semantics)."""
+        arr = np.asarray(arr)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(batch_sh, arr)
+        return jax.device_put(arr, batch_sh)
+
+    def _warn_remainder(self, dropped: int, batch: int):
+        """Round-2 advisor finding: remainder examples were dropped
+        SILENTLY.  Dropping (the reference's round-robin feeding does the
+        same) is still the policy, but it is now visible — resize batches
+        to a multiple of the data-parallel degree to use every example."""
+        import warnings
+        if not getattr(self, "_remainder_warned", False):
+            self._remainder_warned = True
+            warnings.warn(
+                f"ParallelWrapper: dropping {dropped} of {batch} examples "
+                f"per batch (batch not divisible by data degree "
+                f"{self.n_data}); pad or resize batches to avoid this",
+                stacklevel=3)
+
     def _fit_param_averaging(self, iterator, epochs: int):
         m = self.model
         if m.net_params is None:
@@ -182,6 +225,9 @@ class ParallelWrapper:
             while iterator.has_next():
                 ds = iterator.next()
                 n = (ds.num_examples() // D) * D
+                if n != ds.num_examples():
+                    self._warn_remainder(ds.num_examples() - n,
+                                         ds.num_examples())
                 if n == 0:
                     continue
                 shard = lambda a: (  # noqa: E731

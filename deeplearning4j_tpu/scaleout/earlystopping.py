@@ -11,7 +11,8 @@ from __future__ import annotations
 import math
 
 from deeplearning4j_tpu.nn.earlystopping import (
-    EarlyStoppingConfiguration, EarlyStoppingResult)
+    EarlyStoppingConfiguration, EarlyStoppingResult,
+    check_score_free_epoch_conditions, validate_termination_conditions)
 
 
 class ClusterDataSetLossCalculator:
@@ -38,6 +39,7 @@ class ClusterEarlyStoppingTrainer:
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        validate_termination_conditions(cfg)
         net = self.front_end.network
         best_score, best_epoch = math.inf, -1
         score_vs_epoch = {}
@@ -68,6 +70,13 @@ class ClusterEarlyStoppingTrainer:
                                            repr(cond))
                         stop = True
                 if stop:
+                    break
+            else:
+                # score-independent conditions (MaxEpochs) fire every epoch,
+                # not only on evaluate_every_n_epochs boundaries
+                fired = check_score_free_epoch_conditions(cfg, epoch)
+                if fired is not None:
+                    reason, details = "EpochTerminationCondition", repr(fired)
                     break
             epoch += 1
         best = cfg.model_saver.get_best()

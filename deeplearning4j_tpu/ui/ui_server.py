@@ -13,6 +13,10 @@ browser).  Endpoints:
   GET  /train/sessions         {"sessions": [...]}
   GET  /train/overview?sid=    score vs iteration + perf + memory
   GET  /train/model?sid=       per-layer param/update summary stats
+  GET  /train/histograms?sid=  per-param parameter/update histograms
+                               (ref: TrainModule histogram pages)
+  GET  /train/graph?sid=       model topology for the flow/graph view
+                               (ref: TrainModule layer-flow page)
   GET  /train/system?sid=      static info + memory timeline
   POST /remoteReceive          remote stats ingestion
 """
@@ -47,6 +51,8 @@ th:first-child,td:first-child{text-align:left}
 <nav style="padding:8px 20px;background:#34495e">
 <button data-tab="overview" class="active">Overview</button>
 <button data-tab="model">Model</button>
+<button data-tab="histograms">Histograms</button>
+<button data-tab="graph">Graph</button>
 <button data-tab="system">System</button></nav>
 <main id="main"></main>
 <script>
@@ -64,6 +70,13 @@ function line(points,color){if(!points.length)return '';
  return `<svg viewBox="0 0 ${W} ${H}"><path d="${d}" fill="none" stroke="${color}" stroke-width="2"/>
  <text x="${pad}" y="12" font-size="11">max ${y1.toPrecision(4)}</text>
  <text x="${pad}" y="${H-8}" font-size="11">min ${y0.toPrecision(4)}</text></svg>`;}
+function bars(counts,color){if(!counts||!counts.length)return '';
+ const W=800,H=140,pad=8,n=counts.length,mx=Math.max(...counts)||1;
+ const bw=(W-2*pad)/n;
+ const r=counts.map((c,i)=>`<rect x="${(pad+i*bw).toFixed(1)}"
+  y="${(H-pad-(c/mx)*(H-2*pad)).toFixed(1)}" width="${(bw*0.9).toFixed(1)}"
+  height="${((c/mx)*(H-2*pad)).toFixed(1)}" fill="${color}"/>`).join('');
+ return `<svg viewBox="0 0 ${W} ${H}" style="height:140px">${r}</svg>`;}
 async function j(u){return (await fetch(u)).json();}
 async function render(){
  const m=document.getElementById('main');
@@ -77,6 +90,29 @@ async function render(){
   <td>${l.update_magnitude?.toPrecision(4)??''}</td></tr>`).join('');
   m.innerHTML=`<div class="card"><h3>Parameters (latest)</h3>
   <table><tr><th>param</th><th>mean</th><th>stdev</th><th>|mean|</th><th>|update|</th></tr>${rows}</table></div>`;}
+ else if(tab=='histograms'){const d=await j('/train/histograms?sid='+sid);
+  if(!d.params.length&&!d.updates.length){m.innerHTML='<p>no histogram data</p>';}
+  else{const card=(h,color)=>`<div class="card"><h3>${esc(h.name)}
+   <small>[${h.min.toPrecision(3)}, ${h.max.toPrecision(3)}]</small></h3>${bars(h.counts,color)}</div>`;
+  m.innerHTML=`<h2>Parameter histograms (iter ${d.iteration??'-'})</h2>`
+   +d.params.map(h=>card(h,'#2980b9')).join('')
+   +`<h2>Update histograms</h2>`+d.updates.map(h=>card(h,'#e67e22')).join('');}}
+ else if(tab=='graph'){const d=await j('/train/graph?sid='+sid);
+  const W=860,rh=46,H=Math.max(120,d.nodes.length*rh+40);
+  const pos={};d.nodes.forEach((n,i)=>pos[n.name]=[W/2,30+i*rh]);
+  const lines=d.edges.filter(e=>pos[e[0]]&&pos[e[1]]).map(e=>{
+   const a=pos[e[0]],b=pos[e[1]];
+   return `<line x1="${a[0]}" y1="${a[1]+12}" x2="${b[0]}" y2="${b[1]-14}"
+    stroke="#95a5a6" stroke-width="1.5" marker-end="url(#arr)"/>`;}).join('');
+  const boxes=d.nodes.map(n=>{const p=pos[n.name];
+   return `<rect x="${p[0]-130}" y="${p[1]-14}" width="260" height="28" rx="5"
+    fill="#eaf2f8" stroke="#2980b9"/><text x="${p[0]}" y="${p[1]+4}"
+    text-anchor="middle" font-size="12">${esc(n.name)} · ${esc(n.type)}</text>`;}).join('');
+  m.innerHTML=`<div class="card"><h3>Model graph</h3>
+   <svg viewBox="0 0 ${W} ${H}" style="height:${H}px">
+   <defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7" refY="4"
+    orient="auto"><path d="M0,0 L8,4 L0,8 z" fill="#95a5a6"/></marker></defs>
+   ${lines}${boxes}</svg></div>`;}
  else{const d=await j('/train/system?sid='+sid);
   m.innerHTML=`<div class="card"><h3>Host RSS (MB)</h3>${line(d.memory,'#8e44ad')}</div>
   <div class="card"><h3>Static info</h3><pre>${esc(JSON.stringify(d.static,null,2))}</pre></div>`;}
@@ -136,6 +172,10 @@ class UIServer:
                         self._json(server._overview(sid))
                     elif u.path == "/train/model":
                         self._json(server._model(sid))
+                    elif u.path == "/train/histograms":
+                        self._json(server._histograms(sid))
+                    elif u.path == "/train/graph":
+                        self._json(server._graph(sid))
                     elif u.path == "/train/system":
                         self._json(server._system(sid))
                     else:
@@ -245,6 +285,61 @@ class UIServer:
                 "histogram": s.get("histogram"),
             })
         return {"layers": layers}
+
+    def _histograms(self, sid) -> dict:
+        """Latest param + update histograms per tensor — renders the data
+        StatsListener always collected (ref: TrainModule histogram page,
+        ui/module/train/TrainModule.java:53 'histograms' route)."""
+        ups = self._updates(sid)
+        if not ups:
+            return {"iteration": None, "params": [], "updates": []}
+        latest = ups[-1]
+
+        def series(src):
+            out = []
+            for name, s in sorted(latest.get(src, {}).items()):
+                h = (s or {}).get("histogram")
+                if h:
+                    out.append({"name": name, **h})
+            return out
+
+        return {"iteration": latest.get("iteration"),
+                "params": series("params"), "updates": series("updates")}
+
+    def _graph(self, sid) -> dict:
+        """Model topology for the flow view (ref: TrainModule layer-flow
+        page).  Nodes + directed edges, derived from the static-info
+        model_config JSON — works for MultiLayerNetwork chains and
+        ComputationGraph DAGs alike."""
+        info = self._static(sid)
+        if not info:
+            return {"nodes": [], "edges": []}
+        try:
+            conf = json.loads(info.get("model_config", "{}"))
+        except (TypeError, ValueError):
+            return {"nodes": [], "edges": []}
+        nodes, edges = [], []
+        if "vertices" in conf:
+            for name in conf.get("network_inputs", []):
+                nodes.append({"name": name, "type": "Input"})
+            for name, v in conf["vertices"].items():
+                t = v.get("@class", "Vertex")
+                if t == "LayerVertex":
+                    t = (v.get("layer") or {}).get("@class", t)
+                nodes.append({"name": name, "type": t})
+            for name, ins in conf.get("vertex_inputs", {}).items():
+                for i in ins:
+                    edges.append([i, name])
+        else:
+            nodes.append({"name": "input", "type": "Input"})
+            prev = "input"
+            for i, ld in enumerate(conf.get("layers", [])):
+                name = f"layer{i}"
+                nodes.append({"name": name,
+                              "type": ld.get("@class", "Layer")})
+                edges.append([prev, name])
+                prev = name
+        return {"nodes": nodes, "edges": edges}
 
     def _system(self, sid) -> dict:
         ups = self._updates(sid)

@@ -98,10 +98,17 @@ int csv_read(const char* path, char delim, int skip_lines, float* out,
       i = line_end + 1;
       continue;
     }
-    // skip blank lines
+    // skip blank lines — same whitespace set as csv_dims ('\r', ' ', '\t'),
+    // except the delimiter itself, which always marks a data row (a
+    // tab-only line is blank for a comma CSV but a row of empty fields
+    // for a TSV, matching csv_dims' delim-first branch)
     bool blank = true;
-    for (size_t j = i; j < line_end; ++j)
-      if (data[j] != '\r' && data[j] != ' ') { blank = false; break; }
+    for (size_t j = i; j < line_end; ++j) {
+      char ch = data[j];
+      if (ch != delim && (ch == '\r' || ch == ' ' || ch == '\t')) continue;
+      blank = false;
+      break;
+    }
     if (blank) {
       i = line_end + 1;
       continue;
@@ -176,8 +183,13 @@ int idx_read(const char* path, float* out, long count) {
   if (!f) return -1;
   unsigned char hdr[4];
   f.read(reinterpret_cast<char*>(hdr), 4);
+  // validate the read succeeded and the magic/ndim are sane before using
+  // hdr — a truncated file must not seed nd/dtype from stack garbage
+  if (!f || hdr[0] != 0 || hdr[1] != 0) return -2;
   int nd = hdr[3];
+  if (nd < 1 || nd > 4) return -3;
   f.seekg(4 + 4 * nd);
+  if (!f) return -4;
   if (hdr[2] == 0x08) {
     std::vector<unsigned char> buf(static_cast<size_t>(count));
     f.read(reinterpret_cast<char*>(buf.data()), count);

@@ -1,0 +1,71 @@
+"""Worker process for the 2-process jax.distributed smoke test
+(tests/test_distributed.py).  NOT a pytest file.
+
+Each of the two CPU processes exposes 2 virtual devices, joins the
+coordination service, builds the 4-device GLOBAL mesh, feeds its
+process-local half of the batch through one ParallelWrapper all-reduce
+step, and prints a digest of the resulting params — the parent asserts
+both processes converged to identical params (the Spark local[n]
+BaseSparkTest pattern, ref: spark/BaseSparkTest.java:89, realized as
+real multi-process jax.distributed)."""
+
+import hashlib
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.datasets.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.parallel.mesh import MeshConfig  # noqa: E402
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: E402
+from deeplearning4j_tpu.scaleout.multislice import (  # noqa: E402
+    global_mesh, initialize_distributed, process_local_batch_slice)
+
+joined = initialize_distributed(f"127.0.0.1:{port}", num_processes=2,
+                                process_id=pid)
+assert joined, "expected a 2-process group"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+mesh = global_mesh(MeshConfig(data=-1))
+assert mesh.shape["data"] * mesh.shape.get("fsdp", 1) == 4
+
+conf = (NeuralNetConfiguration.builder().seed(99).learning_rate(0.1)
+        .updater("sgd")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+
+# identical global batch on both processes; each feeds its local half
+rng = np.random.default_rng(7)
+gx = rng.normal(size=(16, 4)).astype(np.float32)
+gy = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+sl = process_local_batch_slice(16)
+data = ListDataSetIterator([DataSet(gx[sl], gy[sl])])
+
+ParallelWrapper(net, mesh).fit(data)
+
+params = np.asarray(net.params())
+digest = hashlib.sha256(np.ascontiguousarray(params, np.float32).tobytes()
+                        ).hexdigest()
+print(f"PARAM_DIGEST {pid} {digest}", flush=True)
+print(f"SCORE {pid} {float(net.score()):.6f}", flush=True)

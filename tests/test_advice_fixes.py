@@ -1,0 +1,255 @@
+"""Regression tests for the round-1/round-2 advisor findings (ADVICE.md):
+
+1. csv_dims/csv_read agree on tab-only lines (comma CSV vs TSV).
+2. idx_read validates the 4-byte header read before trusting it.
+3. Ring attention accumulates its online-softmax stats in float32 even
+   for bf16 inputs (parity with the dense/Pallas paths).
+4. Early stopping: MaxEpochs fires on every epoch regardless of
+   evaluate_every_n_epochs, and a config with no termination conditions
+   is rejected instead of looping forever.
+5. use_drop_connect is real: weights are dropped (inverted scaling),
+   input dropout is suppressed, and training still converges.
+"""
+
+import ctypes
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.native import read_csv_matrix, read_idx
+
+
+# ---------------------------------------------------------------- 1. CSV tabs
+def test_tab_only_line_skipped_for_comma_csv(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text("1,2\n\t\t\n3,4\n")
+    m = read_csv_matrix(p)
+    assert m.shape == (2, 2)
+    np.testing.assert_array_equal(m, [[1, 2], [3, 4]])
+
+
+def test_tab_only_line_is_empty_row_for_tsv(tmp_path):
+    # for a TSV the tab IS the delimiter: "\t\t" is a row of 3 empty fields
+    p = tmp_path / "t.tsv"
+    p.write_text("1\t2\t3\n\t\t\n4\t5\t6\n")
+    m = read_csv_matrix(p, delimiter="\t")
+    assert m.shape == (3, 3)
+    assert np.isnan(m[1]).all()
+    np.testing.assert_array_equal(m[2], [4, 5, 6])
+
+
+def test_spaces_and_crlf_lines_still_skipped(tmp_path):
+    p = tmp_path / "s.csv"
+    p.write_text("1,2\n   \r\n\n3,4\n")
+    m = read_csv_matrix(p)
+    assert m.shape == (2, 2)
+
+
+# ---------------------------------------------------------------- 2. IDX hdr
+def test_idx_read_rejects_truncated_header(tmp_path):
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    p = tmp_path / "trunc.idx"
+    p.write_bytes(b"\x00\x00")  # 2 bytes: header read must fail
+    out = np.empty(4, np.float32)
+    rc = lib.idx_read(str(p).encode(),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 4)
+    assert rc < 0
+
+
+def test_idx_read_rejects_bad_magic(tmp_path):
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\xff\xff\x08\x01" + struct.pack(">I", 4) + b"\x01\x02\x03\x04")
+    out = np.empty(4, np.float32)
+    rc = lib.idx_read(str(p).encode(),
+                      out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 4)
+    assert rc < 0
+
+
+def test_idx_read_valid_still_works(tmp_path):
+    p = tmp_path / "ok.idx"
+    p.write_bytes(b"\x00\x00\x08\x01" + struct.pack(">I", 3) + bytes([7, 8, 9]))
+    np.testing.assert_array_equal(read_idx(p), [7.0, 8.0, 9.0])
+
+
+# ---------------------------------------------------------------- 3. ring f32
+@pytest.fixture
+def seq_mesh():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4])
+    with Mesh(devs, ("seq",)) as m:
+        yield m
+
+
+def test_ring_attention_bf16_accumulates_f32(seq_mesh):
+    from deeplearning4j_tpu.parallel import sequence as seq
+
+    rng = np.random.default_rng(0)
+    B, H, T, D = 2, 2, 32, 16
+    q32 = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    k32 = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    v32 = rng.normal(size=(B, H, T, D)).astype(np.float32)
+    q = jnp.asarray(q32, jnp.bfloat16)
+    k = jnp.asarray(k32, jnp.bfloat16)
+    v = jnp.asarray(v32, jnp.bfloat16)
+
+    out = seq.ring_attention(q, k, v, mesh=seq_mesh, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = seq.dense_attention(jnp.asarray(q32, jnp.bfloat16),
+                              jnp.asarray(k32, jnp.bfloat16),
+                              jnp.asarray(v32, jnp.bfloat16),
+                              causal=True, allow_flash=False)
+    # with f32 accumulation the ring result matches the dense bf16 result
+    # to bf16 resolution; bf16 accumulation drifts ~10x wider
+    err = np.abs(np.asarray(out, np.float32) - np.asarray(ref, np.float32))
+    assert err.max() < 0.05, err.max()
+
+
+# ---------------------------------------------------------------- 4. earlystop
+def _tiny_net():
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_iter():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(12, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+    return ListDataSetIterator([DataSet(x, y)])
+
+
+def test_max_epochs_fires_between_eval_boundaries():
+    from deeplearning4j_tpu.nn.earlystopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingTrainer, MaxEpochsTerminationCondition)
+    it = _tiny_iter()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(it),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+        evaluate_every_n_epochs=5)  # eval boundary AFTER the max epoch
+    res = EarlyStoppingTrainer(cfg, _tiny_net(), it).fit()
+    assert res.total_epochs <= 3
+    assert res.termination_reason == "EpochTerminationCondition"
+
+
+def test_no_termination_conditions_rejected():
+    from deeplearning4j_tpu.nn.earlystopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingTrainer)
+    it = _tiny_iter()
+    cfg = EarlyStoppingConfiguration(score_calculator=DataSetLossCalculator(it))
+    with pytest.raises(ValueError, match="termination condition"):
+        EarlyStoppingTrainer(cfg, _tiny_net(), it).fit()
+
+
+def test_cluster_early_stopping_max_epochs_cap():
+    from deeplearning4j_tpu.nn.earlystopping import (
+        EarlyStoppingConfiguration, MaxEpochsTerminationCondition)
+    from deeplearning4j_tpu.scaleout.earlystopping import (
+        ClusterDataSetLossCalculator, ClusterEarlyStoppingTrainer)
+    from deeplearning4j_tpu.scaleout.frontends import ClusterDl4jMultiLayer
+    from deeplearning4j_tpu.scaleout.param_averaging import (
+        ParameterAveragingTrainingMaster)
+
+    net = _tiny_net()
+    rng = np.random.default_rng(1)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    data = [DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+            for _ in range(2)]
+    fe = ClusterDl4jMultiLayer(
+        net, ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size_per_worker=8))
+    calc = ClusterDataSetLossCalculator(fe, data)
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=calc,
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+        evaluate_every_n_epochs=7)
+    res = ClusterEarlyStoppingTrainer(cfg, fe, data).fit()
+    assert res.total_epochs <= 2
+
+    with pytest.raises(ValueError, match="termination condition"):
+        ClusterEarlyStoppingTrainer(
+            EarlyStoppingConfiguration(score_calculator=calc), fe, data).fit()
+
+
+# ---------------------------------------------------------------- 5. dropconn
+def test_drop_connect_drops_weights_inverted_scale():
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+    layer = DenseLayer(n_in=64, n_out=64, dropout=0.5, use_drop_connect=True)
+    W = jnp.ones((64, 64))
+    p = layer._maybe_drop_connect({"W": W, "b": jnp.zeros(64)}, True,
+                                  jax.random.PRNGKey(0))
+    w = np.asarray(p["W"])
+    zeros = (w == 0.0).mean()
+    kept = w[w != 0.0]
+    assert 0.3 < zeros < 0.7                     # ~half dropped
+    np.testing.assert_allclose(kept, 2.0)        # inverted 1/p scaling
+    # inference: untouched
+    p_inf = layer._maybe_drop_connect({"W": W, "b": jnp.zeros(64)}, False,
+                                      jax.random.PRNGKey(0))
+    assert p_inf["W"] is W
+
+
+def test_drop_connect_suppresses_input_dropout():
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+    layer = DenseLayer(n_in=8, n_out=8, dropout=0.5, use_drop_connect=True)
+    x = jnp.ones((4, 8))
+    assert layer._maybe_dropout(x, True, jax.random.PRNGKey(0)) is x
+
+
+def test_drop_connect_training_end_to_end():
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.05)
+            .updater("adam").drop_out(0.5).use_drop_connect(True)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build())
+    assert conf.layers[0].use_drop_connect is True
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    net.fit(x, y)
+    s0 = net.score()
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score() < s0
+    # inference path is deterministic (no dropped weights)
+    o1, o2 = net.output(x), net.output(x)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+
+def test_drop_connect_serialization_round_trip():
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import (
+        MultiLayerConfiguration, NeuralNetConfiguration)
+    conf = (NeuralNetConfiguration.builder().drop_out(0.5).use_drop_connect(True)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=4))
+            .layer(OutputLayer(n_out=2))
+            .build())
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.layers[0].use_drop_connect is True
+    assert back.global_conf.use_drop_connect is True

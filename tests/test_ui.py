@@ -129,6 +129,77 @@ def test_ui_server_endpoints():
         srv.stop()
 
 
+def test_ui_server_histograms_and_graph():
+    """Round-3 TrainModule depth (ref: ui/module/train/TrainModule.java:53
+    histogram + layer-flow pages): the histogram data StatsListener
+    collects is rendered/served, and the model topology endpoint returns
+    nodes+edges for both model families."""
+    st = InMemoryStatsStorage()
+    _train_with_listener(st)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        h = _get(base + "/train/histograms?sid=sess-test")
+        assert h["iteration"] is not None
+        assert h["params"], "param histograms must be present"
+        first = h["params"][0]
+        assert len(first["counts"]) == 20 and first["min"] <= first["max"]
+        assert h["updates"], "update (delta) histograms must be present"
+
+        g = _get(base + "/train/graph?sid=sess-test")
+        names = [n["name"] for n in g["nodes"]]
+        assert "input" in names and len(g["nodes"]) == 3  # input+dense+out
+        assert ["input", "layer0"] in g["edges"]
+        assert ["layer0", "layer1"] in g["edges"]
+        # the dashboard page advertises the new tabs
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert 'data-tab="histograms"' in html and 'data-tab="graph"' in html
+    finally:
+        srv.stop()
+
+
+def test_ui_server_graph_for_computation_graph():
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ElementWiseVertex, GraphBuilder)
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = GlobalConf(seed=3, learning_rate=0.1, updater="adam")
+    conf = (GraphBuilder(g)
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("d2", DenseLayer(n_in=4, n_out=8), "in")
+            .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "add")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    st = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(st, session_id="cg-sess"))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(x, y)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        topo = _get(base + "/train/graph?sid=cg-sess")
+        names = {n["name"] for n in topo["nodes"]}
+        assert {"in", "d1", "d2", "add", "out"} <= names
+        assert ["d1", "add"] in topo["edges"]
+        assert ["d2", "add"] in topo["edges"]
+        types = {n["name"]: n["type"] for n in topo["nodes"]}
+        assert types["d1"] == "DenseLayer"          # LayerVertex unwrapped
+        assert types["add"] == "ElementWiseVertex"
+    finally:
+        srv.stop()
+
+
 def test_remote_stats_router():
     """(ref: RemoteUIStatsStorageRouter → UIServer /remoteReceive)"""
     srv = UIServer()
