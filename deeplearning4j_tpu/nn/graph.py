@@ -318,6 +318,7 @@ class ComputationGraph:
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
+            self._rnn_step_fn = None
 
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
@@ -408,23 +409,36 @@ class ComputationGraph:
     def rnn_time_step(self, *inputs):
         """Single/multi-step stateful inference: each call consumes
         [N, T, C] sequences, returns the network outputs, and carries
-        every recurrent vertex's hidden state to the next call."""
+        every recurrent vertex's hidden state to the next call.
+
+        The forward is jit-compiled and cached (token-by-token
+        autoregressive sampling must not pay op-by-op dispatch for the
+        whole graph every call); the first call without carried state and
+        the steady state with it trace once each."""
         if self.net_params is None:
             self.init()
-        ins = dict(zip(self.conf.network_inputs,
-                       (jnp.asarray(x) for x in inputs)))
-        acts, _, new_states, _ = self._forward_all(
-            self.net_params, self.net_state, ins, {}, False,
-            jax.random.PRNGKey(0))
+        self._check_trace_token()
+        if getattr(self, "_rnn_step_fn", None) is None:
+            def rnn_fn(params, state, xs):
+                ins = dict(zip(self.conf.network_inputs, xs))
+                acts, _, new_states, _ = self._forward_all(
+                    params, state, ins, {}, False, jax.random.PRNGKey(0))
+                outs = tuple(acts[n] for n in self.conf.network_outputs)
+                carries = {n: ns["rnn_state"]
+                           for n, ns in new_states.items()
+                           if isinstance(ns, dict) and "rnn_state" in ns}
+                return outs, carries
+            self._rnn_step_fn = jax.jit(rnn_fn)
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        outs, carries = self._rnn_step_fn(self.net_params, self.net_state, xs)
         merged = {}
         for name, old in self.net_state.items():
             s = dict(old)
-            ns = new_states.get(name, {})
-            if isinstance(ns, dict) and "rnn_state" in ns:
-                s["rnn_state"] = ns["rnn_state"]
+            if name in carries:
+                s["rnn_state"] = carries[name]
             merged[name] = s
         self.net_state = merged
-        return tuple(acts[n] for n in self.conf.network_outputs)
+        return outs
 
     def rnn_clear_previous_state(self):
         """(ref: ComputationGraph.rnnClearPreviousState :1608)"""
@@ -452,6 +466,19 @@ class ComputationGraph:
                  for n, s in self.net_state.items()}
         xs = tuple(jnp.asarray(x) for x in inputs)
         return self._output_fn(self.net_params, state, xs)
+
+    def feed_forward(self, *inputs, train: bool = False):
+        """All vertex activations by name (ref: ComputationGraph.feedForward
+        :1143) — the UI's conv-activation capture reads these."""
+        if self.net_params is None:
+            self.init()
+        ins = dict(zip(self.conf.network_inputs,
+                       (jnp.asarray(x) for x in inputs)))
+        state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
+                 for n, s in self.net_state.items()}
+        acts, _, _, _ = self._forward_all(self.net_params, state, ins, {},
+                                          train, jax.random.PRNGKey(0))
+        return acts
 
     def score(self, data: Optional[Union[DataSet, MultiDataSet]] = None) -> float:
         if data is None:

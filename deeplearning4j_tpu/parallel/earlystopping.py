@@ -41,7 +41,9 @@ class _IterationWatcher(IterationListener):
         self.fired = None
 
     def iteration_done(self, model, iteration):
-        if self.fired is not None:
+        # no conditions → never force the device→host score sync (it
+        # would serialize async dispatch against execution every step)
+        if not self.conditions or self.fired is not None:
             return
         s = float(model.score())
         for cond in self.conditions:

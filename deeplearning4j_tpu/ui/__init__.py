@@ -8,10 +8,13 @@ from deeplearning4j_tpu.ui.stats_storage import (
     FileStatsStorage, InMemoryStatsStorage, RemoteUIStatsStorageRouter,
     SqliteStatsStorage, StatsStorage, StatsStorageEvent, StatsStorageRouter)
 from deeplearning4j_tpu.ui.stats_listener import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.activations import (
+    ActivationsListener, post_word_vector_tsne)
 from deeplearning4j_tpu.ui.ui_server import UIServer
 
 __all__ = [
-    "FileStatsStorage", "InMemoryStatsStorage", "RemoteUIStatsStorageRouter",
-    "SqliteStatsStorage", "StatsStorage", "StatsStorageEvent",
-    "StatsStorageRouter", "StatsListener", "StatsReport", "UIServer",
+    "ActivationsListener", "FileStatsStorage", "InMemoryStatsStorage",
+    "RemoteUIStatsStorageRouter", "SqliteStatsStorage", "StatsStorage",
+    "StatsStorageEvent", "StatsStorageRouter", "StatsListener",
+    "StatsReport", "UIServer", "post_word_vector_tsne",
 ]

@@ -1,0 +1,114 @@
+"""Convolutional-activation capture for the training UI
+(ref: deeplearning4j-ui-parent ConvolutionalIterationListener +
+ui/module/convolutional/ConvolutionalListenerModule.java — the reference
+renders per-layer feature-map image grids at /activations; here the
+listener posts downsampled float grids through the stats-storage bus and
+the dashboard draws them as SVG heatmaps, no image encoder needed)."""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.ui.stats_storage import StatsStorageRouter
+
+TYPE_ID = "ActivationsListener"
+
+_MAX_GRID = 16      # downsample feature maps to at most 16x16
+_MAX_CHANNELS = 8   # first channels per conv layer
+_MAX_UNITS = 64     # first units for dense/recurrent layers
+
+
+def _downsample(a: np.ndarray, target: int = _MAX_GRID) -> np.ndarray:
+    """Box-mean downsample a 2-D map to <= target per side."""
+    h, w = a.shape
+    fh, fw = max(1, h // target), max(1, w // target)
+    th, tw = h // fh * fh, w // fw * fw
+    a = a[:th, :tw].reshape(th // fh, fh, tw // fw, fw).mean(axis=(1, 3))
+    return a
+
+
+def _layer_record(name: str, act: np.ndarray) -> Optional[dict]:
+    """One layer's activation summary: conv [N,C,H,W] → channel grids;
+    dense [N,F] → unit bar; recurrent [N,T,F] → time×feature grid."""
+    a = np.asarray(act, np.float32)
+    if a.ndim == 4:            # [N, C, H, W] — first example
+        grids = [_downsample(a[0, c]).tolist()
+                 for c in range(min(a.shape[1], _MAX_CHANNELS))]
+        return {"name": name, "kind": "conv", "grids": grids}
+    if a.ndim == 3:            # [N, T, F]
+        return {"name": name, "kind": "recurrent",
+                "grids": [_downsample(a[0]).tolist()]}
+    if a.ndim == 2:            # [N, F]
+        return {"name": name, "kind": "dense",
+                "values": a[0, :_MAX_UNITS].tolist()}
+    return None
+
+
+def post_word_vector_tsne(base_url: str, vectors, session_id: str,
+                          words: Optional[List[str]] = None,
+                          max_words: int = 200, perplexity: float = 10.0,
+                          n_iter: int = 250, seed: int = 0) -> int:
+    """Fit 2-D t-SNE over word vectors and upload to the UI's /tsne
+    endpoint (ref: TsneModule upload + word2vec UI hookup).  Returns the
+    number of words posted."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+    if words is None:
+        words = sorted(vectors.vocab.words())[:max_words]
+    else:
+        words = list(words)[:max_words]
+    mat = np.stack([np.asarray(vectors.word_vector(w)) for w in words])
+    coords = np.asarray(BarnesHutTsne(
+        n_components=2, perplexity=min(perplexity, max(2, len(words) // 4)),
+        n_iter=n_iter, seed=seed).fit_transform(mat))
+    body = json.dumps({"session_id": session_id, "words": words,
+                       "coords": coords.tolist()}).encode()
+    req = urllib.request.Request(base_url.rstrip("/") + "/tsne", data=body,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())["n"]
+
+
+class ActivationsListener(IterationListener):
+    """Every ``frequency`` iterations, run the probe batch through the
+    model's feed_forward and post per-layer activation grids."""
+
+    def __init__(self, router: StatsStorageRouter, probe_x,
+                 frequency: int = 10, session_id: Optional[str] = None,
+                 worker_id: Optional[str] = None):
+        self.router = router
+        self.probe_x = np.asarray(probe_x)[:1]   # one example is plenty
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or uuid.uuid4().hex[:12]
+        self.worker_id = worker_id or "activations-0"
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency:
+            return
+        layers: List[dict] = []
+        acts = model.feed_forward(self.probe_x)
+        if isinstance(acts, dict):        # ComputationGraph: name → act
+            items = acts.items()
+        else:                             # MultiLayerNetwork: list
+            items = ((f"layer{i} ({type(l).__name__})", a)
+                     for i, (l, a) in enumerate(zip(model.layers, acts)))
+        for name, a in items:
+            rec = _layer_record(str(name), np.asarray(a))
+            if rec is not None:
+                layers.append(rec)
+        self.router.put_update({
+            "session_id": self.session_id,
+            "type_id": TYPE_ID,
+            "worker_id": self.worker_id,
+            "timestamp": int(time.time() * 1000),
+            "iteration": iteration,
+            "layers": layers,
+        })

@@ -18,6 +18,10 @@ browser).  Endpoints:
   GET  /train/graph?sid=       model topology for the flow/graph view
                                (ref: TrainModule layer-flow page)
   GET  /train/system?sid=      static info + memory timeline
+  GET  /train/activations?sid= latest conv/dense activation grids
+                               (ref: ConvolutionalListenerModule)
+  GET  /train/tsne?sid=        posted t-SNE word coordinates
+  POST /tsne                   upload t-SNE coords (ref: TsneModule)
   POST /remoteReceive          remote stats ingestion
 """
 
@@ -53,6 +57,8 @@ th:first-child,td:first-child{text-align:left}
 <button data-tab="model">Model</button>
 <button data-tab="histograms">Histograms</button>
 <button data-tab="graph">Graph</button>
+<button data-tab="activations">Activations</button>
+<button data-tab="tsne">t-SNE</button>
 <button data-tab="system">System</button></nav>
 <main id="main"></main>
 <script>
@@ -77,6 +83,18 @@ function bars(counts,color){if(!counts||!counts.length)return '';
   y="${(H-pad-(c/mx)*(H-2*pad)).toFixed(1)}" width="${(bw*0.9).toFixed(1)}"
   height="${((c/mx)*(H-2*pad)).toFixed(1)}" fill="${color}"/>`).join('');
  return `<svg viewBox="0 0 ${W} ${H}" style="height:140px">${r}</svg>`;}
+function heat(grid){if(!grid||!grid.length)return '';
+ const rows=grid.length,cols=grid[0].length,cell=Math.min(12,192/rows);
+ let lo=Infinity,hi=-Infinity;
+ for(const r of grid)for(const v of r){if(v<lo)lo=v;if(v>hi)hi=v;}
+ const span=hi-lo||1;
+ let rects='';
+ grid.forEach((row,i)=>row.forEach((v,jj)=>{
+  const t=(v-lo)/span, c=Math.round(255*t);
+  rects+=`<rect x="${jj*cell}" y="${i*cell}" width="${cell}" height="${cell}"
+   fill="rgb(${c},${Math.round(64+96*t)},${255-c})"/>`;}));
+ return `<svg viewBox="0 0 ${cols*cell} ${rows*cell}"
+  style="width:${cols*cell*2}px;height:${rows*cell*2}px">${rects}</svg>`;}
 async function j(u){return (await fetch(u)).json();}
 async function render(){
  const m=document.getElementById('main');
@@ -113,6 +131,24 @@ async function render(){
    <defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7" refY="4"
     orient="auto"><path d="M0,0 L8,4 L0,8 z" fill="#95a5a6"/></marker></defs>
    ${lines}${boxes}</svg></div>`;}
+ else if(tab=='activations'){const d=await j('/train/activations?sid='+sid);
+  if(!d.layers.length){m.innerHTML='<p>no activation captures — attach an ActivationsListener</p>';}
+  else{m.innerHTML=`<h2>Activations (iter ${d.iteration})</h2>`+
+   d.layers.map(l=>{
+    if(l.kind=='dense')return `<div class="card"><h3>${esc(l.name)}</h3>${bars(l.values,'#16a085')}</div>`;
+    return `<div class="card"><h3>${esc(l.name)}</h3>`+
+      (l.grids||[]).map(g=>heat(g)).join(' ')+`</div>`;}).join('');}}
+ else if(tab=='tsne'){const d=await j('/train/tsne?sid='+sid);
+  if(!d.words.length){m.innerHTML='<p>no t-SNE upload yet — POST /tsne</p>';}
+  else{const xs=d.coords.map(c=>c[0]),ys=d.coords.map(c=>c[1]);
+  const x0=Math.min(...xs),x1=Math.max(...xs)||1,y0=Math.min(...ys),y1=Math.max(...ys)||1;
+  const W=860,H=560,pad=40;
+  const px=x=>pad+(x-x0)/(x1-x0||1)*(W-2*pad), py=y=>H-pad-(y-y0)/(y1-y0||1)*(H-2*pad);
+  const pts=d.words.map((w,i)=>`<circle cx="${px(xs[i]).toFixed(1)}" cy="${py(ys[i]).toFixed(1)}"
+   r="3" fill="#c0392b"/><text x="${(px(xs[i])+5).toFixed(1)}" y="${(py(ys[i])+3).toFixed(1)}"
+   font-size="10">${esc(w)}</text>`).join('');
+  m.innerHTML=`<div class="card"><h3>t-SNE word map (${d.words.length} words)</h3>
+   <svg viewBox="0 0 ${W} ${H}" style="height:${H}px">${pts}</svg></div>`;}}
  else{const d=await j('/train/system?sid='+sid);
   m.innerHTML=`<div class="card"><h3>Host RSS (MB)</h3>${line(d.memory,'#8e44ad')}</div>
   <div class="card"><h3>Static info</h3><pre>${esc(JSON.stringify(d.static,null,2))}</pre></div>`;}
@@ -142,6 +178,7 @@ class UIServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._storages: List[StatsStorage] = []
         self._remote_storage = InMemoryStatsStorage()
+        self._tsne: dict = {}   # session_id → {"words", "coords"}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -178,12 +215,36 @@ class UIServer:
                         self._json(server._graph(sid))
                     elif u.path == "/train/system":
                         self._json(server._system(sid))
+                    elif u.path == "/train/activations":
+                        self._json(server._activations(sid))
+                    elif u.path == "/train/tsne":
+                        self._json(server._tsne.get(sid) or
+                                   {"words": [], "coords": []})
                     else:
                         self._send(404, b'{"error":"not found"}')
                 except Exception as e:
                     self._send(500, json.dumps({"error": str(e)}).encode())
 
             def do_POST(self):
+                if self.path == "/tsne":
+                    # (ref: TsneModule POST /tsne/upload — coordinate file
+                    # upload; JSON body {"session_id","words","coords"})
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n))
+                        sid = str(body["session_id"])
+                        words = list(map(str, body["words"]))
+                        coords = [[float(c[0]), float(c[1])]
+                                  for c in body["coords"]]
+                        if len(words) != len(coords):
+                            raise ValueError("words/coords length mismatch")
+                        server._tsne[sid] = {"words": words,
+                                             "coords": coords}
+                        self._json({"ok": True, "n": len(words)})
+                    except Exception as e:
+                        self._send(400, json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}).encode())
+                    return
                 if self.path != "/remoteReceive":
                     self._send(404, b'{"error":"not found"}')
                     return
@@ -348,3 +409,22 @@ class UIServer:
                        for u in ups],
             "static": self._static(sid),
         }
+
+    def _activations(self, sid) -> dict:
+        """Latest ActivationsListener record for the session
+        (ref: ConvolutionalListenerModule /activations)."""
+        from deeplearning4j_tpu.ui.activations import TYPE_ID as ACT_TYPE
+        if sid is None:
+            return {"iteration": None, "layers": []}
+        latest = None
+        for st in self._all_storages():
+            for wid in st.list_worker_ids_for_session(sid):
+                rec = st.get_latest_update(sid, ACT_TYPE, wid)
+                if rec and (latest is None
+                            or rec.get("iteration", 0)
+                            > latest.get("iteration", 0)):
+                    latest = rec
+        if latest is None:
+            return {"iteration": None, "layers": []}
+        return {"iteration": latest.get("iteration"),
+                "layers": latest.get("layers", [])}

@@ -200,6 +200,88 @@ def test_ui_server_graph_for_computation_graph():
         srv.stop()
 
 
+def test_ui_server_activations_page():
+    """(ref: ConvolutionalListenerModule /activations — per-layer feature
+    map grids served to the dashboard)"""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ConvolutionLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.ui import ActivationsListener
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 1, 12, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.05)
+            .updater("adam")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max"))
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    st = InMemoryStatsStorage()
+    net.set_listeners(ActivationsListener(st, x, frequency=1,
+                                          session_id="act-sess"))
+    net.fit(x, y)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        d = _get(base + "/train/activations?sid=act-sess")
+        assert d["iteration"] is not None
+        kinds = {l["kind"] for l in d["layers"]}
+        assert "conv" in kinds and "dense" in kinds
+        conv = next(l for l in d["layers"] if l["kind"] == "conv")
+        assert conv["grids"] and len(conv["grids"][0]) <= 16
+        html = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert 'data-tab="activations"' in html and 'data-tab="tsne"' in html
+    finally:
+        srv.stop()
+
+
+def test_ui_server_tsne_upload_roundtrip():
+    """(ref: TsneModule /tsne upload + word-vector UI hookup)"""
+    from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+    from deeplearning4j_tpu.text.sentence_iterators import (
+        CollectionSentenceIterator)
+    from deeplearning4j_tpu.ui import post_word_vector_tsne
+
+    rng = np.random.default_rng(1)
+    vocab = [f"w{i}" for i in range(12)]
+    sents = [" ".join(rng.choice(vocab, 6)) for _ in range(80)]
+    w2v = (Word2Vec.Builder()
+           .iterate(CollectionSentenceIterator(sents))
+           .layer_size(8).window_size(2).negative_sample(2)
+           .use_hierarchic_softmax(False).min_word_frequency(1)
+           .epochs(1).seed(2).build())
+    w2v.build_vocab()
+    w2v.fit()
+
+    srv = UIServer()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        n = post_word_vector_tsne(base, w2v, "tsne-sess", n_iter=30)
+        assert n == 12
+        d = _get(base + "/train/tsne?sid=tsne-sess")
+        assert len(d["words"]) == 12 and len(d["coords"]) == 12
+        assert all(len(c) == 2 and all(np.isfinite(v) for v in c)
+                   for c in d["coords"])
+        # malformed upload → 400
+        import urllib.error
+        req = urllib.request.Request(
+            base + "/tsne", data=b'{"session_id":"x","words":["a"],"coords":[]}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
 def test_remote_stats_router():
     """(ref: RemoteUIStatsStorageRouter → UIServer /remoteReceive)"""
     srv = UIServer()
