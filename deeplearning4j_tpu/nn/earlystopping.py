@@ -263,3 +263,9 @@ class EarlyStoppingTrainer:
             total_epochs=epoch + 1, best_model_epoch=best_epoch,
             best_model_score=best_score, score_vs_epoch=score_vs_epoch,
             best_model=best if best is not None else self.net)
+
+
+class EarlyStoppingGraphTrainer(EarlyStoppingTrainer):
+    """ComputationGraph early stopping (ref: trainer/EarlyStoppingGraphTrainer.java)
+    — the loop is model-agnostic here (fit/score/iteration are the same
+    surface on both engines); the class exists for reference API parity."""

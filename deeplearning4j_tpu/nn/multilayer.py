@@ -549,6 +549,17 @@ class MultiLayerNetwork:
                                     dataset.features, dataset.labels,
                                     dataset.features_mask, dataset.labels_mask))
 
+    def _merge_rnn_state(self, new_states) -> None:
+        """Persist per-layer rnn carries into the live state, leaving
+        everything else (BN running stats) untouched."""
+        merged = []
+        for old, new in zip(self.net_state, new_states):
+            s = dict(old)
+            if "rnn_state" in new:
+                s["rnn_state"] = new["rnn_state"]
+            merged.append(s)
+        self.net_state = merged
+
     def rnn_time_step(self, x):
         """Stateful single/multi-step inference, carrying RNN state across
         calls (ref: MultiLayerNetwork.rnnTimeStep :2383).  x: [N, T, C]."""
@@ -558,18 +569,42 @@ class MultiLayerNetwork:
         out, new_states, _ = self._forward(self.net_params, self.net_state, x,
                                            None, False, jax.random.PRNGKey(0),
                                            stateful_rnn=True)
-        # persist rnn carries (merge; BN stats unchanged in inference)
-        merged = []
-        for old, new in zip(self.net_state, new_states):
-            s = dict(old)
-            if "rnn_state" in new:
-                s["rnn_state"] = new["rnn_state"]
-            merged.append(s)
-        self.net_state = merged
+        self._merge_rnn_state(new_states)
         return out
 
     def rnn_clear_previous_state(self):
         self._strip_rnn_state()
+
+    def rnn_activate_using_stored_state(self, x, training: bool = False,
+                                        store_last_for_tbptt: bool = False):
+        """All layer activations computed FROM the stored RNN state,
+        optionally persisting the final carry (ref:
+        MultiLayerNetwork.rnnActivateUsingStoredState :1955 — the TBPTT
+        engine's forward; exposed for parity and inspection)."""
+        if self.net_params is None:
+            self.init()
+        x = jnp.asarray(x)
+        acts = []
+        cur, m = x, None
+        new_states = []
+        if training:
+            # fresh dropout masks per call (feed_forward's convention);
+            # a fixed key would train a fixed subnetwork
+            self._key, sub = jax.random.split(self._key)
+        else:
+            sub = jax.random.PRNGKey(0)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                cur, m = self.conf.preprocessors[i](cur, m)
+            cur, ns, m = layer.forward(self.net_params[i], self.net_state[i],
+                                       cur, train=training,
+                                       rng=jax.random.fold_in(sub, i),
+                                       mask=m)
+            new_states.append(ns)
+            acts.append(cur)
+        if store_last_for_tbptt:
+            self._merge_rnn_state(new_states)
+        return acts
 
     # ------------------------------------------------------------------
     # Param view parity

@@ -191,6 +191,59 @@ def math_inf():
     return math.inf
 
 
+def test_early_stopping_graph_trainer():
+    """(ref: trainer/EarlyStoppingGraphTrainer.java) — the CG engine
+    drives the same early-stopping loop."""
+    from deeplearning4j_tpu.nn.earlystopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingGraphTrainer, MaxEpochsTerminationCondition)
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+
+    g = GlobalConf(seed=2, learning_rate=0.1, updater="adam")
+    conf = (GraphBuilder(g).add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    data = _iris_like()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(_iris_like(seed=1)),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(4)])
+    res = EarlyStoppingGraphTrainer(cfg, net, data).fit()
+    assert res.total_epochs == 4
+    assert res.best_model is not None
+
+
+def test_mln_rnn_activate_using_stored_state():
+    """(ref: MultiLayerNetwork.rnnActivateUsingStoredState :1955)"""
+    from deeplearning4j_tpu.models.charrnn import char_rnn
+    net = char_rnn(vocab_size=8, hidden=8, layers=1)
+    net.init()
+    eye = np.eye(8, dtype=np.float32)
+    x1 = eye[np.random.default_rng(0).integers(0, 8, (2, 3))]
+    x2 = eye[np.random.default_rng(1).integers(0, 8, (2, 3))]
+
+    net.rnn_clear_previous_state()
+    acts = net.rnn_activate_using_stored_state(x1, store_last_for_tbptt=True)
+    assert len(acts) == len(net.layers)
+    assert any("rnn_state" in s for s in net.net_state)
+    # continuing from stored state must equal rnn_time_step over the
+    # concatenated sequence
+    out_b = np.asarray(net.rnn_activate_using_stored_state(x2)[-1])
+    net.rnn_clear_previous_state()
+    full = np.asarray(net.rnn_time_step(np.concatenate([x1, x2], axis=1)))
+    np.testing.assert_allclose(out_b, full[:, 3:], rtol=2e-4, atol=1e-5)
+    # without store_last_for_tbptt the state must NOT advance
+    net.rnn_clear_previous_state()
+    a1 = np.asarray(net.rnn_activate_using_stored_state(x1)[-1])
+    a2 = np.asarray(net.rnn_activate_using_stored_state(x1)[-1])
+    np.testing.assert_array_equal(a1, a2)
+
+
 def test_profiler_listener_produces_trace(tmp_path):
     """SURVEY §5: jax.profiler/XPlane integration as a TrainingListener —
     a trace directory with profile artifacts appears after the
