@@ -117,9 +117,13 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Forward (pure, traceable)
     # ------------------------------------------------------------------
-    def _forward(self, params, state, x, mask, train: bool, rng,
-                 stateful_rnn: bool = False):
-        """Full-stack activations.  Returns (out, new_states, out_mask)."""
+    def _forward_core(self, params, state, x, mask, train: bool, rng,
+                      stateful_rnn: bool, collect_acts: bool = False):
+        """THE per-layer forward loop (preprocessor hook, rnn-state
+        gating, per-layer rng fold) — single source for _forward,
+        feed_forward and rnn_activate_using_stored_state so the loop
+        contract cannot drift between them."""
+        acts = []
         new_states = []
         for i, layer in enumerate(self.layers):
             if i in self.conf.preprocessors:
@@ -128,9 +132,19 @@ class MultiLayerNetwork:
             if not stateful_rnn and "rnn_state" in s:
                 s = {k: v for k, v in s.items() if k != "rnn_state"}
             x, ns, mask = layer.forward(params[i], s, x, train=train,
-                                        rng=jax.random.fold_in(rng, i), mask=mask)
+                                        rng=jax.random.fold_in(rng, i),
+                                        mask=mask)
             new_states.append(ns)
-        return x, new_states, mask
+            if collect_acts:
+                acts.append(x)
+        return x, new_states, mask, acts
+
+    def _forward(self, params, state, x, mask, train: bool, rng,
+                 stateful_rnn: bool = False):
+        """Full-stack activations.  Returns (out, new_states, out_mask)."""
+        out, new_states, mask, _ = self._forward_core(
+            params, state, x, mask, train, rng, stateful_rnn)
+        return out, new_states, mask
 
     def _forward_to_preout(self, params, state, x, mask, train: bool, rng,
                            stateful_rnn: bool = False):
@@ -524,17 +538,10 @@ class MultiLayerNetwork:
         """All layer activations (ref: feedForward :696-788)."""
         if self.net_params is None:
             self.init()
-        acts = []
-        cur = jnp.asarray(x)
-        m = mask
         self._key, sub = jax.random.split(self._key)
-        for i, layer in enumerate(self.layers):
-            if i in self.conf.preprocessors:
-                cur, m = self.conf.preprocessors[i](cur, m)
-            s = {k: v for k, v in self.net_state[i].items() if k != "rnn_state"}
-            cur, _, m = layer.forward(self.net_params[i], s, cur, train=train,
-                                      rng=jax.random.fold_in(sub, i), mask=m)
-            acts.append(cur)
+        _, _, _, acts = self._forward_core(
+            self.net_params, self.net_state, jnp.asarray(x), mask, train,
+            sub, stateful_rnn=False, collect_acts=True)
         return acts
 
     def score(self, dataset=None) -> float:
@@ -583,25 +590,15 @@ class MultiLayerNetwork:
         engine's forward; exposed for parity and inspection)."""
         if self.net_params is None:
             self.init()
-        x = jnp.asarray(x)
-        acts = []
-        cur, m = x, None
-        new_states = []
         if training:
             # fresh dropout masks per call (feed_forward's convention);
             # a fixed key would train a fixed subnetwork
             self._key, sub = jax.random.split(self._key)
         else:
             sub = jax.random.PRNGKey(0)
-        for i, layer in enumerate(self.layers):
-            if i in self.conf.preprocessors:
-                cur, m = self.conf.preprocessors[i](cur, m)
-            cur, ns, m = layer.forward(self.net_params[i], self.net_state[i],
-                                       cur, train=training,
-                                       rng=jax.random.fold_in(sub, i),
-                                       mask=m)
-            new_states.append(ns)
-            acts.append(cur)
+        _, new_states, _, acts = self._forward_core(
+            self.net_params, self.net_state, jnp.asarray(x), None, training,
+            sub, stateful_rnn=True, collect_acts=True)
         if store_last_for_tbptt:
             self._merge_rnn_state(new_states)
         return acts

@@ -188,6 +188,43 @@ def test_cg_with_masked_rnn_output():
     _check(conf, [x], [y], lmasks=[lmask[..., None]], subset=48)
 
 
+def test_cg_per_example_label_mask():
+    """[N,1] per-example mask on a 2-D output broadcasts per-element and
+    must NOT be squeezed (the round-3 review's regression class) — and
+    its gradients must check numerically."""
+    conf = (GraphBuilder(_g())
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_in=6, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out")
+            .build())
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(N, 4)).astype(np.float64)
+    y = np.eye(3, dtype=np.float64)[rng.integers(0, 3, N)]
+    lmask = np.ones((N, 1), np.float64)
+    lmask[::2] = 0.0                       # half the examples masked out
+    _check(conf, [x], [y], lmasks=[lmask])
+    # masked-out examples must contribute zero loss: score with the mask
+    # equals score over only the kept rows (up to the mean denominator)
+    net = ComputationGraph(conf).init()
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    import jax.numpy as jnp
+    out_confs = net._output_layer_confs()
+    lc = out_confs["out"]
+    acts, preouts, _, _ = net._forward_all(
+        net.net_params, net.net_state,
+        {"in": jnp.asarray(x, jnp.float32)}, {}, False,
+        __import__("jax").random.PRNGKey(0), preout_for=["out"])
+    per = np.asarray(lc.compute_score(jnp.asarray(y, jnp.float32),
+                                      preouts["out"],
+                                      jnp.asarray(lmask, jnp.float32)))
+    assert np.all(per[::2] == 0.0)
+    assert np.all(per[1::2] > 0.0)
+
+
 # ---------------------------------------------------------------------------
 # Loss × activation sweep (ref: LossFunctionGradientCheck.java — the full
 # ILossFunction matrix against compatible output activations).

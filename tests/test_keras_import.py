@@ -156,3 +156,41 @@ def test_functional_model_import(tmp_path):
     expected = km.predict(x, verbose=0)
     (got,) = net.output(x)
     np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-3, atol=1e-4)
+
+
+def test_vgg16_cifar_import_north_star(tmp_path):
+    """The BASELINE 'VGG16 CIFAR-10 via Keras modelimport' config: a full
+    13-conv VGG16 (CIFAR shape, smaller FC) built in Keras, imported via
+    HDF5, output-equivalent, and trainable after import."""
+    from keras import layers
+    blocks = [(2, 16), (2, 24), (3, 32), (3, 48), (3, 48)]  # thin VGG16
+    stack = [layers.Input((32, 32, 3))]
+    for n_convs, ch in blocks:
+        for _ in range(n_convs):
+            stack.append(layers.Conv2D(ch, (3, 3), padding="same",
+                                       activation="relu"))
+        stack.append(layers.MaxPooling2D((2, 2)))
+    stack += [layers.Flatten(),
+              layers.Dense(64, activation="relu"),
+              layers.Dense(64, activation="relu"),
+              layers.Dense(10, activation="softmax")]
+    km = keras.Sequential(stack)
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    path = _save(km, tmp_path, "vgg16_cifar.h5")
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    # 13 convs + 5 pools + 3 dense-family layers came through
+    names = [type(l).__name__ for l in net.layers]
+    assert names.count("ConvolutionLayer") == 13
+    assert names.count("SubsamplingLayer") == 5
+    rng = np.random.default_rng(9)
+    x_keras = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+    x_native = np.transpose(x_keras, (0, 3, 1, 2))
+    expected = km.predict(x_keras, verbose=0)
+    got = np.asarray(net.output(x_native))
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-4)
+
+    # the imported model trains (the bench path: fit() on the import)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2)]
+    net.fit(x_native, y)
+    assert np.isfinite(float(net.score()))
