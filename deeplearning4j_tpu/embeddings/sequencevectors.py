@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.embeddings import kernels
 from deeplearning4j_tpu.embeddings.lookup import InMemoryLookupTable
 from deeplearning4j_tpu.embeddings.word_vectors import WordVectorsMixin
+from deeplearning4j_tpu.native.io import skipgram_pairs
 from deeplearning4j_tpu.text.sequence import Sequence, SequenceElement
 from deeplearning4j_tpu.text.vocab import AbstractCache, VocabConstructor
 
@@ -79,6 +80,10 @@ class _BatchBuffer:
         self.sg_ctx: List[int] = []
         self.sg_center: List[int] = []
         self.sg_alpha: List[float] = []
+        # bulk intake: whole-sentence pair arrays from the native/numpy
+        # windowing path (native.io.skipgram_pairs) — no per-pair Python
+        self.sg_chunks: List[tuple] = []
+        self._sg_bulk_n = 0
         self.cb_win: List[List[int]] = []
         self.cb_center: List[int] = []
         self.cb_alpha: List[float] = []
@@ -88,8 +93,19 @@ class _BatchBuffer:
         self.sg_ctx.append(ctx)
         self.sg_center.append(center)
         self.sg_alpha.append(alpha)
-        if len(self.sg_ctx) >= self.conf.batch_size:
-            self.flush_sg()
+        if len(self.sg_ctx) + self._sg_bulk_n >= self.conf.batch_size:
+            self.flush_sg(final=False)
+
+    def add_pairs_bulk(self, ctx: np.ndarray, center: np.ndarray,
+                       alpha: float):
+        """Whole arrays of (context, center) pairs at one learning rate —
+        the sentence-at-a-time fast path."""
+        if ctx.size == 0:
+            return
+        self.sg_chunks.append((ctx, center, float(alpha)))
+        self._sg_bulk_n += int(ctx.size)
+        if len(self.sg_ctx) + self._sg_bulk_n >= self.conf.batch_size:
+            self.flush_sg(final=False)
 
     def add_window(self, window_rows: List[int], center: int, alpha: float):
         self.cb_win.append(window_rows)
@@ -122,28 +138,57 @@ class _BatchBuffer:
         return points, codes, cmask, neg_idx, neg_label, neg_mask
 
     # -- flushes ----------------------------------------------------------
-    def flush_sg(self):
-        if not self.sg_ctx:
+    def flush_sg(self, final: bool = True):
+        """Launch skip-gram kernel batches.  Auto-flushes (final=False)
+        only process FULL batch_size slices and keep the tail buffered —
+        a padded partial batch per sentence would double kernel launches
+        for nothing; the tail rides along until the next full batch (or
+        the end-of-training flush())."""
+        total = len(self.sg_ctx) + self._sg_bulk_n
+        if total == 0:
             return
         B = self.conf.batch_size
-        n = len(self.sg_ctx)
-        ctx = np.zeros(B, np.int32)
-        center = np.zeros(B, np.int32)
-        alpha = np.zeros(B, np.float32)
-        pair_mask = np.zeros(B, np.float32)
-        ctx[:n] = self.sg_ctx
-        center[:n] = self.sg_center
-        alpha[:n] = self.sg_alpha
-        pair_mask[:n] = 1.0
-        pts, codes, cmask, nidx, nlab, nmask = self._hs_neg_arrays(
-            center, pair_mask)
-        t = self.table
-        t.syn0, t.syn1, t.syn1neg = kernels.skipgram_step(
-            t.syn0, t.syn1, t.syn1neg,
-            jnp.asarray(ctx), jnp.asarray(pts), jnp.asarray(codes),
-            jnp.asarray(cmask), jnp.asarray(nidx), jnp.asarray(nlab),
-            jnp.asarray(nmask), jnp.asarray(alpha))
+        if not final and total < B:
+            return
+        parts_ctx, parts_ctr, parts_a = [], [], []
+        if self.sg_ctx:
+            parts_ctx.append(np.asarray(self.sg_ctx, np.int32))
+            parts_ctr.append(np.asarray(self.sg_center, np.int32))
+            parts_a.append(np.asarray(self.sg_alpha, np.float32))
+        for c, t_, a in self.sg_chunks:
+            parts_ctx.append(np.asarray(c, np.int32))
+            parts_ctr.append(np.asarray(t_, np.int32))
+            parts_a.append(np.asarray(a, np.float32) if np.ndim(a)
+                           else np.full(c.size, a, np.float32))
+        ctx_all = np.concatenate(parts_ctx)
+        ctr_all = np.concatenate(parts_ctr)
+        a_all = np.concatenate(parts_a)
         self.sg_ctx, self.sg_center, self.sg_alpha = [], [], []
+        self.sg_chunks, self._sg_bulk_n = [], 0
+
+        stop = total if final else (total // B) * B
+        t = self.table
+        for s in range(0, stop, B):
+            n = min(B, stop - s)
+            ctx = np.zeros(B, np.int32)
+            center = np.zeros(B, np.int32)
+            alpha = np.zeros(B, np.float32)
+            pair_mask = np.zeros(B, np.float32)
+            ctx[:n] = ctx_all[s:s + n]
+            center[:n] = ctr_all[s:s + n]
+            alpha[:n] = a_all[s:s + n]
+            pair_mask[:n] = 1.0
+            pts, codes, cmask, nidx, nlab, nmask = self._hs_neg_arrays(
+                center, pair_mask)
+            t.syn0, t.syn1, t.syn1neg = kernels.skipgram_step(
+                t.syn0, t.syn1, t.syn1neg,
+                jnp.asarray(ctx), jnp.asarray(pts), jnp.asarray(codes),
+                jnp.asarray(cmask), jnp.asarray(nidx), jnp.asarray(nlab),
+                jnp.asarray(nmask), jnp.asarray(alpha))
+        if stop < total:  # re-buffer the tail (per-pair alphas preserved)
+            self.sg_chunks.append((ctx_all[stop:], ctr_all[stop:],
+                                   a_all[stop:]))
+            self._sg_bulk_n = total - stop
 
     def flush_cbow(self):
         if not self.cb_win:
@@ -387,12 +432,12 @@ class SequenceVectors(WordVectorsMixin):
         # reduced-window per center, word2vec style
         bs = rng.integers(0, conf.window, size=n)
         if algo == "skipgram":
-            for i in range(n):
-                lo = max(0, i - conf.window + bs[i])
-                hi = min(n, i + conf.window - bs[i] + 1)
-                for c in range(lo, hi):
-                    if c != i and ids[c] != ids[i]:
-                        buf.add_pair(int(ids[c]), int(ids[i]), alpha)
+            # whole-sentence pair generation in native code (numpy
+            # fallback) — the per-pair Python loop was the throughput
+            # ceiling of the fit() path
+            ctx, ctr = skipgram_pairs(ids, conf.window,
+                                      bs.astype(np.int32))
+            buf.add_pairs_bulk(ctx, ctr, alpha)
         elif algo == "cbow":
             for i in range(n):
                 lo = max(0, i - conf.window + bs[i])
@@ -409,9 +454,10 @@ class SequenceVectors(WordVectorsMixin):
         if algo == "dbow":
             # ref: learning/impl/sequence/DBOW.java — label vector predicts
             # every word (skip-gram with the label as the input row).
-            for lbl in label_ids:
-                for w in ids:
-                    buf.add_pair(int(lbl), int(w), alpha)
+            lbl_arr = np.asarray(label_ids, np.int32)
+            buf.add_pairs_bulk(np.repeat(lbl_arr, ids.size),
+                               np.tile(ids.astype(np.int32), lbl_arr.size),
+                               alpha)
         elif algo == "dm":
             # ref: learning/impl/sequence/DM.java — CBOW windows with the
             # label vector(s) appended to the context.

@@ -86,6 +86,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.arena_used.argtypes = [ctypes.c_void_p]
     lib.arena_used.restype = ctypes.c_long
     lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    try:
+        # newer symbol — a stale pre-rebuild .so must not break the
+        # graceful-fallback contract for every OTHER native consumer
+        c_int_p = ctypes.POINTER(ctypes.c_int)
+        lib.sg_pairs.argtypes = [c_int_p, ctypes.c_long, ctypes.c_int,
+                                 c_int_p, c_int_p, c_int_p]
+        lib.sg_pairs.restype = ctypes.c_long
+    except AttributeError:
+        log.warning("libdl4j_io.so predates sg_pairs; word2vec windowing "
+                    "uses the numpy fallback")
     _lib = lib
     return _lib
 

@@ -141,6 +141,41 @@ class NativeFilePrefetcher:
                 idx += 1
 
 
+def skipgram_pairs(ids: np.ndarray, window: int,
+                   reduced: np.ndarray) -> "tuple[np.ndarray, np.ndarray]":
+    """(context, center) index pairs for one sentence with per-center
+    reduced windows — word2vec's windowing hot loop, natively (sg_pairs
+    in dl4j_io.cc; the libnd4j AggregateSkipGram host-prep role) with a
+    vectorized numpy fallback.  Self-positions and equal-id pairs are
+    skipped, matching the reference's skip-gram trainer."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    reduced = np.ascontiguousarray(reduced, np.int32)
+    n = ids.size
+    if n == 0 or window <= 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32))
+    lib = _native.get_lib()
+    if lib is not None and hasattr(lib, "sg_pairs"):
+        cap = int(n) * 2 * window
+        ctx = np.empty(cap, np.int32)
+        ctr = np.empty(cap, np.int32)
+        got = lib.sg_pairs(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n, window,
+            reduced.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            ctx.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            ctr.ctypes.data_as(ctypes.POINTER(ctypes.c_int)))
+        return ctx[:got], ctr[:got]
+    # numpy fallback: offsets grid + validity mask
+    offs = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    pos = np.arange(n)[:, None] + offs[None, :]            # [n, 2w]
+    w_eff = (window - reduced)[:, None]
+    valid = (pos >= 0) & (pos < n) & (np.abs(offs)[None, :] <= w_eff)
+    pos_c = np.clip(pos, 0, n - 1)
+    ctx = ids[pos_c]
+    ctr = np.broadcast_to(ids[:, None], ctx.shape)
+    valid &= ctx != ctr
+    return ctx[valid].astype(np.int32), ctr[valid].astype(np.int32)
+
+
 def load_npz_dataset_bytes(blob: bytes):
     """Decode an exported .npz DataSet blob (scaleout.data format)."""
     from deeplearning4j_tpu.datasets.dataset import DataSet

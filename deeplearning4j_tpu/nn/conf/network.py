@@ -59,6 +59,10 @@ class GlobalConf:
     # compute on TPU, f32 elsewhere); 'float32' | 'bfloat16' | 'float64'.
     # Master params/updater state stay float32 either way (ops/dtypes.py).
     precision: Optional[str] = None
+    # Rematerialization: recompute each layer's forward during backward
+    # instead of keeping its activations in HBM (jax.checkpoint per
+    # layer/vertex) — the FLOPs-for-memory trade for deep nets on TPU.
+    gradient_checkpointing: bool = False
 
 
 _MERGE_FIELDS = [
@@ -242,6 +246,13 @@ class Builder:
         """Mixed-precision policy: 'bfloat16' (TPU fast path), 'float32',
         'float64', or None/'auto' (bf16 on TPU, f32 elsewhere)."""
         self._g.precision = p
+        return self
+
+    def gradient_checkpointing(self, on: bool = True):
+        """Recompute layer forwards in the backward pass (jax.checkpoint)
+        — trades ~33% more FLOPs for O(depth) less activation HBM, the
+        standard remat recipe for deep nets on TPU."""
+        self._g.gradient_checkpointing = bool(on)
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

@@ -150,8 +150,14 @@ class ComputationGraph:
                 acts[name] = lc._act(pre)
                 out_masks[name] = ms[0] if ms else None
             else:
-                y, ns, m = v.forward(params[name], state[name], ins,
-                                     train=train, rng=r, masks=ms)
+                def fwd(p, s, ins_, ms_, v=v, r=r):
+                    return v.forward(p, s, ins_, train=train, rng=r,
+                                     masks=ms_)
+                if train and self.conf.global_conf.gradient_checkpointing:
+                    # per-vertex remat: recompute this vertex's forward in
+                    # the backward pass instead of storing activations
+                    fwd = jax.checkpoint(fwd)
+                y, ns, m = fwd(params[name], state[name], ins, ms)
                 acts[name] = y
                 new_states[name] = ns
                 out_masks[name] = m
@@ -293,20 +299,33 @@ class ComputationGraph:
         if isinstance(data, DataSet):
             data = MultiDataSet([data.features], [data.labels],
                                 [data.features_mask], [data.labels_mask])
+        from deeplearning4j_tpu.nn.listeners import TrainingListener
+
+        def epoch_hook(which):
+            for lst in self.listeners:
+                if isinstance(lst, TrainingListener):
+                    getattr(lst, which)(self)
+
         if isinstance(data, MultiDataSet):
             batches = [data]
             for _ in range(epochs):
+                epoch_hook("on_epoch_start")
                 for mds in batches:
                     self._fit_batch(mds)
+                epoch_hook("on_epoch_end")
+                self.epoch += 1
             return self
         # iterator of DataSet or MultiDataSet
         for _ in range(epochs):
+            epoch_hook("on_epoch_start")
             data.reset()
             for item in data:
                 if isinstance(item, DataSet):
                     item = MultiDataSet([item.features], [item.labels],
                                         [item.features_mask], [item.labels_mask])
                 self._fit_batch(item)
+            epoch_hook("on_epoch_end")
+            self.epoch += 1
         return self
 
     def _check_trace_token(self):
@@ -314,7 +333,8 @@ class ComputationGraph:
         ambient sequence-parallel regime or precision policy changes."""
         from deeplearning4j_tpu.parallel import sequence as seq_ops
         tok = (seq_ops.cache_token(),
-               dtype_ops.resolve(self.conf.global_conf.precision))
+               dtype_ops.resolve(self.conf.global_conf.precision),
+               self.conf.global_conf.gradient_checkpointing)
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None

@@ -117,23 +117,37 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Forward (pure, traceable)
     # ------------------------------------------------------------------
+    def _layer_step(self, layer, i, train: bool, rng):
+        """One layer's forward as a pure fn of (params, state, x, mask),
+        wrapped in jax.checkpoint when gradient_checkpointing is on and
+        we're training — activations are then recomputed during the
+        backward pass instead of living in HBM (the TPU remat recipe)."""
+        def fwd(p, s, x, mask):
+            return layer.forward(p, s, x, train=train,
+                                 rng=jax.random.fold_in(rng, i), mask=mask)
+        if train and self.conf.global_conf.gradient_checkpointing:
+            return jax.checkpoint(fwd)
+        return fwd
+
     def _forward_core(self, params, state, x, mask, train: bool, rng,
-                      stateful_rnn: bool, collect_acts: bool = False):
+                      stateful_rnn: bool, collect_acts: bool = False,
+                      stop: Optional[int] = None):
         """THE per-layer forward loop (preprocessor hook, rnn-state
         gating, per-layer rng fold) — single source for _forward,
-        feed_forward and rnn_activate_using_stored_state so the loop
-        contract cannot drift between them."""
+        _forward_to_preout, feed_forward and
+        rnn_activate_using_stored_state so the loop contract cannot
+        drift between them.  ``stop`` runs only layers[:stop]."""
         acts = []
         new_states = []
-        for i, layer in enumerate(self.layers):
+        layers = self.layers if stop is None else self.layers[:stop]
+        for i, layer in enumerate(layers):
             if i in self.conf.preprocessors:
                 x, mask = self.conf.preprocessors[i](x, mask)
             s = state[i]
             if not stateful_rnn and "rnn_state" in s:
                 s = {k: v for k, v in s.items() if k != "rnn_state"}
-            x, ns, mask = layer.forward(params[i], s, x, train=train,
-                                        rng=jax.random.fold_in(rng, i),
-                                        mask=mask)
+            x, ns, mask = self._layer_step(layer, i, train, rng)(
+                params[i], s, x, mask)
             new_states.append(ns)
             if collect_acts:
                 acts.append(x)
@@ -149,17 +163,9 @@ class MultiLayerNetwork:
     def _forward_to_preout(self, params, state, x, mask, train: bool, rng,
                            stateful_rnn: bool = False):
         """Forward to the output layer's PRE-activation (stable fused loss)."""
-        new_states = []
         n = len(self.layers)
-        for i, layer in enumerate(self.layers[:-1]):
-            if i in self.conf.preprocessors:
-                x, mask = self.conf.preprocessors[i](x, mask)
-            s = state[i]
-            if not stateful_rnn and "rnn_state" in s:
-                s = {k: v for k, v in s.items() if k != "rnn_state"}
-            x, ns, mask = layer.forward(params[i], s, x, train=train,
-                                        rng=jax.random.fold_in(rng, i), mask=mask)
-            new_states.append(ns)
+        x, new_states, mask, _ = self._forward_core(
+            params, state, x, mask, train, rng, stateful_rnn, stop=n - 1)
         last = self.layers[-1]
         if (n - 1) in self.conf.preprocessors:
             x, mask = self.conf.preprocessors[n - 1](x, mask)
@@ -205,7 +211,8 @@ class MultiLayerNetwork:
         (ops/dtypes.set_default_policy — compute dtypes are baked in too)."""
         from deeplearning4j_tpu.parallel import sequence as seq_ops
         tok = (seq_ops.cache_token(),
-               dtype_ops.resolve(self.conf.global_conf.precision))
+               dtype_ops.resolve(self.conf.global_conf.precision),
+               self.conf.global_conf.gradient_checkpointing)
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None

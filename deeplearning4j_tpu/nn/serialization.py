@@ -91,12 +91,12 @@ def restore_normalizer(path: Union[str, Path]):
         return Normalizer.from_dict(json.loads(zf.read(NORMALIZER_NAME)))
 
 
-def load_model(path: Union[str, Path]):
+def load_model(path: Union[str, Path], load_updater: bool = True):
     """Sniff the model type from the checkpoint and restore it
     (ref: deeplearning4j-core util/ModelGuesser.java)."""
     with zipfile.ZipFile(path, "r") as zf:
         conf_dict = json.loads(zf.read(CONFIG_NAME))
     kind = conf_dict.get("@model")
     if kind == "ComputationGraph" or "vertices" in conf_dict:
-        return restore_computation_graph(path)
-    return restore_multi_layer_network(path)
+        return restore_computation_graph(path, load_updater=load_updater)
+    return restore_multi_layer_network(path, load_updater=load_updater)

@@ -212,6 +212,34 @@ int idx_read(const char* path, float* out, long count) {
 }
 
 // ---------------------------------------------------------------------------
+// Skip-gram pair generation — the word2vec windowing hot loop (the role
+// of the reference's libnd4j AggregateSkipGram host-side prep).  For each
+// center i, emit (context, center) index pairs over the reduced window
+// [i-w+r_i, i+w-r_i], skipping self-positions and equal ids.  Caller
+// provides out buffers of capacity n * 2 * window; returns pair count.
+
+long sg_pairs(const int* ids, long n, int window, const int* reduced,
+              int* ctx_out, int* ctr_out) {
+  long out = 0;
+  for (long i = 0; i < n; ++i) {
+    int w = window - reduced[i];
+    if (w <= 0) continue;
+    long lo = i - w;
+    if (lo < 0) lo = 0;
+    long hi = i + w + 1;
+    if (hi > n) hi = n;
+    int center = ids[i];
+    for (long c = lo; c < hi; ++c) {
+      if (c == i || ids[c] == center) continue;
+      ctx_out[out] = ids[c];
+      ctr_out[out] = center;
+      ++out;
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
 // Threaded file prefetcher: N reader threads pull paths from a work list
 // and push (index, bytes) blobs into a bounded queue — the native
 // realization of AsyncDataSetIterator's prefetch thread + BlockingQueue

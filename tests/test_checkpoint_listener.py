@@ -1,0 +1,143 @@
+"""CheckpointListener + resume_from_checkpoint — periodic save, pruning,
+crash-resume with updater state (SURVEY §5 failure/recovery; ref:
+util/ModelSerializer.java save/restore contract)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.checkpoint import (
+    CheckpointListener, resume_from_checkpoint)
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net():
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.05)
+            .updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return x, y
+
+
+def test_checkpoint_listener_saves_and_prunes(tmp_path):
+    net = _net()
+    net.set_listeners(CheckpointListener(tmp_path, save_every_n_iterations=2,
+                                         keep_last=2))
+    x, y = _data()
+    for _ in range(9):
+        net.fit(x, y)
+    ckpts = CheckpointListener.checkpoints(tmp_path)
+    assert len(ckpts) == 2                      # pruned to keep_last
+    assert ckpts[-1].name == "checkpoint_it8.zip"
+    assert CheckpointListener.last_checkpoint(tmp_path) == ckpts[-1]
+    assert not list(tmp_path.glob("*.tmp"))     # atomic publish left no temp
+
+
+def test_resume_continues_training_trajectory(tmp_path):
+    """A resumed run must continue the REFERENCE run exactly: params,
+    iteration counter, and Adam moments all restored."""
+    x, y = _data(seed=1)
+
+    ref = _net()
+    for _ in range(10):
+        ref.fit(x, y)
+
+    crashed = _net()
+    crashed.set_listeners(CheckpointListener(tmp_path,
+                                             save_every_n_iterations=6))
+    for _ in range(7):                          # checkpoint lands at it=6
+        crashed.fit(x, y)
+
+    resumed = resume_from_checkpoint(tmp_path)
+    assert resumed is not None
+    assert resumed.iteration == 6
+    for _ in range(4):                          # 6 + 4 = 10 total
+        resumed.fit(x, y)
+    np.testing.assert_allclose(np.asarray(resumed.params()),
+                               np.asarray(ref.params()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_resume_empty_dir_returns_none(tmp_path):
+    assert resume_from_checkpoint(tmp_path) is None
+
+
+def test_checkpoint_epoch_mode(tmp_path):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    net = _net()
+    net.set_listeners(CheckpointListener(tmp_path, save_every_epoch=True,
+                                         keep_last=5))
+    x, y = _data(seed=2)
+    net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=3)
+    assert len(CheckpointListener.checkpoints(tmp_path)) == 3
+    # resumed epoch counter == completed epochs (matches an
+    # uninterrupted run's post-fit counter)
+    resumed = resume_from_checkpoint(tmp_path)
+    assert resumed.epoch == 3 == net.epoch
+
+
+def test_checkpoint_epoch_mode_computation_graph(tmp_path):
+    """ComputationGraph.fit must fire epoch hooks too (it silently never
+    saved in save_every_epoch mode before round 3) — the epoch counter
+    and on_epoch_end now match MultiLayerNetwork semantics."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = GlobalConf(seed=1, learning_rate=0.05, updater="adam")
+    conf = (GraphBuilder(g).add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    net.set_listeners(CheckpointListener(tmp_path, save_every_epoch=True,
+                                         keep_last=5))
+    x, y = _data(seed=5)
+    net.fit(ListDataSetIterator([DataSet(x, y)]), epochs=2)
+    assert net.epoch == 2
+    assert len(CheckpointListener.checkpoints(tmp_path)) == 2
+    resumed = resume_from_checkpoint(tmp_path)
+    assert resumed is not None and resumed.epoch == 2
+
+
+def test_resume_without_updater_state(tmp_path):
+    net = _net()
+    net.set_listeners(CheckpointListener(tmp_path, save_every_n_iterations=2))
+    x, y = _data(seed=3)
+    for _ in range(4):
+        net.fit(x, y)
+    fresh = resume_from_checkpoint(tmp_path, load_updater=False)
+    warm = resume_from_checkpoint(tmp_path, load_updater=True)
+    assert float(np.abs(warm.updater_state_flat()).sum()) > 0
+    assert float(np.abs(fresh.updater_state_flat()).sum()) == 0.0
+
+
+def test_resume_survives_stale_index(tmp_path):
+    """Crash between zip publish and index write: the filename wins."""
+    import json
+    net = _net()
+    lst = CheckpointListener(tmp_path, save_every_n_iterations=2)
+    net.set_listeners(lst)
+    x, y = _data(seed=4)
+    for _ in range(4):
+        net.fit(x, y)
+    # simulate the stale-index crash window
+    (tmp_path / "checkpoint_index.json").write_text(
+        json.dumps({"iteration": 2, "epoch": 0}))
+    resumed = resume_from_checkpoint(tmp_path)
+    assert resumed.iteration == 4                # filename authoritative

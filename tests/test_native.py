@@ -163,3 +163,43 @@ def test_prefetcher_missing_file_raises(tmp_path):
     missing = tmp_path / "gone.bin"
     with pytest.raises(FileNotFoundError):
         list(NativeFilePrefetcher([ok, missing], capacity=2))
+
+
+def test_skipgram_pairs_native_matches_python_loop():
+    """sg_pairs (native) and the numpy fallback both reproduce the
+    original per-pair Python loop exactly — order included."""
+    from deeplearning4j_tpu.native.io import skipgram_pairs
+
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        n = int(rng.integers(1, 40))
+        window = int(rng.integers(1, 6))
+        ids = rng.integers(0, 12, n).astype(np.int32)
+        reduced = rng.integers(0, window, n).astype(np.int32)
+
+        # reference: the original Python windowing loop
+        exp_ctx, exp_ctr = [], []
+        for i in range(n):
+            lo = max(0, i - window + reduced[i])
+            hi = min(n, i + window - reduced[i] + 1)
+            for c in range(lo, hi):
+                if c != i and ids[c] != ids[i]:
+                    exp_ctx.append(ids[c])
+                    exp_ctr.append(ids[i])
+
+        ctx, ctr = skipgram_pairs(ids, window, reduced)
+        np.testing.assert_array_equal(ctx, exp_ctx)
+        np.testing.assert_array_equal(ctr, exp_ctr)
+
+        # numpy fallback agrees bit-for-bit with the native path
+        import deeplearning4j_tpu.native as nat
+        saved = nat._lib
+        try:
+            nat._lib = None
+            nat._tried = True
+            f_ctx, f_ctr = skipgram_pairs(ids, window, reduced)
+        finally:
+            nat._lib = saved
+            nat._tried = True
+        np.testing.assert_array_equal(f_ctx, ctx)
+        np.testing.assert_array_equal(f_ctr, ctr)

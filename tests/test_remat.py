@@ -1,0 +1,125 @@
+"""Gradient checkpointing (rematerialization) — conf.gradient_checkpointing
+wraps each layer/vertex forward in jax.checkpoint so the backward pass
+recomputes activations instead of holding them in HBM (the standard
+FLOPs-for-memory trade for deep nets on TPU; no reference analog —
+SURVEY §7 capability extension)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import (
+    GlobalConf, MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mln(remat):
+    b = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+         .updater("sgd").drop_out(0.5))
+    if remat:
+        b.gradient_checkpointing(True)
+    return MultiLayerNetwork(
+        b.list()
+        .layer(DenseLayer(n_in=4, n_out=16, activation="tanh", dropout=0.5))
+        .layer(DenseLayer(n_in=16, n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .build()).init()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return x, y
+
+
+def test_remat_mln_identical_training_trajectory():
+    """Remat changes memory, NOT math: same seeds → bitwise-comparable
+    params after several steps (dropout rng included, since checkpoint
+    replays the same fold_in key)."""
+    x, y = _data()
+    a, b = _mln(False), _mln(True)
+    for _ in range(5):
+        a.fit(x, y)
+        b.fit(x, y)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), rtol=1e-6, atol=1e-7)
+
+
+def test_remat_inserts_checkpoint_into_jaxpr():
+    net = _mln(True)
+    x, y = _data()
+    step = net._build_step_raw()
+    jaxpr = jax.make_jaxpr(step)(
+        net.net_params, net.net_state, net.opt_states,
+        jnp.asarray(x), jnp.asarray(y), None, None,
+        jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    prims = {e.primitive.name for e in jaxpr.jaxpr.eqns}
+
+    def all_prims(jx, acc):
+        for e in jx.eqns:
+            acc.add(e.primitive.name)
+            for v in e.params.values():
+                if hasattr(v, "jaxpr"):
+                    all_prims(v.jaxpr, acc)
+        return acc
+
+    prims = all_prims(jaxpr.jaxpr, set())
+    assert any("remat" in p or "checkpoint" in p for p in prims), prims
+
+    # and the plain config has none
+    net0 = _mln(False)
+    jaxpr0 = jax.make_jaxpr(net0._build_step_raw())(
+        net0.net_params, net0.net_state, net0.opt_states,
+        jnp.asarray(x), jnp.asarray(y), None, None,
+        jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
+    prims0 = all_prims(jaxpr0.jaxpr, set())
+    assert not any("remat" in p or "checkpoint" in p for p in prims0)
+
+
+def test_remat_cg_identical_training_trajectory():
+    def build(remat):
+        g = GlobalConf(seed=9, learning_rate=0.1, updater="adam",
+                       gradient_checkpointing=remat)
+        from deeplearning4j_tpu.nn.conf.graph_conf import ElementWiseVertex
+        conf = (GraphBuilder(g).add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=8,
+                                            activation="tanh"), "in")
+                .add_layer("d2", DenseLayer(n_in=4, n_out=8,
+                                            activation="relu"), "in")
+                .add_vertex("add", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "add")
+                .set_outputs("out").build())
+        return ComputationGraph(conf).init()
+
+    x, y = _data(seed=5)
+    a, b = build(False), build(True)
+    for _ in range(5):
+        a.fit(x, y)
+        b.fit(x, y)
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), rtol=1e-6, atol=1e-7)
+
+
+def test_remat_flag_round_trips_and_retraces():
+    conf = (NeuralNetConfiguration.builder().gradient_checkpointing(True)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=4))
+            .layer(OutputLayer(n_out=2))
+            .build())
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.global_conf.gradient_checkpointing is True
+
+    # flipping the flag invalidates the cached step (trace token)
+    net = _mln(False)
+    x, y = _data()
+    net.fit(x, y)
+    fn_before = net._step_fn
+    net.conf.global_conf.gradient_checkpointing = True
+    net.fit(x, y)
+    assert net._step_fn is not fn_before
