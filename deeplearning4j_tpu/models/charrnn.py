@@ -8,6 +8,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
 from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
 from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -35,9 +36,10 @@ def char_rnn(vocab_size: int, hidden: int = 200, layers: int = 2,
     return MultiLayerNetwork(conf)
 
 
-class CharacterIterator:
+class CharacterIterator(DataSetIterator):
     """Text → one-hot char sequences for char-RNN training
-    (ref: dl4j-examples CharacterIterator)."""
+    (ref: dl4j-examples CharacterIterator) — a real DataSetIterator so
+    ``net.fit(iterator, epochs=N)`` accepts it directly."""
 
     def __init__(self, text: str, seq_length: int = 100, batch: int = 32,
                  seed: int = 0):
@@ -52,15 +54,6 @@ class CharacterIterator:
         self.n_batches_per_epoch = max(
             1, (len(self.data) - seq_length - 1) // (batch * seq_length))
         self._count = 0
-
-    def __iter__(self):
-        self.reset()
-        return self
-
-    def __next__(self):
-        if not self.has_next():
-            raise StopIteration
-        return self.next()
 
     def next(self):
         from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -80,9 +73,6 @@ class CharacterIterator:
 
     def batch_size(self):
         return self.batch
-
-    def async_supported(self):
-        return True
 
 
 def sample_text(net: MultiLayerNetwork, iterator: CharacterIterator,

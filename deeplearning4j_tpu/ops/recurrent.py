@@ -3,8 +3,10 @@
 The reference's GravesLSTM runs an eager per-timestep loop of gemms
 (ref: nn/layers/recurrent/LSTMHelpers.java:60-164 — the shared
 activateHelper/backpropGradientHelper).  TPU-natively the whole sequence
-is a single ``lax.scan`` whose body is one fused [N, nIn+nOut] x
-[nIn+nOut, 4*nOut] matmul on the MXU; backprop through time falls out of
+is a single ``lax.scan``: the input projection x·W+b for ALL timesteps
+is hoisted into one large [N·T, nIn]×[nIn, 4H] MXU matmul outside the
+scan, and the scan body keeps only the [N, H]×[H, 4H] recurrent matmul
+(the cuDNN-style LSTM batching); backprop through time falls out of
 jax.grad over the scan instead of the reference's hand-written BPTT.
 
 Gate layout in the fused weight matrices is [input, forget, output, cell]
@@ -33,8 +35,19 @@ def lstm_cell(params: dict, x_t: jnp.ndarray, state: LSTMState,
               peephole: bool = True) -> Tuple[LSTMState, jnp.ndarray]:
     """One peephole-LSTM step.  params: W [nIn,4H], RW [H,4H], b [4H],
     pI/pF/pO [H] (if peephole)."""
-    H = state.h.shape[-1]
-    z = x_t @ params["W"] + state.h @ params["RW"] + params["b"]
+    return _lstm_cell_pre(params, x_t @ params["W"] + params["b"], state,
+                          gate_act, cell_act, peephole)
+
+
+def _lstm_cell_pre(params: dict, zx_t: jnp.ndarray, state: LSTMState,
+                   gate_act=jax.nn.sigmoid, cell_act=jnp.tanh,
+                   peephole: bool = True) -> Tuple[LSTMState, jnp.ndarray]:
+    """LSTM step on a PRE-PROJECTED input (zx_t = x_t·W + b): only the
+    [N,H]×[H,4H] recurrent matmul runs inside the time scan — the input
+    projection for all timesteps is hoisted into one big MXU-friendly
+    matmul by lstm_scan (the cuDNN-style LSTM batching the reference
+    gets from cudnnRNNForwardTraining)."""
+    z = zx_t + state.h @ params["RW"]
     zi, zf, zo, zc = jnp.split(z, 4, axis=-1)
     if peephole:
         zi = zi + state.c * params["pI"]
@@ -66,20 +79,27 @@ def lstm_scan(params: dict, x: jnp.ndarray, init: Optional[LSTMState] = None,
     if init is None:
         init = LSTMState(jnp.zeros((N, H), x.dtype), jnp.zeros((N, H), x.dtype))
 
-    xs = jnp.swapaxes(x, 0, 1)  # [T, N, nIn]
+    # input projection for ALL timesteps as one [N*T, nIn]x[nIn, 4H]
+    # matmul (large MXU tile) — the scan body keeps only the [N,H]x[H,4H]
+    # recurrent matmul, halving per-step gemms
+    zx = (x.reshape(N * T, -1) @ params["W"] + params["b"]).reshape(
+        N, T, 4 * H)
+    zxs = jnp.swapaxes(zx, 0, 1)  # [T, N, 4H]
     ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
 
     def step(carry: LSTMState, inp):
         if ms is None:
-            x_t = inp
-            new, h = lstm_cell(params, x_t, carry, gate_act, cell_act, peephole)
+            zx_t = inp
+            new, h = _lstm_cell_pre(params, zx_t, carry, gate_act, cell_act,
+                                    peephole)
             return new, h
-        x_t, m_t = inp
-        new, h = lstm_cell(params, x_t, carry, gate_act, cell_act, peephole)
+        zx_t, m_t = inp
+        new, h = _lstm_cell_pre(params, zx_t, carry, gate_act, cell_act,
+                                peephole)
         c = jnp.where(m_t > 0, new.c, carry.c)
         hh = jnp.where(m_t > 0, new.h, carry.h)
         return LSTMState(c, hh), hh * (m_t > 0)
 
-    inputs = xs if ms is None else (xs, ms)
+    inputs = zxs if ms is None else (zxs, ms)
     final, hs = lax.scan(step, init, inputs, reverse=reverse)
     return jnp.swapaxes(hs, 0, 1), final

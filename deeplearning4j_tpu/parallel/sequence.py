@@ -147,24 +147,36 @@ def _ring_attention_sharded(q, k, v, key_mask, *, axis_name: str,
         m, l, o, k, v, mask = carry
         src = (idx - s) % S
         k_pos = src * Tl + jnp.arange(Tl)                  # global k positions
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=acc_dt) * scale
-        if causal:
-            scores = jnp.where(q_pos[:, None] >= k_pos[None, :],
+
+        def attend(m, l, o):
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=acc_dt) * scale
+            if causal:
+                scores = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                   scores, NEG_INF)
+            scores = jnp.where(mask[:, None, None, :].astype(bool),
                                scores, NEG_INF)
-        scores = jnp.where(mask[:, None, None, :].astype(bool),
-                           scores, NEG_INF)
-        m_new = jnp.maximum(m, scores.max(axis=-1))
-        # guard fully-masked rows: keep exp argument finite
-        alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
-        p = jnp.exp(scores - m_new[..., None])
-        l = l * alpha + p.sum(axis=-1)
-        o = o * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v,
-                                              preferred_element_type=acc_dt)
+            m_new = jnp.maximum(m, scores.max(axis=-1))
+            # guard fully-masked rows: keep exp argument finite
+            alpha = jnp.exp(jnp.maximum(m - m_new, NEG_INF * 0.5))
+            p = jnp.exp(scores - m_new[..., None])
+            l_new = l * alpha + p.sum(axis=-1)
+            o_new = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v, preferred_element_type=acc_dt)
+            return m_new, l_new, o_new
+
+        # NB: a causal block-skip (cond on "all k in this shard's future")
+        # cannot shorten the ring's critical path — every hop ends in a
+        # ppermute all S devices must join, and the last shard attends on
+        # every hop, so step time stays S x attend either way.  The real
+        # causal win is zigzag/striped query partitioning (balance low+high
+        # positions per shard); until that layout lands, unconditional
+        # compute keeps the body simple and vmap-safe.
+        m, l, o = attend(m, l, o)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         mask = lax.ppermute(mask, axis_name, perm)
-        return (m_new, l, o, k, v, mask), None
+        return (m, l, o, k, v, mask), None
 
     (m, l, o, _, _, _), _ = lax.scan(
         body, (m, l, o, k, v, key_mask), jnp.arange(S))

@@ -138,6 +138,20 @@ class StatsListener(IterationListener):
             batch = getattr(model, "last_batch_size", 0)
             rss_mb = resource.getrusage(
                 resource.RUSAGE_SELF).ru_maxrss / 1024.0
+            memory = {"host_rss_mb": rss_mb}
+            # device-side HBM stats when the backend exposes them — the
+            # reference reports JVM+off-heap memory per iteration
+            # (BaseStatsListener memory section); here it's host RSS +
+            # per-device bytes-in-use
+            try:
+                import jax
+                for d in jax.local_devices():
+                    ms = d.memory_stats()
+                    if ms and "bytes_in_use" in ms:
+                        memory[f"device{d.id}_mb"] = (
+                            ms["bytes_in_use"] / (1024.0 * 1024.0))
+            except Exception:
+                pass
             report = StatsReport(
                 session_id=self.session_id, worker_id=self.worker_id,
                 timestamp=int(time.time() * 1000), iteration=iteration,
@@ -149,7 +163,7 @@ class StatsListener(IterationListener):
                     "batches_per_sec": 1.0 / dt if dt > 0 else 0.0,
                     "total_minibatches": iteration,
                 },
-                memory={"host_rss_mb": rss_mb})
+                memory=memory)
             self.router.put_update(report.to_record())
             self._last_params = cur if self.collect_histograms else None
         self._last_time = now
