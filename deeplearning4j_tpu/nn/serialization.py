@@ -39,8 +39,7 @@ def write_model(model, path: Union[str, Path], save_updater: bool = True,
     """(ref: ModelSerializer.writeModel)"""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    conf_dict = model.conf.to_dict()
-    conf_dict["@model"] = type(model).__name__
+    conf_dict = tagged_conf_dict(model)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr(CONFIG_NAME, json.dumps(conf_dict, indent=2))
         _write_array(zf, COEFFICIENTS_NAME, model.params())
@@ -91,12 +90,40 @@ def restore_normalizer(path: Union[str, Path]):
         return Normalizer.from_dict(json.loads(zf.read(NORMALIZER_NAME)))
 
 
+def tagged_conf_dict(model) -> dict:
+    """Model config dict tagged with the concrete model type — the
+    shared serialization header for the zip AND Orbax formats."""
+    conf_dict = model.conf.to_dict()
+    conf_dict["@model"] = type(model).__name__
+    return conf_dict
+
+
+def is_graph_conf(conf_dict: dict) -> bool:
+    """Model-type sniffing (ref: util/ModelGuesser.java) — one place."""
+    return (conf_dict.get("@model") == "ComputationGraph"
+            or "vertices" in conf_dict)
+
+
+def model_from_conf_dict(conf_dict: dict):
+    """Build an UNinitialized-params model of the right type from a
+    tagged config dict."""
+    conf_dict = {k: v for k, v in conf_dict.items() if k != "@model"}
+    if is_graph_conf(conf_dict):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_dict(conf_dict))
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(MultiLayerConfiguration.from_dict(conf_dict))
+
+
 def load_model(path: Union[str, Path], load_updater: bool = True):
     """Sniff the model type from the checkpoint and restore it
     (ref: deeplearning4j-core util/ModelGuesser.java)."""
     with zipfile.ZipFile(path, "r") as zf:
         conf_dict = json.loads(zf.read(CONFIG_NAME))
-    kind = conf_dict.get("@model")
-    if kind == "ComputationGraph" or "vertices" in conf_dict:
+    if is_graph_conf(conf_dict):
         return restore_computation_graph(path, load_updater=load_updater)
     return restore_multi_layer_network(path, load_updater=load_updater)
