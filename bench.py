@@ -156,6 +156,66 @@ def bench_lenet(precision):
     }
 
 
+def bench_lenet_scan(precision="bf16", k_steps=50):
+    """Device-bound ceiling: K full train steps fused into ONE compiled
+    program via lax.scan — no per-step host dispatch.  The gap between
+    this and the per-step `lenet` number is pure host/dispatch overhead.
+
+    OFF by default (DL4J_BENCH_SCAN=1 enables): on XLA:CPU, wrapping
+    the conv step in lax.scan is ~8x slower than the identical unrolled
+    step even at K=1 (loop bodies miss fusion/layout optimizations), so
+    the number is only meaningful on TPU and must be validated there
+    before it's trusted."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.lenet import lenet
+
+    BATCH = 256
+    net = lenet()
+    net.conf.global_conf.precision = precision
+    net.init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, 1, 28, 28)).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
+    raw = net._build_step_raw()
+
+    def k_train_steps(params, state, opts, it0, key):
+        def body(carry, i):
+            p, s, o = carry
+            p, s, o, score = raw(p, s, o, x, y, None, None, it0 + i,
+                                 jax.random.fold_in(key, i))
+            return (p, s, o), score
+        (params, state, opts), scores = jax.lax.scan(
+            body, (params, state, opts), jnp.arange(k_steps))
+        return params, state, opts, scores[-1]
+
+    jitted = jax.jit(k_train_steps, donate_argnums=(0, 1, 2))
+    carry = [net.net_params, net.net_state, net.opt_states]
+    key = jax.random.PRNGKey(0)
+    it = jnp.asarray(0, jnp.int32)
+
+    def run():
+        carry[0], carry[1], carry[2], _ = jitted(
+            carry[0], carry[1], carry[2], it, key)
+
+    times = timed_windows(run, lambda: jax.block_until_ready(carry[0]),
+                          steps=4, warmup=2)
+    st = window_stats(times, BATCH * k_steps, 4)
+    # normalize units to TRAIN steps so the fields recompute consistently
+    # with every other config (window covers 4 launches x k_steps steps)
+    st["launch_time_ms_median"] = st["step_time_ms_median"]
+    st["step_time_ms_median"] = st["launch_time_ms_median"] / k_steps
+    st["steps_per_window"] = 4 * k_steps
+    return {
+        "metric": f"LeNet-MNIST scan-fused steady-state samples/sec/chip "
+                  f"({precision}, {k_steps} steps/launch)",
+        "value": round(st["items_per_sec_median"], 1),
+        "unit": "samples/sec/chip",
+        "chips_used": 1,
+        **st,
+    }
+
+
 def bench_vgg16(peak):
     import jax.numpy as jnp
     from deeplearning4j_tpu.models.vgg import vgg16_cifar10
@@ -327,14 +387,17 @@ def main():
     budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
     t_start = time.perf_counter()
     configs = {}
-    for name, fn in [
+    config_list = [
         ("lenet", lambda: bench_lenet("bf16")),
         ("lenet_f32", lambda: bench_lenet("f32")),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
         ("word2vec", bench_word2vec),
         ("resnet50", lambda: bench_resnet50(n_chips, peak)),
-    ]:
+    ]
+    if os.environ.get("DL4J_BENCH_SCAN") == "1":
+        config_list.insert(2, ("lenet_scan", bench_lenet_scan))
+    for name, fn in config_list:
         elapsed = time.perf_counter() - t_start
         if name != "lenet" and elapsed > budget:
             configs[name] = {"skipped": f"time budget ({elapsed:.0f}s "
