@@ -211,5 +211,10 @@ def register(name: str, fn: LossFn) -> None:
     _REGISTRY[name.lower()] = fn
 
 
+def unregister(name: str) -> None:
+    """Remove a user-registered loss (no-op when absent)."""
+    _REGISTRY.pop(name.lower(), None)
+
+
 def names() -> list[str]:
     return sorted(_REGISTRY)

@@ -89,3 +89,44 @@ def test_custom_layer_checkpoint_round_trip(tmp_path):
     back = restore_multi_layer_network(tmp_path / "custom.zip")
     np.testing.assert_array_equal(np.asarray(back.output(x)),
                                   np.asarray(net.output(x)))
+
+
+def test_custom_loss_registration():
+    """User-registered loss functions plug into OutputLayer by name and
+    pass the numeric gradient check (the reference's custom
+    ILossFunction extension point, ref: LossFunctionGradientCheck
+    custom-loss pattern)."""
+    from deeplearning4j_tpu.ops import losses
+
+    def huber(labels, preout, activation="identity", mask=None):
+        # plain-jnp user code: the contract is (labels, preout,
+        # activation, mask) -> per-example score [N]
+        d = preout - labels                  # identity activation
+        per = jnp.where(jnp.abs(d) <= 1.0, 0.5 * d * d,
+                        jnp.abs(d) - 0.5)
+        if mask is not None:
+            per = per * mask
+        return jnp.sum(per, axis=tuple(range(1, per.ndim)))
+
+    losses.register("huber_test", huber)
+    try:
+        conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+                .updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="identity",
+                                   loss="huber_test"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float64)
+        y = rng.normal(size=(8, 2)).astype(np.float64)
+        from deeplearning4j_tpu.nn.gradientcheck import check_gradients
+        assert check_gradients(net, x, y, subset=32)
+        net.fit(x.astype(np.float32), y.astype(np.float32))
+        s0 = net.score()
+        for _ in range(30):
+            net.fit(x.astype(np.float32), y.astype(np.float32))
+        assert net.score() < s0
+    finally:
+        losses.unregister("huber_test")

@@ -1,0 +1,81 @@
+"""Variable-length time series: padded positions must be invisible to
+training and scoring (ref: deeplearning4j-core
+nn/multilayer/TestVariableLengthTS.java — perturb values under the mask
+and assert identical scores/gradients)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+N, T, F, C = 4, 6, 3, 2
+
+
+def _net(seed=3):
+    conf = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.1)
+            .updater("sgd")
+            .list()
+            .layer(GravesLSTM(n_in=F, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=C, activation="softmax",
+                                  loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _masked_batch(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(N, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (N, T))]
+    lengths = rng.integers(2, T + 1, N)
+    lengths[0] = T  # at least one full-length sequence
+    mask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    return x, y, mask
+
+
+def test_masked_positions_do_not_affect_score():
+    net = _net()
+    x, y, mask = _masked_batch()
+    ds_a = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    # garbage in the padded region — features AND labels — must change
+    # nothing (the reference perturbs both under the mask)
+    x2 = x.copy()
+    x2[mask == 0] = 777.0
+    y2 = y.copy()
+    y2[mask == 0] = 42.0
+    ds_b = DataSet(x2, y2, features_mask=mask, labels_mask=mask)
+    sa = net.score(ds_a)
+    sb = net.score(ds_b)
+    np.testing.assert_allclose(sa, sb, rtol=1e-6)
+
+
+def test_masked_positions_do_not_affect_training():
+    x, y, mask = _masked_batch(seed=1)
+    x2 = x.copy()
+    x2[mask == 0] = -555.0
+
+    a, b = _net(), _net()
+    a.fit(DataSet(x, y, features_mask=mask, labels_mask=mask))
+    b.fit(DataSet(x2, y, features_mask=mask, labels_mask=mask))
+    np.testing.assert_allclose(np.asarray(a.params()),
+                               np.asarray(b.params()), rtol=1e-5,
+                               atol=1e-6)
+    # and training with masks actually learns
+    s0 = a.score()
+    for _ in range(15):
+        a.fit(DataSet(x, y, features_mask=mask, labels_mask=mask))
+    assert a.score() < s0
+
+
+def test_evaluate_respects_label_mask():
+    from deeplearning4j_tpu.nn.evaluation import Evaluation
+    net = _net()
+    x, y, mask = _masked_batch(seed=2)
+    out = np.asarray(net.output(x, mask=None))
+    ev = Evaluation()
+    ev.eval(y, out, mask=mask)
+    # counted examples == number of unmasked timesteps
+    counted = sum(ev.confusion.get_count(a, p)
+                  for a in range(C) for p in range(C))
+    assert counted == int(mask.sum())
