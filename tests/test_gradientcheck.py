@@ -10,6 +10,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
     GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM,
     LocalResponseNormalization, OutputLayer, RnnOutputLayer, SubsamplingLayer,
+    ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.gradientcheck import check_gradients
@@ -148,3 +149,46 @@ def test_global_pooling_gradients():
             .build())
     net = MultiLayerNetwork(conf).init()
     assert check_gradients(net, x, y, subset=48)
+
+
+def test_cnn1d_gradients():
+    """Conv1D + Subsampling1D (+ global pooling) backward paths
+    numerically verified (ref: CNNGradientCheckTest 1D cases)."""
+    from deeplearning4j_tpu.nn.conf.layers_pretrain import (
+        Convolution1DLayer, Subsampling1DLayer)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(6, 8, 3))            # [N, T, C] recurrent input
+    y = np.eye(2)[rng.integers(0, 2, 6)]
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("sgd")
+            .list()
+            .layer(Convolution1DLayer(n_in=3, n_out=5, kernel=3,
+                                      activation="tanh"))
+            .layer(Subsampling1DLayer(pooling_type="max", kernel=2,
+                                      stride=2))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.recurrent(3, 8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=64, print_results=True)
+
+
+def test_cnn2d_zeropadding_gradients():
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(4, 1, 6, 6))
+    y = np.eye(2)[rng.integers(0, 2, 4)]
+    conf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+            .updater("sgd")
+            .list()
+            .layer(ZeroPaddingLayer(pad=(1, 1, 1, 1)))
+            .layer(ConvolutionLayer(n_out=3, kernel=(3, 3),
+                                    activation="tanh"))
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(6, 6, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, x, y, subset=64, print_results=True)
