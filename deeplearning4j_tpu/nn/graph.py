@@ -46,6 +46,8 @@ class ComputationGraph:
         self._step_fn = None
         self._output_fn = None
         self._score_fn = None
+        self._ext_grad_fn = None
+        self._apply_fn = None
         self.last_batch_size = 0
         self.last_etl_time_ms = 0.0
 
@@ -66,6 +68,9 @@ class ComputationGraph:
                 # inputs without declared types: best effort via layer n_in
                 if isinstance(v, LayerVertex):
                     lc = v.layer_conf()
+                    from deeplearning4j_tpu.nn.conf.layers import FrozenLayerConf
+                    if isinstance(lc, FrozenLayerConf):
+                        lc = lc._inner()
                     n_in = getattr(lc, "n_in", None)
                     if n_in:
                         from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -257,35 +262,48 @@ class ComputationGraph:
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_opts = {}, {}
-            for name in self.order:
-                gi = grads[name]
-                if not gi:
-                    new_params[name] = params[name]
-                    new_opts[name] = opts[name]
-                    continue
-                v = self.conf.vertices[name]
-                layer = v.layer_conf() if isinstance(v, LayerVertex) else None
-                if layer is not None:
-                    gi = upd_ops.normalize_gradient(
-                        gi, layer.gradient_normalization,
-                        layer.gradient_normalization_threshold or 1.0)
-                    lr_base = (layer.learning_rate
-                               if layer.learning_rate is not None
-                               else g.learning_rate)
-                else:
-                    lr_base = g.learning_rate
-                lr = upd_ops.schedule_lr(
-                    lr_base, g.lr_policy, it,
-                    decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
-                    power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
-                upd, new_opt = self.updaters[name].apply(gi, opts[name], lr, it)
-                new_params[name] = {k: params[name][k] - upd[k]
-                                    for k in params[name]}
-                new_opts[name] = new_opt
+            new_params, new_opts = self._apply_updates(params, opts, grads, it)
             return new_params, new_states, new_opts, score
 
         return step
+
+    def _apply_updates(self, params, opts, grads, it):
+        """Traceable gradient→param update over the vertex dict (per-layer
+        normalization, LR schedule, learning rule).  Shared by the fused
+        train step and the external-gradients path (apply_gradients)."""
+        g = self.conf.global_conf
+        new_params, new_opts = {}, {}
+        for name in self.order:
+            gi = grads[name]
+            if not gi:
+                new_params[name] = params[name]
+                new_opts[name] = opts[name]
+                continue
+            v = self.conf.vertices[name]
+            layer = v.layer_conf() if isinstance(v, LayerVertex) else None
+            if type(layer).__name__ == "FrozenLayerConf":
+                # frozen vertex (transfer learning): params must not move
+                new_params[name] = params[name]
+                new_opts[name] = opts[name]
+                continue
+            if layer is not None:
+                gi = upd_ops.normalize_gradient(
+                    gi, layer.gradient_normalization,
+                    layer.gradient_normalization_threshold or 1.0)
+                lr_base = (layer.learning_rate
+                           if layer.learning_rate is not None
+                           else g.learning_rate)
+            else:
+                lr_base = g.learning_rate
+            lr = upd_ops.schedule_lr(
+                lr_base, g.lr_policy, it,
+                decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
+            upd, new_opt = self.updaters[name].apply(gi, opts[name], lr, it)
+            new_params[name] = {k: params[name][k] - upd[k]
+                                for k in params[name]}
+            new_opts[name] = new_opt
+        return new_params, new_opts
 
     def _build_step(self):
         return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
@@ -339,6 +357,7 @@ class ComputationGraph:
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
             self._rnn_step_fn = None
+            self._ext_grad_fn = self._apply_fn = None
 
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
@@ -585,6 +604,94 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
+
+    # ------------------------------------------------------------------
+    # External-errors backprop (the RL pattern: caller owns the loss)
+    # ------------------------------------------------------------------
+    def backprop_gradient(self, inputs, epsilons, masks=None,
+                          train: bool = False):
+        """Vertex-param gradients + per-input epsilons from EXTERNAL error
+        signals dL/d(output_i) — no labels/loss (ref:
+        ComputationGraph.calcBackpropGradients external epsilons,
+        nn/graph/ComputationGraph.java:1421).  ``inputs`` and ``epsilons``
+        are sequences ordered like network_inputs / network_outputs.
+        Returns ``(grads, input_epsilons)``.  ``train=False`` (default)
+        reproduces output()'s exact forward; ``train=True`` samples fresh
+        dropout masks and folds updated carried state (BN running stats)
+        back into the network (see MultiLayerNetwork.backprop_gradient)."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if self._ext_grad_fn is None:
+            self._ext_grad_fn = {}
+        if train not in self._ext_grad_fn:
+            def ext_grad(params, state, xs, eps, ms, rng, _train=train):
+                def fwd(p, xs_):
+                    ins = dict(zip(self.conf.network_inputs, xs_))
+                    mdict = dict(zip(self.conf.network_inputs, ms)) \
+                        if ms is not None else {}
+                    acts, _, ns, _ = self._forward_all(
+                        p, state, ins, mdict, _train, rng)
+                    return tuple(acts[n]
+                                 for n in self.conf.network_outputs), ns
+                outs, vjp, ns = jax.vjp(fwd, params, xs, has_aux=True)
+                cot = tuple(e.astype(o.dtype) for e, o in zip(eps, outs))
+                g, dxs = vjp(cot)
+                return g, dxs, ns
+            self._ext_grad_fn[train] = jax.jit(ext_grad)
+        if train:
+            self._key, sub = jax.random.split(self._key)
+        else:
+            sub = jax.random.PRNGKey(0)
+        xs = tuple(jnp.asarray(x) for x in inputs)
+        eps = tuple(jnp.asarray(e) for e in epsilons)
+        grads, dxs, new_states = self._ext_grad_fn[train](
+            self.net_params, self.net_state, xs, eps, masks, sub)
+        if train:
+            self.net_state = new_states
+            self._strip_rnn_state()
+        return grads, dxs
+
+    def apply_gradients(self, grads):
+        """Apply externally computed vertex gradients through the
+        configured updaters — one jitted step (see
+        MultiLayerNetwork.apply_gradients)."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if self._apply_fn is None:
+            self._apply_fn = jax.jit(
+                lambda p, o, g, it: self._apply_updates(p, o, g, it),
+                donate_argnums=(0, 1))
+        self.net_params, self.opt_states = self._apply_fn(
+            self.net_params, self.opt_states, grads,
+            jnp.asarray(self.iteration, jnp.int32))
+        self.iteration += 1
+        return self
+
+    # ------------------------------------------------------------------
+    def summary(self) -> str:
+        """Printable vertex table in topological order: name, vertex type,
+        inputs, param count (ref: ComputationGraph.summary)."""
+        if self.net_params is None:
+            self.init()
+        rows = [("VertexName", "VertexType", "Inputs", "ParamCount")]
+        total = 0
+        for name in self.order:
+            v = self.conf.vertices[name]
+            lp = self.net_params[name]
+            n = sum(int(np.prod(a.shape)) for a in lp.values()) if lp else 0
+            total += n
+            vtype = (type(v.layer_conf()).__name__
+                     if isinstance(v, LayerVertex) else type(v).__name__)
+            rows.append((name, vtype,
+                         ",".join(self.conf.vertex_inputs[name]) or "-",
+                         f"{n:,}"))
+        from deeplearning4j_tpu.nn.multilayer import render_table
+        return render_table(rows, [
+            f"Total parameters: {total:,}",
+            f"Inputs: {', '.join(self.conf.network_inputs)}",
+            f"Outputs: {', '.join(self.conf.network_outputs)}"])
 
     def clone(self) -> "ComputationGraph":
         import copy

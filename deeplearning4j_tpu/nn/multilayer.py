@@ -35,6 +35,21 @@ WEIGHT_KEYS = {"W", "RW", "f_W", "f_RW", "b_W", "b_RW"}
 BIAS_KEYS = {"b", "f_b", "b_b"}
 
 
+def render_table(rows: Sequence[Tuple[str, ...]], footer: Sequence[str] = ()):
+    """Fixed-width text table: rows[0] is the header; footer lines follow
+    a rule.  Shared by MultiLayerNetwork.summary and
+    ComputationGraph.summary."""
+    ncols = len(rows[0])
+    widths = [max(len(r[c]) for r in rows) for c in range(ncols)]
+    lines = ["  ".join(r[c].ljust(widths[c]) for c in range(ncols))
+             for r in rows]
+    sep = "-" * len(lines[0])
+    lines.insert(1, sep)
+    lines.append(sep)
+    lines.extend(footer)
+    return "\n".join(lines)
+
+
 def _updater_for(layer: Layer) -> upd_ops.Updater:
     name = (layer.updater or "sgd").lower()
     hyper = {}
@@ -74,6 +89,8 @@ class MultiLayerNetwork:
         self._step_fn = None
         self._score_fn = None
         self._output_fn = None
+        self._ext_grad_fn = None
+        self._apply_fn = None
         self.last_batch_size = 0
         self.last_etl_time_ms = 0.0
         self.frozen: List[bool] = [type(l).__name__ == "FrozenLayerConf"
@@ -216,6 +233,7 @@ class MultiLayerNetwork:
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
+            self._ext_grad_fn = self._apply_fn = None
 
     # ------------------------------------------------------------------
     # The jitted train step — ONE XLA computation per step
@@ -269,39 +287,47 @@ class MultiLayerNetwork:
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-
-            new_params, new_opts = [], []
-            for i, layer in enumerate(self.layers):
-                gi = grads[i]
-                if not gi:
-                    new_params.append(params[i])
-                    new_opts.append(opts[i])
-                    continue
-                if self.frozen[i]:
-                    new_params.append(params[i])
-                    new_opts.append(opts[i])
-                    continue
-                gi = upd_ops.normalize_gradient(
-                    gi, layer.gradient_normalization,
-                    layer.gradient_normalization_threshold or 1.0)
-                lr = upd_ops.schedule_lr(
-                    layer.learning_rate if layer.learning_rate is not None else g.learning_rate,
-                    g.lr_policy, it,
-                    decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
-                    power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
-                blr = layer.bias_learning_rate
-                upd, new_opt = self.updaters[i].apply(gi, opts[i], lr, it)
-                if blr is not None and blr != (layer.learning_rate or g.learning_rate):
-                    # bias LR override: rescale bias update (exact for linear-in-lr rules)
-                    base = layer.learning_rate if layer.learning_rate is not None else g.learning_rate
-                    scale = blr / base if base else 1.0
-                    upd = {k: (v * scale if k in BIAS_KEYS else v)
-                           for k, v in upd.items()}
-                new_params.append({k: params[i][k] - upd[k] for k in params[i]})
-                new_opts.append(new_opt)
+            new_params, new_opts = self._apply_updates(params, opts, grads, it)
             return new_params, new_states, new_opts, score
 
         return step
+
+    def _apply_updates(self, params, opts, grads, it):
+        """Traceable gradient→param update: per-layer gradient
+        normalization, LR schedule, learning rule, bias-LR override and
+        frozen-layer gating.  Shared by the fused train step and the
+        external-gradients path (apply_gradients)."""
+        g = self.conf.global_conf
+        new_params, new_opts = [], []
+        for i, layer in enumerate(self.layers):
+            gi = grads[i]
+            if not gi:
+                new_params.append(params[i])
+                new_opts.append(opts[i])
+                continue
+            if self.frozen[i]:
+                new_params.append(params[i])
+                new_opts.append(opts[i])
+                continue
+            gi = upd_ops.normalize_gradient(
+                gi, layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0)
+            lr = upd_ops.schedule_lr(
+                layer.learning_rate if layer.learning_rate is not None else g.learning_rate,
+                g.lr_policy, it,
+                decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                power=g.lr_policy_power, schedule_map=g.learning_rate_schedule)
+            blr = layer.bias_learning_rate
+            upd, new_opt = self.updaters[i].apply(gi, opts[i], lr, it)
+            if blr is not None and blr != (layer.learning_rate or g.learning_rate):
+                # bias LR override: rescale bias update (exact for linear-in-lr rules)
+                base = layer.learning_rate if layer.learning_rate is not None else g.learning_rate
+                scale = blr / base if base else 1.0
+                upd = {k: (v * scale if k in BIAS_KEYS else v)
+                       for k, v in upd.items()}
+            new_params.append({k: params[i][k] - upd[k] for k in params[i]})
+            new_opts.append(new_opt)
+        return new_params, new_opts
 
     def _build_score_fn(self):
         out_layer = self.layers[-1]
@@ -609,6 +635,88 @@ class MultiLayerNetwork:
         if store_last_for_tbptt:
             self._merge_rnn_state(new_states)
         return acts
+
+    # ------------------------------------------------------------------
+    # External-errors backprop (the RL pattern: caller owns the loss)
+    # ------------------------------------------------------------------
+    def backprop_gradient(self, x, epsilon, mask=None, train: bool = False):
+        """Param gradients + input epsilon from an EXTERNAL error signal
+        dL/d(output) — no labels or loss function involved (ref:
+        ComputationGraph.calcBackpropGradients external epsilons,
+        nn/graph/ComputationGraph.java:1421; MLN backpropGradient).
+        Reinforcement-learning frameworks drive the reference engine this
+        way: run output(), compute their own loss outside, hand the error
+        back.  Returns ``(grads, input_epsilon)`` where grads matches the
+        net_params structure and input_epsilon is dL/dx.
+
+        ``train=False`` (default) makes the internal forward EXACTLY the
+        one output() ran — no dropout — so the gradients correspond to the
+        activations the caller computed its error from.  ``train=True``
+        samples fresh dropout masks (a different stochastic forward than
+        the caller's output() call) and also folds the forward's updated
+        carried state (BatchNorm running stats) back into the network,
+        like a fit() step does."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if self._ext_grad_fn is None:
+            self._ext_grad_fn = {}
+        if train not in self._ext_grad_fn:
+            def ext_grad(params, state, xi, eps, m, rng, _train=train):
+                def fwd(p, xin):
+                    out, ns, _ = self._forward(p, state, xin, m, _train, rng)
+                    return out, ns
+                out, vjp, ns = jax.vjp(fwd, params, xi, has_aux=True)
+                g, dx = vjp(eps.astype(out.dtype))
+                return g, dx, ns
+            self._ext_grad_fn[train] = jax.jit(ext_grad)
+        if train:
+            self._key, sub = jax.random.split(self._key)
+        else:
+            sub = jax.random.PRNGKey(0)
+        x = jnp.asarray(x)
+        grads, dx, new_states = self._ext_grad_fn[train](
+            self.net_params, self.net_state, x, jnp.asarray(epsilon), mask,
+            sub)
+        if train:
+            self.net_state = new_states
+            self._strip_rnn_state()
+        return grads, dx
+
+    def apply_gradients(self, grads):
+        """Apply externally computed per-layer gradients through the
+        configured updaters (normalization, LR schedule, learning rule,
+        frozen gating) — one jitted step.  Completes the external-errors
+        training loop started by :meth:`backprop_gradient`."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if self._apply_fn is None:
+            self._apply_fn = jax.jit(
+                lambda p, o, g, it: self._apply_updates(p, o, g, it),
+                donate_argnums=(0, 1))
+        self.net_params, self.opt_states = self._apply_fn(
+            self.net_params, self.opt_states, grads,
+            jnp.asarray(self.iteration, jnp.int32))
+        self.iteration += 1
+        return self
+
+    # ------------------------------------------------------------------
+    def summary(self) -> str:
+        """Printable layer table: index, type, param shapes, param count
+        (ref: MultiLayerNetwork.summary :2689)."""
+        if self.net_params is None:
+            self.init()
+        rows = [("Idx", "LayerType", "ParamShapes", "ParamCount")]
+        total = 0
+        for i, (layer, lp) in enumerate(zip(self.layers, self.net_params)):
+            n = sum(int(np.prod(v.shape)) for v in lp.values())
+            total += n
+            shapes = ", ".join(f"{k}{tuple(int(d) for d in v.shape)}"
+                               for k, v in sorted(lp.items()))
+            rows.append((str(i), type(layer).__name__, shapes or "-",
+                         f"{n:,}"))
+        return render_table(rows, [f"Total parameters: {total:,}"])
 
     # ------------------------------------------------------------------
     # Param view parity

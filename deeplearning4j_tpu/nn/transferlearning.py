@@ -159,10 +159,169 @@ class TransferLearningBuilder:
         return net
 
 
+class TransferLearningGraphBuilder:
+    """ComputationGraph transfer learning (ref: TransferLearning.java:425
+    GraphBuilder — fineTuneConfiguration / setFeatureExtractor(vertices) /
+    removeVertexAndConnections / addLayer / addVertex / nOutReplace /
+    setOutputs)."""
+
+    def __init__(self, net):
+        self._net = net
+        self._fine_tune: Optional[FineTuneConfiguration] = None
+        self._frozen_at: List[str] = []
+        self._n_out_replace: dict = {}
+        self._removed: List[str] = []
+        self._added: List[tuple] = []  # (name, vertex_conf_or_layer, inputs)
+        self._outputs: Optional[List[str]] = None
+
+    def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+        self._fine_tune = ftc
+        return self
+
+    def set_feature_extractor(self, *vertex_names: str):
+        """Freeze the named vertices and every ancestor vertex
+        (ref: GraphBuilder.setFeatureExtractor)."""
+        self._frozen_at = list(vertex_names)
+        return self
+
+    def n_out_replace(self, vertex_name: str, n_out: int,
+                      weight_init: Optional[str] = None):
+        self._n_out_replace[vertex_name] = (n_out, weight_init)
+        return self
+
+    def remove_vertex_and_connections(self, name: str):
+        self._removed.append(name)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str):
+        self._added.append((name, layer, list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex, *inputs: str):
+        self._added.append((name, vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str):
+        self._outputs = list(names)
+        return self
+
+    def _ancestors(self, conf, roots: List[str]) -> set:
+        seen = set()
+        stack = list(roots)
+        while stack:
+            n = stack.pop()
+            if n in seen or n not in conf.vertices:
+                continue
+            seen.add(n)
+            stack.extend(conf.vertex_inputs.get(n, []))
+        return seen
+
+    def build(self):
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration, LayerVertex)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        src = self._net
+        conf = copy.deepcopy(src.conf)
+        vertices = dict(conf.vertices)
+        vertex_inputs = {k: list(v) for k, v in conf.vertex_inputs.items()}
+        outputs = list(conf.network_outputs)
+
+        reinit: set = set()
+        for name in self._removed:
+            vertices.pop(name, None)
+            vertex_inputs.pop(name, None)
+            outputs = [o for o in outputs if o != name]
+
+        g = conf.global_conf
+        if self._fine_tune:
+            g = self._fine_tune.apply_to_global(g)
+
+        for name, (n_out, winit) in self._n_out_replace.items():
+            lv = vertices[name]
+            lc = lv.layer_conf()
+            lc = dc.replace(lc, n_out=n_out,
+                            **({"weight_init": winit} if winit else {}))
+            vertices[name] = LayerVertex(layer=lc.to_dict())
+            reinit.add(name)
+            for k, ins in vertex_inputs.items():
+                if name in ins and isinstance(vertices.get(k), LayerVertex):
+                    dlc = vertices[k].layer_conf()
+                    if getattr(dlc, "n_in", None):
+                        vertices[k] = LayerVertex(
+                            layer=dc.replace(dlc, n_in=n_out).to_dict())
+                        reinit.add(k)
+
+        for name, v, ins in self._added:
+            if isinstance(v, Layer):
+                v = LayerVertex(layer=merge_layer_conf(v, g).to_dict())
+            vertices[name] = v
+            vertex_inputs[name] = ins
+            reinit.add(name)
+
+        # dangling-edge validation AFTER all removals/additions so
+        # multi-vertex edits are order-independent
+        known = set(vertices) | set(conf.network_inputs)
+        for k, ins in vertex_inputs.items():
+            for i in ins:
+                if i not in known:
+                    raise ValueError(
+                        f"vertex '{k}' consumes removed/unknown vertex "
+                        f"'{i}' — remove or rewire downstream vertices too")
+
+        frozen: set = set()
+        if self._frozen_at:
+            tmp = ComputationGraphConfiguration(
+                network_inputs=conf.network_inputs, network_outputs=outputs,
+                vertices=vertices, vertex_inputs=vertex_inputs, global_conf=g)
+            frozen = self._ancestors(tmp, self._frozen_at)
+
+        new_vertices = {}
+        for name, v in vertices.items():
+            if isinstance(v, LayerVertex):
+                lc = v.layer_conf()
+                if name in frozen:
+                    if not isinstance(lc, FrozenLayerConf):
+                        lc = FrozenLayerConf.wrap(lc)
+                elif self._fine_tune:
+                    lc = self._fine_tune.apply_to_layer(lc)
+                new_vertices[name] = LayerVertex(layer=lc.to_dict())
+            else:
+                new_vertices[name] = v
+
+        new_conf = ComputationGraphConfiguration(
+            network_inputs=conf.network_inputs,
+            network_outputs=self._outputs if self._outputs is not None
+            else outputs,
+            vertices=new_vertices, vertex_inputs=vertex_inputs,
+            global_conf=g, input_types=conf.input_types,
+            backprop_type=conf.backprop_type,
+            tbptt_fwd_length=conf.tbptt_fwd_length,
+            tbptt_back_length=conf.tbptt_back_length)
+        net = ComputationGraph(new_conf).init()
+        if src.net_params is not None:
+            for name in net.order:
+                if name in reinit or name not in src.net_params:
+                    continue
+                old, fresh = src.net_params[name], net.net_params[name]
+                if all(k in old and old[k].shape == fresh[k].shape
+                       for k in fresh):
+                    net.net_params[name] = {
+                        k: jnp.array(old[k], copy=True) for k in fresh}
+            net.opt_states = {n: net.updaters[n].init(net.net_params[n])
+                              for n in net.order}
+        return net
+
+
 class TransferLearning:
     """Entry point mirroring the reference's nested Builder API."""
 
     Builder = TransferLearningBuilder
+    GraphBuilder = TransferLearningGraphBuilder
 
 
 class TransferLearningHelper:

@@ -1,0 +1,165 @@
+"""External-errors backprop + apply_gradients + summary().
+
+The reference lets a caller own the loss: run output(), compute an error
+signal outside the engine, and hand it back as an epsilon array —
+``MultiLayerNetwork.backpropGradient`` / ``ComputationGraph.
+calcBackpropGradients(externalEpsilons)`` (nn/graph/ComputationGraph.java
+:1421).  This is the contract RL frameworks train through.  Here the
+equivalent is a jitted jax.vjp of the forward, plus apply_gradients()
+to push the result through the configured updaters.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def small_mlp(loss="mse", out_act="identity"):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(7).learning_rate(0.1).updater("sgd")
+         .list()
+         .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=3, activation=out_act, loss=loss))
+         .build())).init()
+
+
+def two_output_graph():
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    conf = (GraphBuilder(GlobalConf(seed=3, learning_rate=0.05, updater="sgd"))
+            .add_inputs("in")
+            .add_layer("h", DenseLayer(n_in=4, n_out=6, activation="tanh"), "in")
+            .add_layer("o1", OutputLayer(n_out=2, activation="identity",
+                                         loss="mse"), "h")
+            .add_layer("o2", OutputLayer(n_out=3, activation="identity",
+                                         loss="mse"), "h")
+            .set_outputs("o1", "o2")
+            .build())
+    return ComputationGraph(conf).init()
+
+
+class TestMLNExternalGradients:
+    def test_matches_autodiff_of_weighted_output_sum(self):
+        net = small_mlp()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        eps = rng.normal(size=(4, 3)).astype(np.float32)
+
+        grads, dx = net.backprop_gradient(x, eps)
+
+        def loss(p, xi):
+            out, _, _ = net._forward(p, net.net_state, xi, None, True,
+                                     jax.random.PRNGKey(0))
+            return jnp.sum(out * eps)
+
+        want_p, want_x = jax.grad(loss, argnums=(0, 1))(
+            net.net_params, jnp.asarray(x))
+        for g, w in zip(grads, want_p):
+            for k in w:
+                np.testing.assert_allclose(g[k], w[k], rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(dx, want_x, rtol=1e-5, atol=1e-6)
+        assert dx.shape == x.shape
+
+    def test_external_loop_equals_fit_for_mse(self):
+        """Driving the engine externally with eps = dMSE/dOut must take the
+        same update step as the built-in fused mse fit."""
+        a = small_mlp()
+        b = small_mlp()
+        b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(6, 5)).astype(np.float32)
+        y = rng.normal(size=(6, 3)).astype(np.float32)
+
+        a.fit(x, y)
+
+        out = np.asarray(b.output(x))
+        # built-in mse: per-example mean-over-features squared error,
+        # meaned over the batch (ops/losses.mse divides by n_out)
+        eps = 2.0 * (out - y) / (x.shape[0] * y.shape[1])
+        grads, _ = b.backprop_gradient(x, eps)
+        b.apply_gradients(grads)
+
+        for pa, pb in zip(a.net_params, b.net_params):
+            for k in pa:
+                np.testing.assert_allclose(pa[k], pb[k], rtol=1e-4, atol=1e-5)
+        assert b.iteration == 1
+
+    def test_train_true_updates_batchnorm_running_stats(self):
+        from deeplearning4j_tpu.nn.conf.layers import BatchNormalization
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(9).learning_rate(0.1).updater("sgd")
+             .list()
+             .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
+             .layer(BatchNormalization())
+             .layer(OutputLayer(n_out=3, activation="identity", loss="mse"))
+             .build())).init()
+        rng = np.random.default_rng(5)
+        x = (rng.normal(size=(32, 5)) * 3 + 2).astype(np.float32)
+        eps = rng.normal(size=(32, 3)).astype(np.float32)
+        mean0 = np.asarray(net.net_state[1]["mean"]).copy()
+        # train=False must NOT touch carried state
+        net.backprop_gradient(x, eps, train=False)
+        np.testing.assert_array_equal(mean0, np.asarray(net.net_state[1]["mean"]))
+        # train=True folds the updated running stats back in (like fit())
+        net.backprop_gradient(x, eps, train=True)
+        assert not np.allclose(mean0, np.asarray(net.net_state[1]["mean"]))
+
+    def test_summary_lists_layers_and_total(self):
+        net = small_mlp()
+        s = net.summary()
+        assert "DenseLayer" in s and "OutputLayer" in s
+        total = 5 * 8 + 8 + 8 * 3 + 3
+        assert f"Total parameters: {total:,}" in s
+
+
+class TestCGExternalGradients:
+    def test_multi_output_epsilons_match_autodiff(self):
+        net = two_output_graph()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        e1 = rng.normal(size=(5, 2)).astype(np.float32)
+        e2 = rng.normal(size=(5, 3)).astype(np.float32)
+
+        grads, (dx,) = net.backprop_gradient([x], [e1, e2])
+
+        def loss(p, xi):
+            acts, _, _, _ = net._forward_all(
+                p, net.net_state, {"in": xi}, {}, True, jax.random.PRNGKey(0))
+            return jnp.sum(acts["o1"] * e1) + jnp.sum(acts["o2"] * e2)
+
+        want_p, want_x = jax.grad(loss, argnums=(0, 1))(
+            net.net_params, jnp.asarray(x))
+        for name in net.order:
+            for k in want_p[name]:
+                np.testing.assert_allclose(grads[name][k], want_p[name][k],
+                                           rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(dx, want_x, rtol=1e-5, atol=1e-6)
+
+    def test_apply_gradients_steps_params(self):
+        net = two_output_graph()
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        e1 = np.ones((5, 2), np.float32)
+        e2 = np.ones((5, 3), np.float32)
+        before = jax.tree_util.tree_map(jnp.array, net.net_params)
+        grads, _ = net.backprop_gradient([x], [e1, e2])
+        net.apply_gradients(grads)
+        moved = any(
+            not np.allclose(before[n][k], net.net_params[n][k])
+            for n in net.order for k in before[n])
+        assert moved and net.iteration == 1
+
+    def test_summary_lists_vertices(self):
+        net = two_output_graph()
+        s = net.summary()
+        for name in ("in", "h", "o1", "o2"):
+            assert name in s
+        assert "Outputs: o1, o2" in s

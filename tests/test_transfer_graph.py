@@ -1,0 +1,128 @@
+"""ComputationGraph transfer learning (ref: TransferLearning.java:425
+GraphBuilder) + frozen-vertex gating in the CG update step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder, LayerVertex
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer, FrozenLayerConf, OutputLayer)
+from deeplearning4j_tpu.nn.conf.network import GlobalConf
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning)
+
+
+def base_graph():
+    conf = (GraphBuilder(GlobalConf(seed=5, learning_rate=0.1, updater="sgd"))
+            .add_inputs("in")
+            .add_layer("feat", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("head", DenseLayer(n_in=8, n_out=6, activation="relu"),
+                       "feat")
+            .add_layer("out", OutputLayer(n_in=6, n_out=3,
+                                          activation="softmax", loss="mcxent"),
+                       "head")
+            .set_outputs("out")
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _data(n=16):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+def test_frozen_vertex_params_do_not_move():
+    net = base_graph()
+    conf = net.conf
+    # freeze 'feat' by wrapping its layer conf in-place
+    lc = conf.vertices["feat"].layer_conf()
+    conf.vertices["feat"] = LayerVertex(layer=FrozenLayerConf.wrap(lc).to_dict())
+    net = ComputationGraph(conf).init()
+    before = jax.tree_util.tree_map(jnp.array, net.net_params["feat"])
+    x, y = _data()
+    net.fit(x, y, epochs=3)
+    for k in before:
+        np.testing.assert_array_equal(before[k], net.net_params["feat"][k])
+    # unfrozen vertices DID move
+    assert not np.allclose(np.asarray(net.net_params["head"]["W"]), 0.0)
+    assert float(net.score()) == float(net.score())  # finite
+
+
+def test_graph_builder_freeze_and_replace_output():
+    src = base_graph()
+    x, y = _data()
+    src.fit(x, y)  # give the source some trained weights
+    feat_w = np.asarray(src.net_params["feat"]["W"]).copy()
+
+    new = (TransferLearning.GraphBuilder(src)
+           .fine_tune_configuration(FineTuneConfiguration(learning_rate=0.05))
+           .set_feature_extractor("feat")
+           .remove_vertex_and_connections("out")
+           .add_layer("newout",
+                      OutputLayer(n_in=6, n_out=5, activation="softmax",
+                                  loss="mcxent"), "head")
+           .set_outputs("newout")
+           .build())
+
+    # weights carried over for kept vertices
+    np.testing.assert_allclose(np.asarray(new.net_params["feat"]["W"]), feat_w)
+    np.testing.assert_allclose(np.asarray(new.net_params["head"]["W"]),
+                               np.asarray(src.net_params["head"]["W"]))
+    # frozen wrapping applied to 'feat' and its ancestors only
+    assert isinstance(new.conf.vertices["feat"].layer_conf(), FrozenLayerConf)
+    assert not isinstance(new.conf.vertices["head"].layer_conf(),
+                          FrozenLayerConf)
+
+    y5 = np.eye(5, dtype=np.float32)[np.random.default_rng(1).integers(0, 5, 16)]
+    new.fit(x, y5, epochs=2)
+    # frozen params unchanged through training; new head trains
+    np.testing.assert_array_equal(np.asarray(new.net_params["feat"]["W"]),
+                                  feat_w)
+    (out,) = new.output(x)
+    assert out.shape == (16, 5)
+
+
+def test_graph_builder_n_out_replace_rewires_downstream():
+    src = base_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .n_out_replace("feat", 12)
+           .build())
+    assert new.net_params["feat"]["W"].shape == (4, 12)
+    assert new.net_params["head"]["W"].shape == (12, 6)
+    x, y = _data()
+    new.fit(x, y)
+    assert np.isfinite(float(new.score()))
+
+
+def test_graph_builder_multi_removal_is_order_independent():
+    """Removing a vertex AND its consumer in either order must build
+    (validation runs after all edits, not per removal)."""
+    src = base_graph()
+    new = (TransferLearning.GraphBuilder(src)
+           .remove_vertex_and_connections("head")
+           .remove_vertex_and_connections("out")
+           .add_layer("out2", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax", loss="mcxent"),
+                      "feat")
+           .set_outputs("out2")
+           .build())
+    x, y = _data()
+    new.fit(x, y)
+    assert np.isfinite(float(new.score()))
+
+
+def test_graph_builder_remove_with_live_consumer_raises():
+    src = base_graph()
+    try:
+        (TransferLearning.GraphBuilder(src)
+         .remove_vertex_and_connections("head")
+         .build())
+    except ValueError as e:
+        assert "head" in str(e)
+    else:
+        raise AssertionError("expected ValueError for dangling consumer")
