@@ -358,6 +358,7 @@ class ComputationGraph:
             self._step_fn = self._score_fn = self._output_fn = None
             self._rnn_step_fn = None
             self._ext_grad_fn = self._apply_fn = None
+            self._score_ex_fn = None
 
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
@@ -582,6 +583,35 @@ class ComputationGraph:
     def num_params(self) -> int:
         return param_util.num_params([self.net_params[n] for n in self.order])
 
+    def param_table(self) -> Dict[str, jnp.ndarray]:
+        """Named param map keyed ``"<vertexName>_<paramName>"`` (ref:
+        Model.paramTable on ComputationGraph)."""
+        if self.net_params is None:
+            self.init()
+        return {f"{n}_{k}": v for n in self.order
+                for k, v in self.net_params[n].items()}
+
+    def _split_param_key(self, key: str):
+        # vertex names may themselves contain '_' and so may param names
+        # (f_W, b_RW) — match the longest vertex-name prefix
+        for n in sorted(self.net_params, key=len, reverse=True):
+            if key.startswith(n + "_"):
+                return n, key[len(n) + 1:]
+        raise KeyError(f"no vertex owns param key '{key}'")
+
+    def get_param(self, key: str) -> jnp.ndarray:
+        name, k = self._split_param_key(key)
+        return self.net_params[name][k]
+
+    def set_param(self, key: str, value) -> None:
+        name, k = self._split_param_key(key)
+        cur = self.net_params[name][k]
+        value = jnp.asarray(value, cur.dtype)
+        if value.shape != cur.shape:
+            raise ValueError(f"setParam('{key}'): shape {value.shape} != "
+                             f"{cur.shape}")
+        self.net_params[name] = {**self.net_params[name], k: value}
+
     def updater_state_flat(self) -> jnp.ndarray:
         leaves = jax.tree_util.tree_leaves(
             [self.opt_states[n] for n in self.order])
@@ -603,6 +633,139 @@ class ComputationGraph:
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
+        return self
+
+    # ------------------------------------------------------------------
+    def score_examples(self, data, add_regularization_terms: bool = False):
+        """Per-example scores without minibatch averaging, summed over all
+        output layers (ref: ComputationGraph.scoreExamples — the
+        anomaly-detection API; addRegularizationTerms adds the graph's
+        l1/l2 penalty to every example)."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if getattr(self, "_score_ex_fn", None) is None:
+            g = self.conf.global_conf
+            policy = dtype_ops.resolve(g.precision)
+            out_confs = self._output_layer_confs()
+            out_names = list(out_confs)
+            out_pos = {n: self.conf.network_outputs.index(n)
+                       for n in out_names}
+
+            def score_ex(params, state, xs, ys, fmasks, lmasks, add_reg):
+                pc, xs_c, fm_c = policy.cast_to_compute((params, xs, fmasks))
+                inputs = dict(zip(self.conf.network_inputs, xs_c))
+                masks = dict(zip(self.conf.network_inputs, fm_c)) \
+                    if fm_c is not None else {}
+                _, preouts, _, out_masks = self._forward_all(
+                    pc, state, inputs, masks, False, jax.random.PRNGKey(0),
+                    preout_for=out_names)
+                total = 0.0
+                for name, lc in out_confs.items():
+                    pre = policy.cast_to_accum(preouts[name])
+                    lm = self._resolve_label_mask(
+                        pre, lmasks[out_pos[name]] if lmasks is not None
+                        else None, out_masks.get(name))
+                    total = total + lc.compute_score(ys[out_pos[name]], pre,
+                                                     lm)
+                return total + jnp.where(add_reg,
+                                         self._reg_penalty(params), 0.0)
+
+            self._score_ex_fn = jax.jit(score_ex)
+        if isinstance(data, DataSet):
+            data = MultiDataSet([data.features], [data.labels],
+                                [data.features_mask], [data.labels_mask])
+        batches = [data] if isinstance(data, MultiDataSet) else data
+        out = []
+        for mds in batches:
+            if isinstance(mds, DataSet):
+                mds = MultiDataSet([mds.features], [mds.labels],
+                                   [mds.features_mask], [mds.labels_mask])
+            out.append(np.asarray(self._score_ex_fn(
+                self.net_params, self.net_state, tuple(mds.features),
+                tuple(mds.labels),
+                tuple(mds.features_masks) if mds.features_masks else None,
+                tuple(mds.labels_masks) if mds.labels_masks else None,
+                jnp.asarray(add_regularization_terms))))
+        return np.concatenate(out)
+
+    # ------------------------------------------------------------------
+    # Layerwise unsupervised pretraining over the DAG
+    # ------------------------------------------------------------------
+    def pretrain(self, data, epochs: int = 1):
+        """Layerwise pretrain of every pretrain-capable layer vertex in
+        topological order (ref: ComputationGraph.pretrain :549-561)."""
+        for name in self.order:
+            v = self.conf.vertices[name]
+            if isinstance(v, LayerVertex) and \
+                    v.layer_conf().is_pretrain_layer():
+                self.pretrain_layer(name, data, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, name: str, data, epochs: int = 1):
+        """Unsupervised fit of one layer vertex on the activations of its
+        upstream subgraph (ref: ComputationGraph.pretrainLayer).  The
+        upstream forward runs inside the same jitted step; XLA dead-code-
+        eliminates every vertex the target doesn't depend on."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        layer = self._vertex_layer(name)
+        if not layer.is_pretrain_layer():
+            return self
+        if self.net_params is None:
+            self.init()
+        in_name = self.conf.vertex_inputs[name][0]
+        updater = self.updaters[name]
+        g = self.conf.global_conf
+
+        def pre_step(lp, opt, all_params, state, xs, it, rng):
+            ins = dict(zip(self.conf.network_inputs, xs))
+            acts, _, _, _ = self._forward_all(
+                all_params, state, ins, {}, False, rng)
+            feats = jax.lax.stop_gradient(acts[in_name])
+
+            def full_loss(p):
+                loss = layer.pretrain_loss(p, feats, rng) + \
+                    MultiLayerNetwork._layer_reg_penalty(layer, p)
+                return loss if g.minimize else -loss
+
+            loss, grads = jax.value_and_grad(full_loss)(lp)
+            grads = upd_ops.normalize_gradient(
+                grads, layer.gradient_normalization,
+                layer.gradient_normalization_threshold or 1.0)
+            lr = upd_ops.schedule_lr(
+                layer.learning_rate if layer.learning_rate is not None
+                else g.learning_rate,
+                g.lr_policy, it,
+                decay_rate=g.lr_policy_decay_rate, steps=g.lr_policy_steps,
+                power=g.lr_policy_power,
+                schedule_map=g.learning_rate_schedule)
+            upd, new_opt = updater.apply(grads, opt, lr, it)
+            return {k: lp[k] - upd[k] for k in lp}, new_opt, loss
+
+        # no donation: the target vertex's params are passed BOTH as the
+        # trained leaf (lp) and inside all_params for the upstream forward
+        step_jit = jax.jit(pre_step)
+        if isinstance(data, (np.ndarray, jax.Array)):
+            data = DataSet(np.asarray(data), np.asarray(data))
+        if isinstance(data, DataSet):
+            data = MultiDataSet([data.features], [data.labels])
+        batches = [data] if isinstance(data, MultiDataSet) else None
+        for _ in range(epochs):
+            it_ = batches if batches is not None else (data.reset() or data)
+            for item in it_:
+                if isinstance(item, DataSet):
+                    item = MultiDataSet([item.features], [item.labels])
+                self._key, sub = jax.random.split(self._key)
+                lp, opt, loss = step_jit(
+                    self.net_params[name], self.opt_states[name],
+                    self.net_params, self.net_state, tuple(item.features),
+                    jnp.asarray(self.iteration, jnp.int32), sub)
+                self.net_params[name] = lp
+                self.opt_states[name] = opt
+                self._score = loss
+                self.iteration += 1
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration)
         return self
 
     # ------------------------------------------------------------------

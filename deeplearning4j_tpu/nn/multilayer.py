@@ -234,6 +234,7 @@ class MultiLayerNetwork:
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
             self._ext_grad_fn = self._apply_fn = None
+            self._score_ex_fn = None
 
     # ------------------------------------------------------------------
     # The jitted train step — ONE XLA computation per step
@@ -589,6 +590,48 @@ class MultiLayerNetwork:
                                     dataset.features, dataset.labels,
                                     dataset.features_mask, dataset.labels_mask))
 
+    def score_examples(self, data, add_regularization_terms: bool = False):
+        """Per-example scores WITHOUT minibatch averaging — the anomaly-
+        detection / per-example-attribution API (ref:
+        MultiLayerNetwork.scoreExamples :1884 iterator, :1901 DataSet;
+        addRegularizationTerms adds the net's l1/l2 penalty to every
+        example's score).  Accepts a DataSet or an iterator; returns a 1-D
+        np.ndarray of length total-examples."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if getattr(self, "_score_ex_fn", None) is None:
+            out_layer = self.layers[-1]
+            policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
+            def score_ex(params, state, x, y, fmask, lmask, add_reg):
+                pc, xc, fmc = policy.cast_to_compute((params, x, fmask))
+                preout, _, m, feats = self._forward_to_preout(
+                    pc, state, xc, fmc, False, jax.random.PRNGKey(0))
+                preout = policy.cast_to_accum(preout)
+                lm = lmask if lmask is not None else (
+                    m if (m is not None and m.ndim == preout.ndim - 1)
+                    else None)
+                if getattr(out_layer, "requires_features_for_score", False):
+                    per_ex = out_layer.compute_score_with_features(
+                        y, preout, policy.cast_to_accum(feats), params[-1],
+                        lm)
+                else:
+                    per_ex = out_layer.compute_score(y, preout, lm)
+                return per_ex + jnp.where(add_reg,
+                                          self._reg_penalty(params), 0.0)
+
+            self._score_ex_fn = jax.jit(score_ex)
+        batches = [data] if isinstance(data, DataSet) else data
+        out = []
+        for ds in batches:
+            out.append(np.asarray(self._score_ex_fn(
+                self.net_params, self.net_state, ds.features, ds.labels,
+                ds.features_mask, ds.labels_mask,
+                jnp.asarray(add_regularization_terms))))
+        return np.concatenate(out)
+
     def _merge_rnn_state(self, new_states) -> None:
         """Persist per-layer rnn carries into the live state, leaving
         everything else (BN running stats) untouched."""
@@ -733,6 +776,29 @@ class MultiLayerNetwork:
 
     def get_layer_params(self, i: int) -> dict:
         return self.net_params[i]
+
+    def param_table(self) -> Dict[str, jnp.ndarray]:
+        """Named param map keyed ``"<layerIdx>_<paramName>"`` — e.g.
+        ``"0_W"``, ``"1_b"`` (ref: Model.paramTable / MLN param keys)."""
+        if self.net_params is None:
+            self.init()
+        return {f"{i}_{k}": v for i, lp in enumerate(self.net_params)
+                for k, v in lp.items()}
+
+    def get_param(self, key: str) -> jnp.ndarray:
+        """(ref: Model.getParam("0_W"))"""
+        i, k = key.split("_", 1)
+        return self.net_params[int(i)][k]
+
+    def set_param(self, key: str, value) -> None:
+        """(ref: Model.setParam) — shape must match the existing param."""
+        i, k = key.split("_", 1)
+        cur = self.net_params[int(i)][k]
+        value = jnp.asarray(value, cur.dtype)
+        if value.shape != cur.shape:
+            raise ValueError(f"setParam('{key}'): shape {value.shape} != "
+                             f"{cur.shape}")
+        self.net_params[int(i)] = {**self.net_params[int(i)], k: value}
 
     def updater_state_flat(self) -> jnp.ndarray:
         leaves = jax.tree_util.tree_leaves(self.opt_states)

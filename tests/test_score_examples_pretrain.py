@@ -1,0 +1,152 @@
+"""Per-example scoring (ref: MultiLayerNetwork.scoreExamples :1884/:1901,
+ComputationGraph.scoreExamples) and ComputationGraph layerwise pretraining
+(ref: ComputationGraph.pretrain :549-561)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers_pretrain import AutoEncoder
+from deeplearning4j_tpu.nn.conf.network import GlobalConf, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _mln():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(1).learning_rate(0.1).updater("sgd").regularization(True).l2(0.01)
+         .list()
+         .layer(DenseLayer(n_in=6, n_out=10, activation="tanh"))
+         .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+         .build())).init()
+
+
+def _data(n=12):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, n)]
+    return x, y
+
+
+class TestScoreExamplesMLN:
+    def test_mean_matches_score_and_reg_flag(self):
+        net = _mln()
+        x, y = _data()
+        ds = DataSet(x, y)
+        per_ex = net.score_examples(ds)
+        assert per_ex.shape == (12,)
+        # without reg: mean of per-example == score(ds) - reg penalty
+        with_reg = net.score_examples(ds, add_regularization_terms=True)
+        reg = float(with_reg[0] - per_ex[0])
+        assert reg > 0  # l2=0.01 on real weights
+        np.testing.assert_allclose(with_reg, per_ex + reg, rtol=1e-5)
+        np.testing.assert_allclose(per_ex.mean() + reg, net.score(ds),
+                                   rtol=1e-4)
+
+    def test_singles_match_batch(self):
+        """Scoring examples one-by-one must equal scoring the batch
+        (per-example independence, the anomaly-detection contract)."""
+        net = _mln()
+        x, y = _data()
+        batch = net.score_examples(DataSet(x, y))
+        singles = np.concatenate([
+            net.score_examples(DataSet(x[i:i + 1], y[i:i + 1]))
+            for i in range(len(x))])
+        np.testing.assert_allclose(batch, singles, rtol=1e-4, atol=1e-6)
+
+    def test_iterator_concatenates(self):
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        net = _mln()
+        x, y = _data(16)
+        it = ListDataSetIterator(DataSet(x, y), 8)
+        per_ex = net.score_examples(it)
+        assert per_ex.shape == (16,)
+
+
+class TestScoreExamplesCG:
+    def test_two_output_sum(self):
+        conf = (GraphBuilder(GlobalConf(seed=2, learning_rate=0.1,
+                                        updater="sgd"))
+                .add_inputs("in")
+                .add_layer("h", DenseLayer(n_in=6, n_out=8,
+                                           activation="tanh"), "in")
+                .add_layer("o1", OutputLayer(n_out=4, activation="softmax",
+                                             loss="mcxent"), "h")
+                .add_layer("o2", OutputLayer(n_out=1, activation="identity",
+                                             loss="mse"), "h")
+                .set_outputs("o1", "o2")
+                .build())
+        net = ComputationGraph(conf).init()
+        x, y = _data()
+        y2 = np.random.default_rng(1).normal(size=(12, 1)).astype(np.float32)
+        mds = MultiDataSet([x], [y, y2])
+        per_ex = net.score_examples(mds)
+        assert per_ex.shape == (12,)
+        np.testing.assert_allclose(per_ex.mean(), net.score(mds), rtol=1e-4)
+
+
+class TestParamTable:
+    def test_mln_param_table_get_set(self):
+        net = _mln()
+        pt = net.param_table()
+        assert set(pt) == {"0_W", "0_b", "1_W", "1_b"}
+        assert net.get_param("0_W").shape == (6, 10)
+        new_w = np.zeros((6, 10), np.float32)
+        net.set_param("0_W", new_w)
+        np.testing.assert_array_equal(np.asarray(net.get_param("0_W")), new_w)
+        try:
+            net.set_param("0_W", np.zeros((2, 2), np.float32))
+        except ValueError:
+            pass
+        else:
+            raise AssertionError("shape mismatch must raise")
+
+    def test_cg_param_table_underscore_names(self):
+        conf = (GraphBuilder(GlobalConf(seed=5, learning_rate=0.1,
+                                        updater="sgd"))
+                .add_inputs("in")
+                .add_layer("my_hidden", DenseLayer(n_in=6, n_out=8,
+                                                   activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "my_hidden")
+                .set_outputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        pt = net.param_table()
+        assert "my_hidden_W" in pt and "out_b" in pt
+        assert net.get_param("my_hidden_W").shape == (6, 8)
+        net.set_param("my_hidden_b", np.ones((8,), np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(net.net_params["my_hidden"]["b"]), 1.0)
+
+
+class TestCGPretrain:
+    def test_autoencoder_vertex_pretrains(self):
+        conf = (GraphBuilder(GlobalConf(seed=3, learning_rate=0.05,
+                                        updater="adam"))
+                .add_inputs("in")
+                .add_layer("ae", AutoEncoder(n_in=6, n_out=4,
+                                             activation="sigmoid"), "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "ae")
+                .set_outputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(4)
+        x = rng.uniform(size=(64, 6)).astype(np.float32)
+
+        # loss must decrease over pretrain epochs on the AE vertex
+        net.pretrain_layer("ae", x, epochs=1)
+        first = float(net._score)
+        net.pretrain_layer("ae", x, epochs=30)
+        assert float(net._score) < first
+
+        # pretrain() routes to every pretrain-capable vertex
+        out_w = np.asarray(net.net_params["out"]["W"]).copy()
+        net.pretrain(x, epochs=2)
+        # supervised vertex untouched by unsupervised pretraining
+        np.testing.assert_array_equal(out_w, np.asarray(net.net_params["out"]["W"]))
