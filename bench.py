@@ -156,6 +156,96 @@ def bench_lenet(precision):
     }
 
 
+def bench_lenet_etl():
+    """LeNet fed from FILES, not in-memory arrays: npz shards on disk →
+    native threaded prefetcher (native/dl4j_io.cc) → AsyncDataSetIterator
+    (background decode + device_put) → fit step.  Reports etl_ms per
+    step next to step time so input-pipeline overlap is measured, not
+    assumed (ref: AsyncDataSetIterator.java:39-127; PerformanceListener's
+    ETL-ms column, PerformanceListener.java:119-122)."""
+    import pathlib
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.datasets.fetchers import load_mnist, CACHE_DIR
+    from deeplearning4j_tpu.datasets.iterators import (
+        AsyncDataSetIterator, ExistingDataSetIterator)
+    from deeplearning4j_tpu.native.io import (
+        NativeFilePrefetcher, load_npz_dataset_bytes)
+    from deeplearning4j_tpu.native import available as native_available
+
+    BATCH = 256
+    cache = pathlib.Path(__file__).parent / ".bench_cache" / "lenet_etl"
+    cache.mkdir(parents=True, exist_ok=True)
+    real_idx = (CACHE_DIR / "mnist").exists()
+    ds = load_mnist(train=True)
+    n_shards = min(40, ds.features.shape[0] // BATCH)
+    paths = [cache / f"shard_{i:03d}.npz" for i in range(n_shards)]
+    for i, p in enumerate(paths):
+        if not p.exists():
+            s = slice(i * BATCH, (i + 1) * BATCH)
+            tmp = p.with_suffix(".tmp.npz")
+            np.savez(tmp, features=ds.features[s], labels=ds.labels[s])
+            os.replace(tmp, p)  # atomic: a killed run can't leave a
+            # truncated shard that poisons every later bench
+
+    def gen():
+        for _, blob in NativeFilePrefetcher(paths, capacity=4, n_threads=2):
+            yield load_npz_dataset_bytes(blob)
+
+    it = AsyncDataSetIterator(ExistingDataSetIterator(gen),
+                              queue_size=4, device_put=True)
+    net = lenet()
+    net.conf.global_conf.precision = "bf16"
+    net.init()
+    step = jax.jit(net._build_step_raw(), donate_argnums=(0, 1, 2))
+    carry = [net.net_params, net.net_state, net.opt_states]
+    key = jax.random.PRNGKey(0)
+    it0 = jnp.asarray(0, jnp.int32)
+    etl_wait = [0.0]
+
+    def run():
+        t0 = time.perf_counter()
+        if not it.has_next():
+            it.reset()
+        d = it.next()
+        etl_wait[0] += time.perf_counter() - t0
+        carry[0], carry[1], carry[2], _ = step(
+            carry[0], carry[1], carry[2], d.features, d.labels,
+            None, None, it0, key)
+
+    STEPS = 30
+    for _ in range(8):
+        run()
+    jax.block_until_ready(carry[0])
+    times, etls = [], []
+    for _ in range(WINDOWS):
+        etl_wait[0] = 0.0
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            run()
+        jax.block_until_ready(carry[0])
+        times.append(time.perf_counter() - t0)
+        etls.append(etl_wait[0])
+    st = window_stats(times, BATCH, STEPS)
+    return {
+        "metric": "LeNet-MNIST fit() from disk via native prefetch + async "
+                  "iterator, samples/sec/chip (bf16)",
+        "value": round(st["items_per_sec_median"], 1),
+        "unit": "samples/sec/chip",
+        "chips_used": 1,
+        "etl_ms_per_step_median": round(
+            statistics.median(etls) / STEPS * 1e3, 3),
+        "etl_fraction_of_step": round(
+            statistics.median(etls) / statistics.median(times), 4),
+        "native_prefetcher": native_available(),
+        "data_source": "cached MNIST IDX" if real_idx
+                       else "synthetic fallback (zero egress)",
+        "n_shards": n_shards,
+        **st,
+    }
+
+
 def bench_lenet_scan(precision="bf16", k_steps=50):
     """Device-bound ceiling: K full train steps fused into ONE compiled
     program via lax.scan — no per-step host dispatch.  The gap between
@@ -370,15 +460,96 @@ def bench_resnet50(n_chips, peak):
     return out
 
 
-def main():
+def acquire_backend():
+    """Initialize a JAX backend, falling back to CPU when the primary
+    (TPU/axon) backend fails to init.  NEVER raises — round 3 died here
+    (BENCH_r03.json rc=1: 'Unable to initialize backend axon') and lost
+    the round's only hardware evidence.  Returns (devices|[], info)."""
     import jax
+    info = {}
+    forced = os.environ.get("DL4J_BENCH_PLATFORM")
+    if forced:
+        # the axon sitecustomize rewrites JAX_PLATFORMS at import time,
+        # so an explicit config update is the only reliable override
+        jax.config.update("jax_platforms", forced)
+        info["platform_forced"] = forced
+    try:
+        devs = jax.devices()
+        info["platform"] = jax.default_backend()
+        return devs, info
+    except Exception as e:
+        info["backend_error"] = f"{type(e).__name__}: {e}"[:500]
+        log(f"primary backend init FAILED: {e}\nfalling back to CPU")
+    # jax caches nothing on failure; narrowing jax_platforms to cpu makes
+    # the retry skip the broken plugin.  (Env var alone is not enough —
+    # the axon sitecustomize overrides JAX_PLATFORMS at import time.)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        info["platform"] = "cpu (fallback)"
+        return devs, info
+    except Exception as e:
+        info["fallback_error"] = f"{type(e).__name__}: {e}"[:500]
+        log(f"CPU fallback ALSO failed: {e}")
+        return [], info
+
+
+def main():
+    # From here down every failure mode must still end in ONE JSON line
+    # on stdout — a bench that can exit without printing is not a bench.
+    result = {
+        "metric": "LeNet-MNIST MultiLayerNetwork.fit() samples/sec/chip",
+        "value": 0.0,
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,
+    }
+    try:
+        import signal
+
+        def _bail(signum, frame):
+            raise TimeoutError(f"signal {signum}")
+        # SIGTERM (driver kill) and a hard alarm at 2x the config budget
+        # both unwind through the except below so the JSON line still
+        # prints; a hang inside a C++ compile can't be interrupted this
+        # way, but every Python-level stall can.
+        signal.signal(signal.SIGTERM, _bail)
+        signal.signal(signal.SIGALRM, _bail)
+        budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
+        signal.alarm(int(budget * 2) + 300)
+        _run_configs(result)
+        signal.alarm(0)
+    except BaseException as e:  # incl. KeyboardInterrupt from a driver kill
+        result["fatal_error"] = f"{type(e).__name__}: {e}"[:500]
+        log(traceback.format_exc())
+    finally:
+        print(json.dumps(result), flush=True)
+
+
+def _run_configs(result):
     from deeplearning4j_tpu.ops import platform
 
-    n_chips = max(1, len(jax.devices()))
+    devices, backend_info = acquire_backend()
+    result.update(backend_info)
+    if not devices:
+        result["configs"] = {}
+        return
+    import jax
+    n_chips = max(1, len(devices))
     kind = platform.device_kind()
     peak = platform.peak_flops_bf16()
     log(f"devices={n_chips} kind={kind!r} is_tpu={platform.is_tpu()} "
         f"bf16_peak={peak}")
+
+    # Compile-check both Pallas kernels BEFORE any config touches them:
+    # a Mosaic rejection here downgrades to the dense path (and is
+    # recorded) instead of sinking the first config that calls attention
+    # or the fused xent (round-3 weak #3: the compiled path had never
+    # run on a real chip).
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    t0 = time.perf_counter()
+    result["pallas_kernels"] = pk.kernel_self_test()
+    log(f"pallas self-test ({time.perf_counter() - t0:.1f}s): "
+        f"{result['pallas_kernels']}")
 
     # Per-run wall-clock budget: the headline (lenet) runs first; if a
     # later config's compile drags past the budget the remaining ones
@@ -389,6 +560,7 @@ def main():
     configs = {}
     config_list = [
         ("lenet", lambda: bench_lenet("bf16")),
+        ("lenet_etl", bench_lenet_etl),
         ("lenet_f32", lambda: bench_lenet("f32")),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
@@ -415,16 +587,14 @@ def main():
 
     head = configs.get("lenet", {})
     value = head.get("value", 0.0)
-    print(json.dumps({
-        "metric": "LeNet-MNIST MultiLayerNetwork.fit() samples/sec/chip",
+    result.update({
         "value": value,
-        "unit": "samples/sec/chip",
         "vs_baseline": round(value / BASELINE_SAMPLES_SEC, 2),
         "device_kind": kind,
         "n_chips": n_chips,
         "measurement": f"median of {WINDOWS} timed windows",
         "configs": configs,
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -94,7 +94,7 @@ def _fused_xent_wanted(labels, preout, mask) -> bool:
     n_rows = 1
     for d in preout.shape[:-1]:
         n_rows *= d
-    return pk._on_tpu() and V >= 128 and n_rows * V >= (1 << 16)
+    return pk.xent_available() and V >= 128 and n_rows * V >= (1 << 16)
 
 
 def mcxent(labels, preout, activation="softmax", mask=None):

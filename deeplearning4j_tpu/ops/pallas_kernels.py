@@ -38,12 +38,38 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+# Runtime kill switches, PER KERNEL TIER: set by kernel_self_test() when
+# a Mosaic compile fails on the real chip, so one bad kernel degrades to
+# the dense XLA path without disabling the other, healthy one (the
+# cuDNN-helper-with-builtin-fallback pattern, ref
+# ConvolutionLayer.java:157-212).  DL4J_PALLAS=0 disables everything.
+_disabled: dict = {}  # tier ("flash" | "xent") -> reason
+
+
+def disable_kernels(reason: str, tier: Optional[str] = None) -> None:
+    for t in ((tier,) if tier else ("flash", "xent")):
+        _disabled[t] = reason
+
+
 def _on_tpu() -> bool:
     # Device-capability probe (ops/platform.py), not a backend-name match:
     # the bench chip registers via the experimental 'axon' PJRT plugin and
     # a string compare against "tpu" would force interpret-mode emulation.
+    import os
+    if os.environ.get("DL4J_PALLAS") == "0":
+        return False
     from deeplearning4j_tpu.ops import platform
     return platform.is_tpu()
+
+
+def flash_available() -> bool:
+    """Dispatch gate for callers of flash_attention (parallel/sequence)."""
+    return "flash" not in _disabled and _on_tpu()
+
+
+def xent_available() -> bool:
+    """Dispatch gate for callers of softmax_xent_rows (ops/losses)."""
+    return "xent" not in _disabled and _on_tpu()
 
 
 def _interpret() -> bool:
@@ -452,3 +478,68 @@ def _sxr_bwd(grad, g):
 
 
 softmax_xent_rows.defvjp(_sxr_fwd, _sxr_bwd)
+
+
+def kernel_self_test(disable_on_error: bool = True) -> dict:
+    """Compile+run each kernel once on small shapes through the REAL
+    dispatch path (interpret only off-TPU) and report per-kernel status.
+
+    Run this before anything perf-critical: the first Mosaic compile of
+    a kernel otherwise happens cold inside whatever model hits it first,
+    and a compile rejection there kills that whole run.  On error the
+    offending tier is disabled via :func:`disable_kernels`, so callers
+    (ops/losses.mcxent, parallel/sequence.dense_attention) silently fall
+    back to the dense XLA path.  Ref analog: ConvolutionLayer's
+    cuDNN-helper-try/builtin-fallback, ConvolutionLayer.java:67,157-212.
+    """
+    import numpy as _np
+    results = {}
+    # snapshot BEFORE any _try can flip a kill switch: the mode the tests
+    # actually ran under, not the post-disable state
+    interp = _interpret()
+
+    def _try(name, tier, fn):
+        try:
+            fn()
+            results[name] = "ok"
+        except Exception as e:  # Mosaic/XLA compile or runtime failure
+            results[name] = f"error: {type(e).__name__}: {e}"[:300]
+            if disable_on_error:
+                disable_kernels(f"{name} self-test failed: {e}", tier=tier)
+
+    rng = _np.random.default_rng(0)
+
+    def _flash():
+        B, H, T, D = 1, 2, 256, 64
+        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
+        km = jnp.ones((B, T), jnp.float32)
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, km, causal=True).sum()
+        out, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
+            q, k, v)
+        jax.block_until_ready(grads)
+        if not bool(jnp.isfinite(out)):
+            raise FloatingPointError("non-finite flash attention loss")
+
+    def _xent():
+        N, V = 256, 512
+        logits = jnp.asarray(rng.normal(size=(N, V)), jnp.float32)
+        labels = jnp.asarray(_np.eye(V, dtype=_np.float32)[
+            rng.integers(0, V, N)])
+
+        def loss(lg):
+            return softmax_xent_rows(lg, labels).mean()
+        out, g = jax.jit(jax.value_and_grad(loss))(logits)
+        jax.block_until_ready(g)
+        if not bool(jnp.isfinite(out)):
+            raise FloatingPointError("non-finite fused xent loss")
+
+    _try("flash_attention", "flash", _flash)
+    _try("softmax_xent", "xent", _xent)
+    results["interpret_mode"] = interp
+    if _disabled:
+        results["disabled"] = {t: r[:300] for t, r in _disabled.items()}
+    return results

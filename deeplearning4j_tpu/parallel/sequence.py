@@ -93,7 +93,7 @@ def dense_attention(q, k, v, *, causal: bool = False, key_mask=None,
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     if allow_flash and q.shape[2] == k.shape[2]:
         from deeplearning4j_tpu.ops import pallas_kernels as pk
-        if pk._on_tpu() and pk.flash_attention_supported(q):
+        if pk.flash_available() and pk.flash_attention_supported(q):
             km = (key_mask if key_mask is not None
                   else jnp.ones((q.shape[0], k.shape[2]), q.dtype))
             return pk.flash_attention(q, k, v, km.astype(q.dtype), causal,

@@ -265,3 +265,43 @@ def test_fused_softmax_xent_ragged_rows():
     np.testing.assert_allclose(np.asarray(loss),
                                np.asarray(-(y * logp).sum(-1)),
                                rtol=1e-5, atol=1e-5)
+
+
+class TestKernelSelfTest:
+    """Round-4 bench preflight: per-kernel compile check + per-tier kill
+    switch (the cuDNN-try/builtin-fallback pattern,
+    ref ConvolutionLayer.java:67,157-212)."""
+
+    def teardown_method(self):
+        pk._disabled.clear()
+
+    def test_self_test_ok(self):
+        st = pk.kernel_self_test()
+        assert st["flash_attention"] == "ok"
+        assert st["softmax_xent"] == "ok"
+        assert st["interpret_mode"] is True  # CPU test mesh
+        assert "disabled" not in st
+
+    def test_per_tier_disable(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU", "1")  # pretend we're on TPU
+        assert pk.flash_available() and pk.xent_available()
+        pk.disable_kernels("flash broke", tier="flash")
+        assert not pk.flash_available()
+        assert pk.xent_available()  # healthy tier stays enabled
+        pk.disable_kernels("all broke")
+        assert not pk.xent_available()
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU", "1")
+        monkeypatch.setenv("DL4J_PALLAS", "0")
+        assert not pk.flash_available() and not pk.xent_available()
+
+    def test_self_test_disables_on_error(self, monkeypatch):
+        # a kernel that dies at dispatch must flip ONLY its own tier
+        def boom(*a, **k):
+            raise RuntimeError("mosaic rejected")
+        monkeypatch.setattr(pk, "flash_attention", boom)
+        st = pk.kernel_self_test()
+        assert st["flash_attention"].startswith("error")
+        assert st["softmax_xent"] == "ok"
+        assert "flash" in st["disabled"] and "xent" not in st["disabled"]
