@@ -175,9 +175,12 @@ def bench_lenet_etl():
     from deeplearning4j_tpu.native import available as native_available
 
     BATCH = 256
-    cache = pathlib.Path(__file__).parent / ".bench_cache" / "lenet_etl"
-    cache.mkdir(parents=True, exist_ok=True)
     real_idx = (CACHE_DIR / "mnist").exists()
+    # cache keyed by data source: a run after the MNIST cache appears
+    # must not silently reuse synthetic shards under a "real" label
+    cache = (pathlib.Path(__file__).parent / ".bench_cache" /
+             f"lenet_etl_{'idx' if real_idx else 'synth'}")
+    cache.mkdir(parents=True, exist_ok=True)
     ds = load_mnist(train=True)
     n_shards = min(40, ds.features.shape[0] // BATCH)
     paths = [cache / f"shard_{i:03d}.npz" for i in range(n_shards)]
@@ -198,7 +201,12 @@ def bench_lenet_etl():
     net = lenet()
     net.conf.global_conf.precision = "bf16"
     net.init()
-    step = jax.jit(net._build_step_raw(), donate_argnums=(0, 1, 2))
+    first = np.load(paths[0])
+    step, flops = compiled_step(
+        net._build_step_raw(),
+        (net.net_params, net.net_state, net.opt_states,
+         jnp.asarray(first["features"]), jnp.asarray(first["labels"]),
+         None, None, jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0)))
     carry = [net.net_params, net.net_state, net.opt_states]
     key = jax.random.PRNGKey(0)
     it0 = jnp.asarray(0, jnp.int32)
@@ -242,6 +250,7 @@ def bench_lenet_etl():
         "data_source": "cached MNIST IDX" if real_idx
                        else "synthetic fallback (zero egress)",
         "n_shards": n_shards,
+        **({"flops_per_step": flops} if flops else {}),
         **st,
     }
 

@@ -258,6 +258,8 @@ class ComputationGraph:
                 score = self._assemble_training_score(
                     p, preouts, new_states, out_masks, ys, lmasks,
                     out_confs, out_pos)
+                if not g.minimize:
+                    score = -score  # maximize: parity with the MLN step
                 return score, new_states
 
             (score, new_states), grads = jax.value_and_grad(
@@ -788,19 +790,27 @@ class ComputationGraph:
         if self._ext_grad_fn is None:
             self._ext_grad_fn = {}
         if train not in self._ext_grad_fn:
+            policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
             def ext_grad(params, state, xs, eps, ms, rng, _train=train):
                 def fwd(p, xs_):
-                    ins = dict(zip(self.conf.network_inputs, xs_))
-                    mdict = dict(zip(self.conf.network_inputs, ms)) \
-                        if ms is not None else {}
+                    # same precision-policy cast as the fused step /
+                    # output(): under bf16 the VJP differentiates the
+                    # forward the caller actually saw, and grads come
+                    # back in the f32 master-param dtype
+                    pc = policy.cast_to_compute(p)
+                    xs_c, ms_c = policy.cast_to_compute((xs_, ms))
+                    ins = dict(zip(self.conf.network_inputs, xs_c))
+                    mdict = dict(zip(self.conf.network_inputs, ms_c)) \
+                        if ms_c is not None else {}
                     acts, _, ns, _ = self._forward_all(
-                        p, state, ins, mdict, _train, rng)
+                        pc, state, ins, mdict, _train, rng)
                     return tuple(acts[n]
                                  for n in self.conf.network_outputs), ns
                 outs, vjp, ns = jax.vjp(fwd, params, xs, has_aux=True)
                 cot = tuple(e.astype(o.dtype) for e, o in zip(eps, outs))
                 g, dxs = vjp(cot)
-                return g, dxs, ns
+                return g, dxs, policy.cast_to_param(ns)
             self._ext_grad_fn[train] = jax.jit(ext_grad)
         if train:
             self._key, sub = jax.random.split(self._key)
@@ -818,14 +828,24 @@ class ComputationGraph:
     def apply_gradients(self, grads):
         """Apply externally computed vertex gradients through the
         configured updaters — one jitted step (see
-        MultiLayerNetwork.apply_gradients)."""
+        MultiLayerNetwork.apply_gradients: l1/l2 regularization gradients
+        are added here and ``minimize=False`` negates, matching fit())."""
         if self.net_params is None:
             self.init()
         self._check_trace_token()
         if self._apply_fn is None:
-            self._apply_fn = jax.jit(
-                lambda p, o, g, it: self._apply_updates(p, o, g, it),
-                donate_argnums=(0, 1))
+            g_conf = self.conf.global_conf
+
+            def apply(p, o, gr, it):
+                reg = jax.grad(
+                    lambda p_: jnp.asarray(self._reg_penalty(p_),
+                                           jnp.float32))(p)
+                gr = jax.tree_util.tree_map(jnp.add, gr, reg)
+                if not g_conf.minimize:
+                    gr = jax.tree_util.tree_map(jnp.negative, gr)
+                return self._apply_updates(p, o, gr, it)
+
+            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
         self.net_params, self.opt_states = self._apply_fn(
             self.net_params, self.opt_states, grads,
             jnp.asarray(self.iteration, jnp.int32))

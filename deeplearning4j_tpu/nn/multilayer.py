@@ -705,13 +705,21 @@ class MultiLayerNetwork:
         if self._ext_grad_fn is None:
             self._ext_grad_fn = {}
         if train not in self._ext_grad_fn:
+            policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
             def ext_grad(params, state, xi, eps, m, rng, _train=train):
                 def fwd(p, xin):
-                    out, ns, _ = self._forward(p, state, xin, m, _train, rng)
+                    # cast through the precision policy exactly like
+                    # _build_output_fn: under bf16 the VJP must
+                    # differentiate the same forward output() ran, and
+                    # grads come back in the f32 master-param dtype
+                    pc, xc, mc = policy.cast_to_compute((p, xin, m))
+                    out, ns, _ = self._forward(pc, state, xc, mc, _train,
+                                               rng)
                     return out, ns
                 out, vjp, ns = jax.vjp(fwd, params, xi, has_aux=True)
                 g, dx = vjp(eps.astype(out.dtype))
-                return g, dx, ns
+                return g, dx, policy.cast_to_param(ns)
             self._ext_grad_fn[train] = jax.jit(ext_grad)
         if train:
             self._key, sub = jax.random.split(self._key)
@@ -730,14 +738,29 @@ class MultiLayerNetwork:
         """Apply externally computed per-layer gradients through the
         configured updaters (normalization, LR schedule, learning rule,
         frozen gating) — one jitted step.  Completes the external-errors
-        training loop started by :meth:`backprop_gradient`."""
+        training loop started by :meth:`backprop_gradient`.
+
+        The l1/l2 regularization gradient is added here, matching the
+        fused fit step's in-loss penalty (reference analog:
+        UpdaterBlock.postApply applies l1/l2 updater-side so externally
+        driven training still decays weights); ``minimize=False`` negates
+        like fit() does, so callers always pass plain dL/dparam."""
         if self.net_params is None:
             self.init()
         self._check_trace_token()
         if self._apply_fn is None:
-            self._apply_fn = jax.jit(
-                lambda p, o, g, it: self._apply_updates(p, o, g, it),
-                donate_argnums=(0, 1))
+            g_conf = self.conf.global_conf
+
+            def apply(p, o, gr, it):
+                reg = jax.grad(
+                    lambda p_: jnp.asarray(self._reg_penalty(p_),
+                                           jnp.float32))(p)
+                gr = jax.tree_util.tree_map(jnp.add, gr, reg)
+                if not g_conf.minimize:
+                    gr = jax.tree_util.tree_map(jnp.negative, gr)
+                return self._apply_updates(p, o, gr, it)
+
+            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
         self.net_params, self.opt_states = self._apply_fn(
             self.net_params, self.opt_states, grads,
             jnp.asarray(self.iteration, jnp.int32))

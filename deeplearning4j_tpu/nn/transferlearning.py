@@ -110,14 +110,28 @@ class TransferLearningBuilder:
         if self._fine_tune:
             g = self._fine_tune.apply_to_global(g)
 
+        def _replace_unwrapped(lc, **changes):
+            # chained transfer learning: the layer may already be a
+            # FrozenLayerConf wrapper (no n_out/n_in field) — edit the
+            # inner conf and re-wrap so frozen status survives the edit
+            if isinstance(lc, FrozenLayerConf):
+                return FrozenLayerConf.wrap(
+                    dataclasses.replace(lc._inner(), **changes))
+            return dataclasses.replace(lc, **changes)
+
         reinit: set = set()
         for idx, (n_out, winit) in self._n_out_replace.items():
-            layers[idx] = dataclasses.replace(layers[idx], n_out=n_out,
-                                              **({"weight_init": winit} if winit else {}))
+            layers[idx] = _replace_unwrapped(
+                layers[idx], n_out=n_out,
+                **({"weight_init": winit} if winit else {}))
             reinit.add(idx)
-            if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
-                layers[idx + 1] = dataclasses.replace(layers[idx + 1], n_out=getattr(layers[idx + 1], "n_out"), n_in=n_out)
-                reinit.add(idx + 1)
+            if idx + 1 < len(layers):
+                nxt = layers[idx + 1]
+                inner = nxt._inner() if isinstance(nxt, FrozenLayerConf) \
+                    else nxt
+                if hasattr(inner, "n_in"):
+                    layers[idx + 1] = _replace_unwrapped(nxt, n_in=n_out)
+                    reinit.add(idx + 1)
 
         for layer in self._added:
             layers.append(merge_layer_conf(layer, g))
@@ -241,19 +255,30 @@ class TransferLearningGraphBuilder:
         if self._fine_tune:
             g = self._fine_tune.apply_to_global(g)
 
+        def _replace_unwrapped(lc, **changes):
+            # chained transfer learning hands us vertices that are already
+            # FrozenLayerConf wrappers (no n_out/n_in field) — edit the
+            # inner conf and re-wrap so frozen status survives the edit
+            if isinstance(lc, FrozenLayerConf):
+                return FrozenLayerConf.wrap(
+                    dc.replace(lc._inner(), **changes))
+            return dc.replace(lc, **changes)
+
         for name, (n_out, winit) in self._n_out_replace.items():
             lv = vertices[name]
-            lc = lv.layer_conf()
-            lc = dc.replace(lc, n_out=n_out,
-                            **({"weight_init": winit} if winit else {}))
+            lc = _replace_unwrapped(
+                lv.layer_conf(), n_out=n_out,
+                **({"weight_init": winit} if winit else {}))
             vertices[name] = LayerVertex(layer=lc.to_dict())
             reinit.add(name)
             for k, ins in vertex_inputs.items():
                 if name in ins and isinstance(vertices.get(k), LayerVertex):
                     dlc = vertices[k].layer_conf()
-                    if getattr(dlc, "n_in", None):
-                        vertices[k] = LayerVertex(
-                            layer=dc.replace(dlc, n_in=n_out).to_dict())
+                    inner = dlc._inner() if isinstance(dlc, FrozenLayerConf) \
+                        else dlc
+                    if getattr(inner, "n_in", None):
+                        vertices[k] = LayerVertex(layer=_replace_unwrapped(
+                            dlc, n_in=n_out).to_dict())
                         reinit.add(k)
 
         for name, v, ins in self._added:

@@ -99,3 +99,45 @@ class TestTransferLearning:
         top = helper.unfrozen_network()
         out = top.output(feat.features[:4])
         assert out.shape == (4, 3)
+
+
+class TestChainedTransferMLN:
+    def test_n_out_replace_on_frozen_layer(self):
+        """Second transfer pass sees FrozenLayerConf layers (no n_out
+        field): n_out_replace must unwrap/edit/re-wrap, and a frozen NEXT
+        layer must still get its n_in rewired (round-4 review finding)."""
+        data = _iris_data()
+        src = _net()
+        src.fit(ListDataSetIterator(data, 50), epochs=2)
+        t1 = (TransferLearning.Builder(src)
+              .set_feature_extractor(1)   # freezes layers 0 and 1
+              .build())
+        assert isinstance(t1.layers[1], FrozenLayerConf)
+
+        # replace n_out of frozen layer 1; frozen?  layer 2 is unfrozen
+        t2 = (TransferLearning.Builder(t1)
+              .n_out_replace(1, 12)
+              .build())
+        lc = t2.layers[1]
+        assert isinstance(lc, FrozenLayerConf)   # stays frozen
+        assert lc._inner().n_out == 12
+        assert t2.net_params[1]["W"].shape[-1] == 12
+        assert t2.layers[2].n_in == 12           # consumer rewired
+        t2.fit(ListDataSetIterator(data, 50), epochs=1)
+
+    def test_n_out_replace_with_frozen_consumer(self):
+        data = _iris_data()
+        src = _net()
+        src.fit(ListDataSetIterator(data, 50), epochs=1)
+        t1 = (TransferLearning.Builder(src)
+              .set_feature_extractor(1)
+              .build())
+        # replace n_out of frozen layer 0 — frozen layer 1 consumes it
+        t2 = (TransferLearning.Builder(t1)
+              .n_out_replace(0, 9)
+              .build())
+        nxt = t2.layers[1]
+        assert isinstance(nxt, FrozenLayerConf)
+        assert nxt._inner().n_in == 9
+        assert t2.net_params[1]["W"].shape[0] == 9
+        t2.fit(ListDataSetIterator(data, 50), epochs=1)

@@ -163,3 +163,114 @@ class TestCGExternalGradients:
         for name in ("in", "h", "o1", "o2"):
             assert name in s
         assert "Outputs: o1, o2" in s
+
+
+def reg_mlp(minimize=True):
+    """MLP with l1/l2 set — the external loop must include the penalty
+    gradient apply_gradients adds (round-3 advisor: reference analog is
+    UpdaterBlock.postApply applying l1/l2 updater-side)."""
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(7).learning_rate(0.1).updater("sgd")
+         .l2(0.02).l1(0.005)
+         .minimize(minimize)
+         .list()
+         .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_out=3, activation="identity", loss="mse"))
+         .build())).init()
+
+
+class TestExternalGradientsRegularization:
+    def _external_equals_fit(self, minimize):
+        a = reg_mlp(minimize)
+        b = reg_mlp(minimize)
+        b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(6, 5)).astype(np.float32)
+        y = rng.normal(size=(6, 3)).astype(np.float32)
+
+        a.fit(x, y)
+
+        out = np.asarray(b.output(x))
+        # caller convention: plain dLoss/dOut of the (positive) score —
+        # apply_gradients adds the l1/l2 term and handles minimize
+        eps = 2.0 * (out - y) / (x.shape[0] * y.shape[1])
+        grads, _ = b.backprop_gradient(x, eps)
+        b.apply_gradients(grads)
+
+        for pa, pb in zip(a.net_params, b.net_params):
+            for k in pa:
+                np.testing.assert_allclose(pa[k], pb[k], rtol=1e-4,
+                                           atol=1e-5)
+
+    def test_l1_l2_included(self):
+        self._external_equals_fit(minimize=True)
+
+    def test_maximize_negates_like_fit(self):
+        self._external_equals_fit(minimize=False)
+
+    def test_graph_l1_l2_and_maximize(self):
+        from deeplearning4j_tpu.nn.conf.network import GlobalConf
+        for minimize in (True, False):
+            def build():
+                conf = (GraphBuilder(GlobalConf(
+                            seed=3, learning_rate=0.05, updater="sgd",
+                            l2=0.03, minimize=minimize))
+                        .add_inputs("in")
+                        .add_layer("h", DenseLayer(n_in=4, n_out=6,
+                                                   activation="tanh"), "in")
+                        .add_layer("o", OutputLayer(n_out=2,
+                                                    activation="identity",
+                                                    loss="mse"), "h")
+                        .set_outputs("o")
+                        .build())
+                return ComputationGraph(conf).init()
+            a, b = build(), build()
+            b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+            rng = np.random.default_rng(13)
+            x = rng.normal(size=(5, 4)).astype(np.float32)
+            y = rng.normal(size=(5, 2)).astype(np.float32)
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            a.fit(DataSet(x, y))
+            out = np.asarray(b.output(x)[0])
+            eps = 2.0 * (out - y) / (x.shape[0] * y.shape[1])
+            grads, _ = b.backprop_gradient([x], [eps])
+            b.apply_gradients(grads)
+            for name in a.net_params:
+                for k in a.net_params[name]:
+                    np.testing.assert_allclose(
+                        a.net_params[name][k], b.net_params[name][k],
+                        rtol=1e-4, atol=1e-5, err_msg=f"minimize={minimize}")
+
+
+class TestExternalGradientsPrecision:
+    def test_bf16_policy_grads_match_bf16_forward(self):
+        """Under a bf16 policy the VJP must differentiate the SAME cast
+        forward output() ran (round-3 advisor low #2)."""
+        net = small_mlp()
+        net.conf.global_conf.precision = "bf16"
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        eps = rng.normal(size=(4, 3)).astype(np.float32)
+        grads, dx = net.backprop_gradient(x, eps)
+        # grads stay in the f32 master dtype
+        for g in grads:
+            for k in g:
+                assert g[k].dtype == jnp.float32
+        from deeplearning4j_tpu.ops import dtypes as dtype_ops
+        policy = dtype_ops.resolve("bf16")
+
+        def loss(p, xi):
+            pc, xc = policy.cast_to_compute((p, xi))
+            out, _, _ = net._forward(pc, net.net_state, xc, None, True,
+                                     jax.random.PRNGKey(0))
+            return jnp.sum(out * eps.astype(out.dtype))
+
+        # jit the reference too: un-jitted XLA:CPU keeps bf16 chains in
+        # f32 registers, so only jit-vs-jit is exactly comparable
+        want_p, want_x = jax.jit(jax.grad(loss, argnums=(0, 1)))(
+            net.net_params, jnp.asarray(x))
+        for g, w in zip(grads, want_p):
+            for k in w:
+                np.testing.assert_allclose(g[k], w[k], rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(dx, want_x, rtol=1e-5, atol=1e-6)

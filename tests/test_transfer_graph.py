@@ -126,3 +126,57 @@ def test_graph_builder_remove_with_live_consumer_raises():
         assert "head" in str(e)
     else:
         raise AssertionError("expected ValueError for dangling consumer")
+
+
+def test_chained_transfer_n_out_replace_on_frozen_vertex():
+    """Round-3 advisor low #4: a second transfer pass sees vertices whose
+    confs are already FrozenLayerConf (no n_out field) — n_out_replace
+    must unwrap, edit the inner conf, and re-wrap (frozen survives)."""
+    src = base_graph()
+    x, y = _data()
+    src.fit(x, y)
+
+    # first transfer: freeze feat+head
+    t1 = (TransferLearning.GraphBuilder(src)
+          .set_feature_extractor("head")
+          .build())
+    assert isinstance(t1.conf.vertices["head"].layer_conf(), FrozenLayerConf)
+
+    # second transfer on the already-frozen net: replace n_out of the
+    # frozen 'head' — previously raised TypeError in dataclasses.replace
+    t2 = (TransferLearning.GraphBuilder(t1)
+          .n_out_replace("head", 10)
+          .build())
+    hc = t2.conf.vertices["head"].layer_conf()
+    assert isinstance(hc, FrozenLayerConf)       # frozen status preserved
+    assert hc._inner().n_out == 10
+    assert t2.net_params["head"]["W"].shape == (8, 10)
+    # downstream 'out' consumer was rewired (n_in follows the new n_out)
+    oc = t2.conf.vertices["out"].layer_conf()
+    oinner = oc._inner() if isinstance(oc, FrozenLayerConf) else oc
+    assert oinner.n_in == 10
+    # and the rebuilt net still trains end-to-end
+    t2.fit(x, y)
+    assert np.isfinite(float(t2.score()))
+
+
+def test_chained_transfer_frozen_downstream_consumer_rewired():
+    """n_out_replace on an UNFROZEN vertex whose consumer is frozen: the
+    frozen consumer's inner n_in must be rewired without unwrapping it
+    permanently."""
+    src = base_graph()
+    x, y = _data()
+    src.fit(x, y)
+    t1 = (TransferLearning.GraphBuilder(src)
+          .set_feature_extractor("head")   # freezes head + feat
+          .build())
+    # replace n_out of frozen 'feat'; frozen 'head' consumes it
+    t2 = (TransferLearning.GraphBuilder(t1)
+          .n_out_replace("feat", 12)
+          .build())
+    hc = t2.conf.vertices["head"].layer_conf()
+    assert isinstance(hc, FrozenLayerConf)
+    assert hc._inner().n_in == 12
+    assert t2.net_params["head"]["W"].shape == (12, 6)
+    t2.fit(x, y)
+    assert np.isfinite(float(t2.score()))
