@@ -1,0 +1,508 @@
+"""Migrate checkpoints written by the ORIGINAL DL4J (0.x Java) into this
+framework — the interop half of checkpoint parity: `nn/serialization.py`
+round-trips this framework's own zips; this module reads the reference's.
+
+Format (ref: util/ModelSerializer.java:79-120): a zip with
+``configuration.json`` (Jackson MultiLayerConfiguration, wrapper-object
+typed layers — nn/conf/layers/Layer.java:47 @JsonTypeInfo WRAPPER_OBJECT),
+``coefficients.bin`` (legacy ``Nd4j.write``: shapeInfo DataBuffer then data
+DataBuffer, each ``writeUTF(allocationMode) writeInt(length)
+writeUTF(dtype) big-endian elements``), and optionally
+``updaterState.bin``.
+
+Parameter layout (ref: nn/params/DefaultParamInitializer.java:60-99): the
+flat params row is the per-layer concatenation, each layer contributing
+its views in initializer order — Dense/Output/Embedding: W [nIn,nOut]
+then b, **'f' (column-major) flattened** (weights/WeightInitUtil.java:40
+DEFAULT_WEIGHT_INIT_ORDER='f'); Convolution: W [nOut,nIn,kH,kW] then b
+(nn/params/ConvolutionParamInitializer.java); BatchNorm: gamma, beta,
+mean, var (nn/params/BatchNormalizationParamInitializer.java:59-80);
+GravesLSTM: W [nIn,4H], RW [H,4H+3] (last 3 cols = peepholes wFF, wOO,
+wGG), b [4H], gate order IFOG
+(nn/params/GravesLSTMParamInitializer.java:60-148,
+nn/layers/recurrent/LSTMHelpers.java:62).
+
+Peephole caveat: DL4J applies its third peephole (wGG) to the *input
+modulation* gate (LSTMHelpers.java:202-209); this framework's cell
+applies pI to the *input* gate (ops/recurrent.py).  wFF→pF and wOO→pO map
+exactly; wGG→pI is the closest slot and is documented divergence —
+migrated LSTM nets match DL4J only when peephole weights are zero (their
+init value).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import struct
+import zipfile
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf import preprocessors as pp
+from deeplearning4j_tpu.nn.conf.network import (GlobalConf,
+                                                MultiLayerConfiguration)
+
+# ---------------------------------------------------------------------------
+# Legacy Nd4j binary format
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"FLOAT": ("f", 4, np.float32), "DOUBLE": ("d", 8, np.float64),
+           "INT": ("i", 4, np.int32), "LONG": ("q", 8, np.int64),
+           "HALF": ("e", 2, np.float16)}
+
+
+def _read_utf(stream: BinaryIO) -> str:
+    (n,) = struct.unpack(">H", stream.read(2))
+    return stream.read(n).decode("utf-8")
+
+
+def _write_utf(stream: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    stream.write(struct.pack(">H", len(b)))
+    stream.write(b)
+
+
+def read_data_buffer(stream: BinaryIO) -> np.ndarray:
+    """One legacy DataBuffer: UTF allocation mode, int32 length, UTF
+    element type, big-endian elements (BaseDataBuffer.write)."""
+    _alloc = _read_utf(stream)  # HEAP/DIRECT/JAVACPP — irrelevant here
+    (length,) = struct.unpack(">i", stream.read(4))
+    dtype = _read_utf(stream)
+    if dtype not in _DTYPES:
+        raise ValueError(f"unknown nd4j DataBuffer element type {dtype!r}")
+    _, size, np_t = _DTYPES[dtype]
+    raw = stream.read(length * size)
+    if len(raw) != length * size:
+        raise ValueError("truncated nd4j DataBuffer")
+    return np.frombuffer(raw, dtype=np.dtype(np_t).newbyteorder(">")).astype(
+        np_t)
+
+
+def write_data_buffer(stream: BinaryIO, arr: np.ndarray,
+                      dtype: str = "FLOAT") -> None:
+    _write_utf(stream, "HEAP")
+    stream.write(struct.pack(">i", arr.size))
+    _write_utf(stream, dtype)
+    _, _, np_t = _DTYPES[dtype]
+    stream.write(np.ascontiguousarray(arr, np_t).astype(
+        np.dtype(np_t).newbyteorder(">")).tobytes())
+
+
+def read_nd4j_array(stream: BinaryIO) -> np.ndarray:
+    """Legacy ``Nd4j.write``: shapeInfo buffer then data buffer.
+    shapeInfo layout: [rank, shape..., stride..., offset,
+    elementWiseStride, order-char]."""
+    shape_info = read_data_buffer(stream).astype(np.int64)
+    rank = int(shape_info[0])
+    shape = tuple(int(d) for d in shape_info[1:1 + rank])
+    order = chr(int(shape_info[-1]))
+    data = read_data_buffer(stream)
+    n = int(np.prod(shape)) if shape else data.size
+    return np.reshape(data[:n], shape,
+                      order="F" if order == "f" else "C")
+
+
+def write_nd4j_array(stream: BinaryIO, arr: np.ndarray,
+                     order: str = "f") -> None:
+    """Inverse of :func:`read_nd4j_array` — used to author DL4J-schema
+    fixtures (and to export params a Java DL4J could read back)."""
+    arr = np.asarray(arr)
+    rank = arr.ndim
+    shape = list(arr.shape)
+    # strides in elements for the chosen order ('f' matches DL4J params)
+    strides = [0] * rank
+    acc = 1
+    idx = range(rank) if order == "f" else range(rank - 1, -1, -1)
+    for i in idx:
+        strides[i] = acc
+        acc *= shape[i]
+    info = [rank] + shape + strides + [0, 1, ord(order)]
+    write_data_buffer(stream, np.asarray(info, np.int32), "INT")
+    flat = np.ravel(arr, order="F" if order == "f" else "C")
+    write_data_buffer(stream, flat,
+                      "DOUBLE" if arr.dtype == np.float64 else "FLOAT")
+
+
+# ---------------------------------------------------------------------------
+# configuration.json → builder-DSL confs
+# ---------------------------------------------------------------------------
+
+_ACT_NAMES = sorted(
+    ["rationaltanh", "rectifiedtanh", "hardsigmoid", "hardtanh",
+     "leakyrelu", "softmax", "softplus", "softsign", "sigmoid",
+     "identity", "linear", "relu", "tanh", "cube", "elu", "selu",
+     "gelu", "swish"],
+    key=len, reverse=True)  # longest first: "selu"/"gelu" before "elu"
+
+
+def _parse_activation(v, default: str = "sigmoid") -> str:
+    """activationFn appears as a legacy string ("relu"), a wrapper object
+    ({"ReLU": {}} / {".ActivationReLU": {}}), or an @class map."""
+    if v is None:
+        return default
+    if isinstance(v, dict):
+        if "@class" in v:
+            v = v["@class"]
+        else:
+            v = next(iter(v), "")
+    s = str(v).lower()
+    for name in _ACT_NAMES:   # longest/most-specific first in list order
+        if name in s:
+            return "identity" if name == "linear" else name
+    return default
+
+
+_LOSS_MAP = {"mcxent": "mcxent", "negativeloglikelihood": "mcxent",
+             "xent": "xent", "mse": "mse", "l2": "l2", "l1": "l1",
+             "mae": "mae", "squared_loss": "mse", "cosine": "mse"}
+
+
+def _parse_loss(layer_json: dict, default: str = "mse") -> str:
+    v = layer_json.get("lossFn", layer_json.get("lossFunction"))
+    if v is None:
+        return default
+    if isinstance(v, dict):
+        v = v.get("@class") or next(iter(v), "")
+    s = str(v).lower().replace("loss", "")
+    for k, ours in _LOSS_MAP.items():
+        if k in s:
+            return ours
+    return default
+
+
+def _num(v, default=0.0) -> float:
+    """Jackson writes unset doubles as NaN (l1/l2 default NaN in
+    nn/conf/layers/Layer.java) — treat NaN/None as unset."""
+    if v is None:
+        return default
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return default
+    return default if math.isnan(f) else f
+
+
+def _ints(v, default=(0, 0)) -> Tuple[int, ...]:
+    if v is None:
+        return tuple(default)
+    if isinstance(v, (int, float)):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)
+
+
+def _common_kwargs(j: dict) -> dict:
+    kw = {}
+    if j.get("nIn"):
+        kw["n_in"] = int(j["nIn"])
+    if j.get("nOut"):
+        kw["n_out"] = int(j["nOut"])
+    kw["activation"] = _parse_activation(
+        j.get("activationFn", j.get("activationFunction")))
+    for src, dst in (("l1", "l1"), ("l2", "l2"), ("l1Bias", "l1_bias"),
+                     ("l2Bias", "l2_bias")):
+        x = _num(j.get(src))
+        if x:
+            kw[dst] = x
+    d = _num(j.get("dropOut"))
+    if d:
+        kw["dropout"] = d
+    wi = j.get("weightInit")
+    if wi:
+        kw["weight_init"] = str(wi).lower()
+    lr = _num(j.get("learningRate"))
+    if lr:
+        kw["learning_rate"] = lr
+    blr = _num(j.get("biasLearningRate"))
+    if blr:
+        kw["bias_learning_rate"] = blr
+    upd = j.get("updater")
+    if upd:
+        kw["updater"] = _UPDATER_MAP.get(str(upd).lower(), "sgd")
+    for src, dst in (("momentum", "momentum"), ("rho", "rho"),
+                     ("rmsDecay", "rms_decay"),
+                     ("adamMeanDecay", "adam_mean_decay"),
+                     ("adamVarDecay", "adam_var_decay"),
+                     ("epsilon", "epsilon"), ("biasInit", "bias_init")):
+        x = _num(j.get(src))
+        if x:
+            kw[dst] = x
+    gn = j.get("gradientNormalization")
+    if gn and str(gn) != "None":
+        kw["gradient_normalization"] = str(gn).lower()
+        t = _num(j.get("gradientNormalizationThreshold"))
+        if t:
+            kw["gradient_normalization_threshold"] = t
+    return kw
+
+
+def _build_layer(type_name: str, j: dict) -> L.Layer:
+    kw = _common_kwargs(j)
+    t = type_name
+    if t == "dense":
+        return L.DenseLayer(**kw)
+    if t == "output":
+        return L.OutputLayer(loss=_parse_loss(j), **kw)
+    if t == "rnnoutput":
+        return L.RnnOutputLayer(loss=_parse_loss(j), **kw)
+    if t == "loss":
+        kw.pop("n_in", None), kw.pop("n_out", None)
+        return L.LossLayer(loss=_parse_loss(j), **kw)
+    if t == "convolution":
+        return L.ConvolutionLayer(
+            kernel=_ints(j.get("kernelSize"), (3, 3)),
+            stride=_ints(j.get("stride"), (1, 1)),
+            padding=_ints(j.get("padding"), (0, 0)),
+            convolution_mode=str(j.get("convolutionMode",
+                                       "truncate")).lower(), **kw)
+    if t == "subsampling":
+        kw.pop("activation", None)
+        kw.pop("n_in", None), kw.pop("n_out", None)
+        return L.SubsamplingLayer(
+            pooling_type=str(j.get("poolingType", "max")).lower(),
+            kernel=_ints(j.get("kernelSize"), (2, 2)),
+            stride=_ints(j.get("stride"), (2, 2)),
+            padding=_ints(j.get("padding"), (0, 0)), **kw)
+    if t == "batchNormalization":
+        kw.pop("n_in", None)
+        n_out = kw.pop("n_out", None)
+        return L.BatchNormalization(
+            decay=_num(j.get("decay"), 0.9), eps=_num(j.get("eps"), 1e-5),
+            lock_gamma_beta=bool(j.get("lockGammaBeta", False)),
+            n_features=n_out, **kw)
+    if t == "gravesLSTM":
+        kw.setdefault("activation", "tanh")
+        return L.GravesLSTM(
+            forget_gate_bias_init=_num(j.get("forgetGateBiasInit"), 1.0),
+            gate_activation=_parse_activation(j.get("gateActivationFn"),
+                                              "sigmoid"), **kw)
+    if t == "embedding":
+        return L.EmbeddingLayer(**kw)
+    if t == "activation":
+        kw.pop("n_in", None), kw.pop("n_out", None)
+        return L.ActivationLayer(**kw)
+    if t == "dropout":
+        kw.pop("n_in", None), kw.pop("n_out", None)
+        return L.DropoutLayer(**kw)
+    if t == "GlobalPooling":
+        kw.pop("activation", None)
+        kw.pop("n_in", None), kw.pop("n_out", None)
+        return L.GlobalPoolingLayer(
+            pooling_type=str(j.get("poolingType", "max")).lower(), **kw)
+    if t == "zeroPadding":
+        kw.pop("activation", None)
+        pad = j.get("padding", [0, 0, 0, 0])
+        return L.ZeroPaddingLayer(padding=tuple(int(x) for x in pad))
+    raise ValueError(f"DL4J layer type {type_name!r} has no migration "
+                     f"mapping yet")
+
+
+_PREPROC_MAP = {
+    "cnnToFeedForward": lambda j: pp.CnnToFeedForwardPreProcessor(
+        height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
+        channels=int(j.get("numChannels", 0))),
+    "feedForwardToCnn": lambda j: pp.FeedForwardToCnnPreProcessor(
+        height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
+        channels=int(j.get("numChannels", 0))),
+    "rnnToFeedForward": lambda j: pp.RnnToFeedForwardPreProcessor(),
+    "feedForwardToRnn": lambda j: pp.FeedForwardToRnnPreProcessor(),
+    "rnnToCnn": lambda j: pp.RnnToCnnPreProcessor(
+        height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
+        channels=int(j.get("numChannels", 0))),
+    "cnnToRnn": lambda j: pp.CnnToRnnPreProcessor(
+        height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
+        channels=int(j.get("numChannels", 0))),
+}
+
+
+_UPDATER_MAP = {"nesterovs": "nesterovs", "sgd": "sgd", "adam": "adam",
+                "adamax": "adamax", "adagrad": "adagrad",
+                "adadelta": "adadelta", "rmsprop": "rmsprop",
+                "none": "none"}
+
+
+def config_from_dl4j_json(text: str) -> MultiLayerConfiguration:
+    """Jackson MultiLayerConfiguration JSON → our builder-DSL conf
+    (schema: nn/conf/MultiLayerConfiguration.java:59-74 — confs[],
+    inputPreProcessors, backprop/pretrain, backpropType, tbptt lengths)."""
+    top = json.loads(text)
+    confs = top.get("confs", [])
+    if not confs:
+        raise ValueError("configuration.json has no 'confs' — not a "
+                         "MultiLayerConfiguration (ComputationGraph "
+                         "migration is not supported yet)")
+
+    layers: List[L.Layer] = []
+    g = GlobalConf()
+    for i, c in enumerate(confs):
+        lw = c.get("layer", {})
+        if not isinstance(lw, dict) or len(lw) != 1:
+            raise ValueError(f"conf {i}: expected wrapper-object layer, "
+                             f"got {type(lw).__name__}")
+        (tname, lj), = lw.items()
+        layers.append(_build_layer(tname, lj))
+        if i == 0:
+            g.seed = int(c.get("seed", 0) or 0)
+            g.minimize = bool(c.get("minimize", True))
+            g.mini_batch = bool(c.get("miniBatch", True))
+            g.use_regularization = bool(c.get("useRegularization", False))
+            lr = _num(lj.get("learningRate"))
+            if lr:
+                g.learning_rate = lr
+            upd = str(lj.get("updater", "sgd")).lower()
+            g.updater = _UPDATER_MAP.get(upd, "sgd")
+            mom = _num(lj.get("momentum"))
+            if mom:
+                g.momentum = mom
+
+    # global-then-override merge (nn/conf/network.merge_layer_conf):
+    # fills unset updater/momentum/etc from the global conf and zeroes
+    # l1/l2 when useRegularization=false — without this, migrated nets
+    # would fine-tune with plain SGD regardless of the saved updater
+    from deeplearning4j_tpu.nn.conf.network import merge_layer_conf
+    layers = [merge_layer_conf(l, g) for l in layers]
+
+    preprocs = {}
+    for k, v in (top.get("inputPreProcessors") or {}).items():
+        if isinstance(v, dict) and len(v) == 1:
+            (pname, pj), = v.items()
+            if pname in _PREPROC_MAP:
+                preprocs[int(k)] = _PREPROC_MAP[pname](pj)
+
+    return MultiLayerConfiguration(
+        layers=layers, global_conf=g, preprocessors=preprocs,
+        backprop=bool(top.get("backprop", True)),
+        pretrain=bool(top.get("pretrain", False)),
+        backprop_type=("truncatedbptt"
+                       if str(top.get("backpropType", "")).lower()
+                       .startswith("truncated") else "standard"),
+        tbptt_fwd_length=int(top.get("tbpttFwdLength", 20)),
+        tbptt_back_length=int(top.get("tbpttBackLength", 20)))
+
+
+# ---------------------------------------------------------------------------
+# coefficients.bin → per-layer param dicts
+# ---------------------------------------------------------------------------
+
+def _layer_param_spec(layer: L.Layer):
+    """[(name, shape, n)] in DL4J view order, or [] for no-param layers.
+    Shapes are DL4J's; 'f'-order reshape recovers the matrices."""
+    if isinstance(layer, L.ConvolutionLayer):
+        n_in, n_out = layer.n_in, layer.n_out
+        kh, kw = layer.kernel
+        return [("W", (n_out, n_in, kh, kw), n_out * n_in * kh * kw),
+                ("b", (n_out,), n_out)]
+    if isinstance(layer, L.BatchNormalization):
+        n = layer.n_features
+        spec = [] if layer.lock_gamma_beta else [("gamma", (n,), n),
+                                                 ("beta", (n,), n)]
+        return spec + [("mean", (n,), n), ("var", (n,), n)]
+    if isinstance(layer, L.GravesLSTM):
+        n_in, H = layer.n_in, layer.n_out
+        return [("W", (n_in, 4 * H), n_in * 4 * H),
+                ("RW+p", (H, 4 * H + 3), H * (4 * H + 3)),
+                ("b", (4 * H,), 4 * H)]
+    if layer.has_params():   # dense/output/rnnoutput/embedding family
+        n_in, n_out = layer.n_in, layer.n_out
+        return [("W", (n_in, n_out), n_in * n_out), ("b", (n_out,), n_out)]
+    return []
+
+
+def params_from_flat(layers: List[L.Layer],
+                     flat: np.ndarray) -> Tuple[List[Dict], List[Dict]]:
+    """Replay DefaultParamInitializer's flattening: slice the flat row
+    per layer/view, 'f'-order reshape.  Returns (params, states) in this
+    framework's conventions (BN mean/var live in state, not params)."""
+    params, states = [], []
+    off = 0
+    for i, layer in enumerate(layers):
+        spec = _layer_param_spec(layer)
+        lp, ls = {}, {}
+        for name, shape, n in spec:
+            if off + n > flat.size:
+                raise ValueError(
+                    f"coefficients.bin too short at layer {i} ({name}): "
+                    f"need {off + n}, have {flat.size}")
+            view = flat[off:off + n]
+            off += n
+            if name == "RW+p":
+                m = np.reshape(view, shape, order="F")
+                H = shape[0]
+                lp["RW"] = m[:, :4 * H]
+                # peephole cols: wFF, wOO, wGG (LSTMHelpers.java:62);
+                # wGG→pI is documented divergence (module docstring)
+                lp["pF"] = m[:, 4 * H]
+                lp["pO"] = m[:, 4 * H + 1]
+                lp["pI"] = m[:, 4 * H + 2]
+            elif name in ("mean", "var"):
+                ls[name] = view.copy()
+            else:
+                lp[name] = np.reshape(view, shape, order="F")
+        params.append(lp)
+        states.append(ls)
+    if off != flat.size:
+        raise ValueError(f"coefficients.bin has {flat.size} params, "
+                         f"layer specs consume {off}")
+    return params, states
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def restore_multi_layer_network(path, load_params: bool = True,
+                                load_updater: bool = True):
+    """Load a zip the ORIGINAL DL4J's ModelSerializer wrote and return an
+    initialized :class:`MultiLayerNetwork` of this framework (ref:
+    ModelSerializer.restoreMultiLayerNetwork, util/ModelSerializer.java;
+    regression contract: regressiontest/RegressionTest071.java).
+
+    ``updaterState.bin`` is NOT migrated: its per-rule buffer layout is
+    defined by nd4j GradientUpdater implementations whose source is not
+    part of the reference tree, so a faithful decode can't be verified.
+    When present and ``load_updater=True`` a UserWarning is emitted and
+    fresh updater state is used (one warm-up period on resume)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    import jax.numpy as jnp
+
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        if "configuration.json" not in names:
+            raise ValueError("not a DL4J model zip: no configuration.json")
+        conf = config_from_dl4j_json(
+            zf.read("configuration.json").decode("utf-8"))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        if load_params and "coefficients.bin" in names:
+            flat = read_nd4j_array(
+                io.BytesIO(zf.read("coefficients.bin"))).ravel(order="C")
+            params, states = params_from_flat(conf.layers, flat)
+            new_p, new_s = [], []
+            for lp, ls, cur_p, cur_s in zip(params, states, net.net_params,
+                                            net.net_state):
+                merged_p = dict(cur_p)
+                for k, v in lp.items():
+                    if k in merged_p and merged_p[k].shape != v.shape:
+                        raise ValueError(
+                            f"param {k}: DL4J shape {v.shape} != "
+                            f"{merged_p[k].shape}")
+                    merged_p[k] = jnp.asarray(v, jnp.float32)
+                merged_s = dict(cur_s)
+                for k, v in ls.items():
+                    merged_s[k] = jnp.asarray(v, jnp.float32)
+                new_p.append(merged_p)
+                new_s.append(merged_s)
+            net.net_params = new_p
+            net.net_state = new_s
+            net.opt_states = [net.updaters[i].init(net.net_params[i])
+                              for i in range(len(net.layers))]
+        if load_updater and "updaterState.bin" in names:
+            import warnings
+            warnings.warn(
+                "DL4J updaterState.bin found but not migrated (nd4j "
+                "buffer layout unverifiable); training resumes with "
+                "fresh updater state", UserWarning, stacklevel=2)
+    return net

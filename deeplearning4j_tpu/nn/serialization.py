@@ -56,6 +56,13 @@ def restore_multi_layer_network(path: Union[str, Path], load_updater: bool = Tru
 
     with zipfile.ZipFile(path, "r") as zf:
         conf_dict = json.loads(zf.read(CONFIG_NAME))
+        if "confs" in conf_dict:
+            # a zip the ORIGINAL Java DL4J wrote (Jackson schema with a
+            # confs[] array) — migrate it (nn/dl4j_migration.py) instead
+            # of parsing it as this framework's own tagged schema
+            from deeplearning4j_tpu.nn import dl4j_migration
+            return dl4j_migration.restore_multi_layer_network(
+                path, load_updater=load_updater)
         conf_dict.pop("@model", None)
         conf = MultiLayerConfiguration.from_dict(conf_dict)
         net = MultiLayerNetwork(conf).init()
