@@ -14,8 +14,9 @@ Parameter layout (ref: nn/params/DefaultParamInitializer.java:60-99): the
 flat params row is the per-layer concatenation, each layer contributing
 its views in initializer order — Dense/Output/Embedding: W [nIn,nOut]
 then b, **'f' (column-major) flattened** (weights/WeightInitUtil.java:40
-DEFAULT_WEIGHT_INIT_ORDER='f'); Convolution: W [nOut,nIn,kH,kW] then b
-(nn/params/ConvolutionParamInitializer.java); BatchNorm: gamma, beta,
+DEFAULT_WEIGHT_INIT_ORDER='f'); Convolution: b FIRST then W
+[nOut,nIn,kH,kW] reshaped **'c'** — the one row-major exception
+(nn/params/ConvolutionParamInitializer.java:76-80); BatchNorm: gamma, beta,
 mean, var (nn/params/BatchNormalizationParamInitializer.java:59-80);
 GravesLSTM: W [nIn,4H], RW [H,4H+3] (last 3 cols = peepholes wFF, wOO,
 wGG), b [4H], gate order IFOG
@@ -194,53 +195,60 @@ def _ints(v, default=(0, 0)) -> Tuple[int, ...]:
     return tuple(int(x) for x in v)
 
 
-def _common_kwargs(j: dict) -> dict:
+def _num_opt(j: dict, key) -> Optional[float]:
+    """Present-and-set (non-NaN) numeric field, else None.  Explicit
+    zeros are KEPT: a DL4J net saved with momentum=0.0 must not migrate
+    to the global default 0.9 (Jackson writes resolved values; only NaN
+    means unset)."""
+    if key not in j:
+        return None
+    try:
+        f = float(j[key])
+    except (TypeError, ValueError):
+        return None
+    return None if math.isnan(f) else f
+
+
+def _common_kwargs(j: dict, default_activation: str = "sigmoid") -> dict:
     kw = {}
     if j.get("nIn"):
         kw["n_in"] = int(j["nIn"])
     if j.get("nOut"):
         kw["n_out"] = int(j["nOut"])
     kw["activation"] = _parse_activation(
-        j.get("activationFn", j.get("activationFunction")))
+        j.get("activationFn", j.get("activationFunction")),
+        default_activation)
     for src, dst in (("l1", "l1"), ("l2", "l2"), ("l1Bias", "l1_bias"),
-                     ("l2Bias", "l2_bias")):
-        x = _num(j.get(src))
-        if x:
-            kw[dst] = x
-    d = _num(j.get("dropOut"))
-    if d:
-        kw["dropout"] = d
-    wi = j.get("weightInit")
-    if wi:
-        kw["weight_init"] = str(wi).lower()
-    lr = _num(j.get("learningRate"))
-    if lr:
-        kw["learning_rate"] = lr
-    blr = _num(j.get("biasLearningRate"))
-    if blr:
-        kw["bias_learning_rate"] = blr
-    upd = j.get("updater")
-    if upd:
-        kw["updater"] = _UPDATER_MAP.get(str(upd).lower(), "sgd")
-    for src, dst in (("momentum", "momentum"), ("rho", "rho"),
+                     ("l2Bias", "l2_bias"), ("dropOut", "dropout"),
+                     ("learningRate", "learning_rate"),
+                     ("biasLearningRate", "bias_learning_rate"),
+                     ("momentum", "momentum"), ("rho", "rho"),
                      ("rmsDecay", "rms_decay"),
                      ("adamMeanDecay", "adam_mean_decay"),
                      ("adamVarDecay", "adam_var_decay"),
                      ("epsilon", "epsilon"), ("biasInit", "bias_init")):
-        x = _num(j.get(src))
-        if x:
+        x = _num_opt(j, src)
+        if x is not None:
             kw[dst] = x
+    wi = j.get("weightInit")
+    if wi:
+        kw["weight_init"] = str(wi).lower()
+    upd = j.get("updater")
+    if upd:
+        kw["updater"] = _UPDATER_MAP.get(str(upd).lower(), "sgd")
     gn = j.get("gradientNormalization")
     if gn and str(gn) != "None":
         kw["gradient_normalization"] = str(gn).lower()
-        t = _num(j.get("gradientNormalizationThreshold"))
-        if t:
+        t = _num_opt(j, "gradientNormalizationThreshold")
+        if t is not None:
             kw["gradient_normalization_threshold"] = t
     return kw
 
 
 def _build_layer(type_name: str, j: dict) -> L.Layer:
-    kw = _common_kwargs(j)
+    kw = _common_kwargs(
+        j, default_activation="tanh" if type_name == "gravesLSTM"
+        else "sigmoid")
     t = type_name
     if t == "dense":
         return L.DenseLayer(**kw)
@@ -268,13 +276,16 @@ def _build_layer(type_name: str, j: dict) -> L.Layer:
             padding=_ints(j.get("padding"), (0, 0)), **kw)
     if t == "batchNormalization":
         kw.pop("n_in", None)
+        # DL4J BN applies NO activation regardless of the recorded
+        # activationFn (nn/layers/normalization/BatchNormalization.java:228)
+        kw.pop("activation", None)
         n_out = kw.pop("n_out", None)
         return L.BatchNormalization(
+            activation="identity",
             decay=_num(j.get("decay"), 0.9), eps=_num(j.get("eps"), 1e-5),
             lock_gamma_beta=bool(j.get("lockGammaBeta", False)),
             n_features=n_out, **kw)
     if t == "gravesLSTM":
-        kw.setdefault("activation", "tanh")
         return L.GravesLSTM(
             forget_gate_bias_init=_num(j.get("forgetGateBiasInit"), 1.0),
             gate_activation=_parse_activation(j.get("gateActivationFn"),
@@ -388,26 +399,30 @@ def config_from_dl4j_json(text: str) -> MultiLayerConfiguration:
 # ---------------------------------------------------------------------------
 
 def _layer_param_spec(layer: L.Layer):
-    """[(name, shape, n)] in DL4J view order, or [] for no-param layers.
-    Shapes are DL4J's; 'f'-order reshape recovers the matrices."""
+    """[(name, shape, n, order)] in DL4J view order, or [] for no-param
+    layers.  Shapes are DL4J's; most views reshape 'f' (column-major,
+    WeightInitUtil.java:40) — EXCEPT conv kernels, which DL4J reshapes
+    'c' and stores AFTER the bias (ConvolutionParamInitializer.java:76-80
+    bias at interval(0,nOut), weights reshape('c', nOut,nIn,kH,kW))."""
     if isinstance(layer, L.ConvolutionLayer):
         n_in, n_out = layer.n_in, layer.n_out
         kh, kw = layer.kernel
-        return [("W", (n_out, n_in, kh, kw), n_out * n_in * kh * kw),
-                ("b", (n_out,), n_out)]
+        return [("b", (n_out,), n_out, "C"),
+                ("W", (n_out, n_in, kh, kw), n_out * n_in * kh * kw, "C")]
     if isinstance(layer, L.BatchNormalization):
         n = layer.n_features
-        spec = [] if layer.lock_gamma_beta else [("gamma", (n,), n),
-                                                 ("beta", (n,), n)]
-        return spec + [("mean", (n,), n), ("var", (n,), n)]
+        spec = [] if layer.lock_gamma_beta else [("gamma", (n,), n, "F"),
+                                                 ("beta", (n,), n, "F")]
+        return spec + [("mean", (n,), n, "F"), ("var", (n,), n, "F")]
     if isinstance(layer, L.GravesLSTM):
         n_in, H = layer.n_in, layer.n_out
-        return [("W", (n_in, 4 * H), n_in * 4 * H),
-                ("RW+p", (H, 4 * H + 3), H * (4 * H + 3)),
-                ("b", (4 * H,), 4 * H)]
+        return [("W", (n_in, 4 * H), n_in * 4 * H, "F"),
+                ("RW+p", (H, 4 * H + 3), H * (4 * H + 3), "F"),
+                ("b", (4 * H,), 4 * H, "F")]
     if layer.has_params():   # dense/output/rnnoutput/embedding family
         n_in, n_out = layer.n_in, layer.n_out
-        return [("W", (n_in, n_out), n_in * n_out), ("b", (n_out,), n_out)]
+        return [("W", (n_in, n_out), n_in * n_out, "F"),
+                ("b", (n_out,), n_out, "F")]
     return []
 
 
@@ -421,7 +436,7 @@ def params_from_flat(layers: List[L.Layer],
     for i, layer in enumerate(layers):
         spec = _layer_param_spec(layer)
         lp, ls = {}, {}
-        for name, shape, n in spec:
+        for name, shape, n, order in spec:
             if off + n > flat.size:
                 raise ValueError(
                     f"coefficients.bin too short at layer {i} ({name}): "
@@ -429,7 +444,7 @@ def params_from_flat(layers: List[L.Layer],
             view = flat[off:off + n]
             off += n
             if name == "RW+p":
-                m = np.reshape(view, shape, order="F")
+                m = np.reshape(view, shape, order=order)
                 H = shape[0]
                 lp["RW"] = m[:, :4 * H]
                 # peephole cols: wFF, wOO, wGG (LSTMHelpers.java:62);
@@ -440,7 +455,7 @@ def params_from_flat(layers: List[L.Layer],
             elif name in ("mean", "var"):
                 ls[name] = view.copy()
             else:
-                lp[name] = np.reshape(view, shape, order="F")
+                lp[name] = np.reshape(view, shape, order=order)
         params.append(lp)
         states.append(ls)
     if off != flat.size:

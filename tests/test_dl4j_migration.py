@@ -121,15 +121,15 @@ class TestRestoreNetwork:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
     def test_restored_net_trains(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
         net = mig.restore_multi_layer_network(FIXTURE)
         rng = np.random.default_rng(4)
         x = rng.normal(size=(16, 3)).astype(np.float32)
         y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)]
-        s0 = float(net.score(
-            __import__("deeplearning4j_tpu.datasets.dataset",
-                       fromlist=["DataSet"]).DataSet(x, y)))
+        s0 = float(net.score(DataSet(x, y)))
         net.fit(x, y, epochs=5)
-        assert np.isfinite(float(net._score))
+        s1 = float(net.score(DataSet(x, y)))
+        assert np.isfinite(s1) and s1 < s0  # fine-tuning actually learns
 
     def test_conv_bn_lstm_layer_specs(self):
         """Flattening specs for the non-dense families match the
@@ -138,7 +138,10 @@ class TestRestoreNetwork:
             BatchNormalization, ConvolutionLayer, GravesLSTM)
         conv = ConvolutionLayer(n_in=3, n_out=8, kernel=(5, 5))
         spec = mig._layer_param_spec(conv)
-        assert [(s[0], s[2]) for s in spec] == [("W", 8 * 3 * 25), ("b", 8)]
+        # DL4J conv views: bias FIRST, then 'c'-order kernels
+        # (ConvolutionParamInitializer.java:76-80)
+        assert [(s[0], s[2]) for s in spec] == [("b", 8), ("W", 8 * 3 * 25)]
+        assert spec[1][3] == "C"
         bn = BatchNormalization(n_features=7)
         assert [(s[0], s[2]) for s in mig._layer_param_spec(bn)] == [
             ("gamma", 7), ("beta", 7), ("mean", 7), ("var", 7)]
@@ -219,3 +222,37 @@ class TestReviewFixes:
             warnings.simplefilter("always")
             mig.restore_multi_layer_network(p, load_updater=False)
         assert not any("updaterState" in str(x.message) for x in w)
+
+
+class TestConvMigrationValues:
+    def test_conv_kernel_c_order_bias_first(self):
+        """Value-level check of the conv view layout: bias occupies the
+        first nOut slots, kernels reshape 'c' (row-major) — NOT the 'f'
+        order every other layer uses (ConvolutionParamInitializer.java:
+        76-80, 'Note c order is used specifically for the CNN weights')."""
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        conv = ConvolutionLayer(n_in=2, n_out=3, kernel=(2, 2))
+        n = 3 + 3 * 2 * 2 * 2
+        flat = np.arange(n, dtype=np.float32)
+        params, _ = mig.params_from_flat([conv], flat)
+        lp = params[0]
+        np.testing.assert_array_equal(lp["b"], flat[:3])
+        np.testing.assert_array_equal(
+            lp["W"], flat[3:].reshape(3, 2, 2, 2, order="C"))
+
+    def test_bn_layer_gets_no_activation(self):
+        j = {"nOut": 4, "activationFn": {"ReLU": {}}}
+        layer = mig._build_layer("batchNormalization", j)
+        assert layer.activation == "identity"
+
+    def test_explicit_zero_momentum_survives(self):
+        """momentum=0.0 saved explicitly must not be replaced by the
+        global default 0.9 (round-4 review: truthiness-drop bug)."""
+        j = {"nIn": 2, "nOut": 3, "updater": "NESTEROVS", "momentum": 0.0,
+             "activationFn": {"TanH": {}}}
+        layer = mig._build_layer("dense", j)
+        assert layer.momentum == 0.0
+        from deeplearning4j_tpu.nn.conf.network import (GlobalConf,
+                                                        merge_layer_conf)
+        merged = merge_layer_conf(layer, GlobalConf())
+        assert merged.momentum == 0.0
