@@ -353,6 +353,134 @@ def _viterbi_span(span: str, dictionary: MorphDictionary,
 # ---------------------------------------------------------------------------
 # Tokenizer contract
 # ---------------------------------------------------------------------------
+# IPADIC/kuromoji CSV dictionary loading — a user who has a real
+# kuromoji-format dictionary (IPADIC, NAIST-jdic, UniDic export, or a
+# kuromoji user dictionary) can load it into MorphDictionary instead of
+# the seed lexicon.
+# ---------------------------------------------------------------------------
+
+def parse_dictionary_line(line: str) -> List[str]:
+    """Quote-aware CSV split with ``""`` unescape — kuromoji's
+    DictionaryEntryLineParser.parseLine semantics (surfaces may contain
+    commas inside quotes, e.g. ``"1,000",...``)."""
+    fields: List[str] = []
+    buf: List[str] = []
+    inside = False
+    quotes = 0
+    for ch in line:
+        if ch == '"':
+            inside = not inside
+            quotes += 1
+        if ch == "," and not inside:
+            fields.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if quotes % 2:
+        raise ValueError(f"Unmatched quote in entry: {line!r}")
+    fields.append("".join(buf))
+
+    def unescape(v: str) -> str:
+        if len(v) > 1 and v[0] == '"' and v[-1] == '"':
+            v = v[1:-1]
+        return v.replace('""', '"')
+
+    return [unescape(f) for f in fields]
+
+
+# IPADIC part-of-speech level-1 (and the 接尾 level-2 marker) → the
+# connection-cost POS classes this lattice uses.  IPADIC names are
+# standard across kuromoji-format dictionaries.
+_IPADIC_POS = {
+    "名詞": NOUN, "助詞": PARTICLE, "動詞": VERB, "助動詞": AUX,
+    "形容詞": ADJ, "副詞": ADV, "接頭詞": PREFIX, "連体詞": ADJ,
+    "接続詞": ADV, "感動詞": ADV, "記号": SYMBOL, "フィラー": ADV,
+    "その他": UNK,
+}
+
+
+def ipadic_entry(fields: Sequence[str],
+                 cost_divisor: int = 1500) -> MorphEntry:
+    """One IPADIC CSV row → MorphEntry.  Layout (ref: kuromoji
+    ipadic/compile/DictionaryEntry.java:24-66): surface, left_id,
+    right_id, word_cost, pos1..pos4, conj_type, conj_form, base_form,
+    reading, pronunciation.  Short rows (user dictionaries) need only
+    surface[,left,right,cost[,pos1]].
+
+    IPADIC word costs are shorts (≈ -20000..20000, frequent words most
+    negative); this lattice's costs are small non-negative ints on the
+    seed lexicon's scale, so raw costs are affinely squashed:
+    ``clip(round(cost/divisor) + 8, 0, 24)`` — order-preserving, and a
+    typical frequent word (≈ -6000) lands near the seed lexicon's cheap
+    entries."""
+    surface = fields[0]
+    f3 = fields[3].strip() if len(fields) > 3 else ""
+    try:
+        raw_cost = int(f3) if f3 else 0
+    except ValueError:
+        # kuromoji USER-dictionary layout instead: surface, segmentation,
+        # readings, pos-name (dict/UserDictionary.java) — field 3 is a
+        # POS string like カスタム名詞.  Cheap cost so the user entry
+        # wins, mirroring add_word / kuromoji user-dict semantics.
+        return MorphEntry(surface, _ja_pos_name(f3), 3)
+    pos1 = fields[4] if len(fields) > 4 else ""
+    pos2 = fields[5] if len(fields) > 5 else ""
+    pos = _IPADIC_POS.get(pos1, NOUN)
+    if pos is NOUN and "接尾" in (pos1, pos2):
+        pos = SUFFIX
+    base = fields[10] if len(fields) > 10 else None
+    if base in ("*", "", surface):
+        base = None
+    cost = int(min(24, max(0, round(raw_cost / cost_divisor) + 8)))
+    return MorphEntry(surface, pos, cost, base)
+
+
+def _ja_pos_name(name: str) -> str:
+    """Best-effort POS class from a Japanese POS NAME (user dictionaries
+    use free-form names like カスタム名詞): substring match against the
+    IPADIC level-1 names, NOUN fallback."""
+    for ja, pos in _IPADIC_POS.items():
+        if ja in name:
+            return pos
+    return NOUN
+
+
+def load_ipadic_csv(source, dictionary: Optional[MorphDictionary] = None,
+                    encoding: str = "utf-8-sig",
+                    cost_divisor: int = 1500) -> MorphDictionary:
+    """Load a kuromoji/IPADIC-format CSV dictionary (or a kuromoji USER
+    dictionary — auto-detected per row) into a MorphDictionary (ref: the
+    vendored analyzer's compile step,
+    com/atilika/kuromoji/ipadic/compile/DictionaryEntry.java,
+    dict/UserDictionary.java).
+
+    ``source`` is a path (original IPADIC ships EUC-JP — pass
+    ``encoding='euc-jp'``; the default also absorbs a UTF-8 BOM) or an
+    iterable of already-decoded lines.  Kuromoji CSV has no comment
+    syntax, so every non-empty line is an entry.  With no ``dictionary``
+    argument a fresh one WITHOUT the seed lexicon is returned (a real
+    dictionary replaces the seed, which remains the zero-download
+    fallback); pass an existing dictionary to merge."""
+    if dictionary is None:
+        dictionary = MorphDictionary(seed=False)
+    opened = None
+    if isinstance(source, (str, bytes)) or hasattr(source, "__fspath__"):
+        import io as _io
+        lines = opened = _io.open(source, "r", encoding=encoding)
+    else:
+        lines = source  # iterable of lines or an open file object
+    try:
+        for line in lines:
+            line = line.strip("\r\n")
+            if not line:
+                continue
+            dictionary.add(ipadic_entry(parse_dictionary_line(line),
+                                        cost_divisor))
+    finally:
+        if opened is not None:
+            opened.close()
+    return dictionary
+
 
 class JapaneseLatticeTokenizer(Tokenizer):
     """Viterbi segmentation with morpheme metadata
@@ -377,9 +505,13 @@ class JapaneseLatticeTokenizerFactory(TokenizerFactory):
     longest-match heuristic."""
 
     def __init__(self, user_entries: Optional[Iterable] = None,
-                 keep_punct: bool = False):
+                 keep_punct: bool = False,
+                 dictionary: Optional[MorphDictionary] = None):
         super().__init__()
-        self.dictionary = MorphDictionary()
+        # a user-supplied dictionary (e.g. load_ipadic_csv) replaces the
+        # seed lexicon, mirroring kuromoji's dictionary selection
+        self.dictionary = dictionary if dictionary is not None \
+            else MorphDictionary()
         for e in user_entries or ():
             if isinstance(e, MorphEntry):
                 self.dictionary.add(e)

@@ -3,6 +3,8 @@ Viterbi segmentation (ref: com/atilika/kuromoji ViterbiSearcher /
 UnknownDictionary), replacing round-2's longest-match-only heuristic."""
 
 import numpy as np
+
+from deeplearning4j_tpu.text import lattice
 import pytest
 
 from deeplearning4j_tpu.text.lattice import (
@@ -156,3 +158,116 @@ def test_word2vec_integration():
     w2v.fit()
     vec = w2v.word_vector("言葉")
     assert vec is not None and np.isfinite(vec).all()
+
+
+class TestIpadicCsvLoader:
+    """Round-3 verdict missing #3 / next #7: kuromoji/IPADIC-format CSV
+    dictionaries load into MorphDictionary (ref:
+    com/atilika/kuromoji/ipadic/compile/DictionaryEntry.java:24-66,
+    util/DictionaryEntryLineParser.java)."""
+
+    # 20-line hand-made CSV in the IPADIC 13-field layout
+    CSV = "\n".join([
+        "すもも,1285,1285,7546,名詞,一般,*,*,*,*,すもも,スモモ,スモモ",
+        "もも,1285,1285,7219,名詞,一般,*,*,*,*,もも,モモ,モモ",
+        "も,262,262,4669,助詞,係助詞,*,*,*,*,も,モ,モ",
+        "の,368,368,4816,助詞,連体化,*,*,*,*,の,ノ,ノ",
+        "うち,1313,1313,5796,名詞,非自立,副詞可能,*,*,*,うち,ウチ,ウチ",
+        "に,156,156,4304,助詞,格助詞,一般,*,*,*,に,ニ,ニ",
+        "は,261,261,3865,助詞,係助詞,*,*,*,*,は,ハ,ハ",
+        "鶏,1285,1285,6016,名詞,一般,*,*,*,*,鶏,ニワトリ,ニワトリ",
+        "が,148,148,4404,助詞,格助詞,一般,*,*,*,が,ガ,ガ",
+        "いる,729,729,3777,動詞,自立,*,*,一段,基本形,いる,イル,イル",
+        "いた,729,729,4222,動詞,自立,*,*,一段,連用タ接続,いる,イタ,イタ",
+        "食べる,732,732,4723,動詞,自立,*,*,一段,基本形,食べる,タベル,タベル",
+        "です,304,304,2706,助動詞,*,*,*,特殊・デス,基本形,です,デス,デス",
+        "大きい,20,20,5219,形容詞,自立,*,*,形容詞・イ段,基本形,大きい,オオキイ,オオキイ",
+        "とても,1016,1016,5154,副詞,助詞類接続,*,*,*,*,とても,トテモ,トテモ",
+        "お,560,560,6664,接頭詞,名詞接続,*,*,*,*,お,オ,オ",
+        "さん,1678,1678,5576,名詞,接尾,人名,*,*,*,さん,サン,サン",
+        "、,76,76,-2435,記号,読点,*,*,*,*,、,、,、",
+        '"1,000",1295,1295,3003,名詞,数,*,*,*,*,"1,000",セン,セン',
+        "東京,1293,1293,3003,名詞,固有名詞,地域,一般,*,*,東京,トウキョウ,トーキョー",
+    ])
+
+    def test_quote_aware_line_parser(self):
+        f = lattice.parse_dictionary_line('"1,000",1295,1295,3003,名詞')
+        assert f[0] == "1,000" and f[1] == "1295" and f[4] == "名詞"
+        f = lattice.parse_dictionary_line('he said ""hi"",1,2,3')
+        assert f[0] == 'he said "hi"'
+        with pytest.raises(ValueError, match="Unmatched quote"):
+            lattice.parse_dictionary_line('"broken,1,2,3')
+
+    def test_pos_and_cost_mapping(self):
+        d = lattice.load_ipadic_csv(self.CSV.splitlines())
+        sumomo = d.prefixes("すもも", 0)[-1]
+        assert sumomo.pos == lattice.NOUN
+        wa = d.prefixes("は", 0)[-1]
+        assert wa.pos == lattice.PARTICLE
+        iru = [e for e in d.prefixes("いたX", 0) if e.surface == "いた"][0]
+        assert iru.pos == lattice.VERB and iru.base_form == "いる"
+        desu = d.prefixes("です", 0)[-1]
+        assert desu.pos == lattice.AUX
+        ookii = d.prefixes("大きい", 0)[-1]
+        assert ookii.pos == lattice.ADJ
+        o = [e for e in d.prefixes("おX", 0) if e.surface == "お"][0]
+        assert o.pos == lattice.PREFIX
+        san = d.prefixes("さん", 0)[-1]
+        assert san.pos == lattice.SUFFIX   # 名詞,接尾
+        comma = d.prefixes("、", 0)[-1]
+        assert comma.pos == lattice.SYMBOL
+        # frequent (negative-cost) symbol is cheaper than a rare noun
+        assert comma.cost < sumomo.cost
+
+    def test_costs_order_preserving(self):
+        d = lattice.load_ipadic_csv(self.CSV.splitlines())
+        momo = [e for e in d.prefixes("もも", 0) if e.surface == "もも"][0]
+        sumomo = d.prefixes("すもも", 0)[-1]
+        assert momo.cost <= sumomo.cost  # 7219 < 7546
+
+    def test_loaded_dictionary_segments_classic_sentence(self):
+        d = lattice.load_ipadic_csv(self.CSV.splitlines())
+        toks = lattice.viterbi_segment("すもももももももものうち", d)
+        assert [t.surface for t in toks] == \
+            ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+
+    def test_factory_takes_loaded_dictionary(self):
+        d = lattice.load_ipadic_csv(self.CSV.splitlines())
+        fac = lattice.JapaneseLatticeTokenizerFactory(dictionary=d)
+        toks = fac.create("すももとももです").get_tokens()
+        assert "すもも" in toks and "です" in toks
+
+    def test_load_from_file_path(self, tmp_path):
+        p = tmp_path / "user_dict.csv"
+        p.write_text(self.CSV, encoding="utf-8")
+        d = lattice.load_ipadic_csv(p)
+        assert d.prefixes("東京", 0)[-1].pos == lattice.NOUN
+
+    def test_merge_into_existing_dictionary(self):
+        d = lattice.MorphDictionary()  # seed lexicon
+        lattice.load_ipadic_csv(["固有名詞X,1,1,1000,名詞,固有名詞"],
+                                dictionary=d)
+        assert any(e.surface == "固有名詞X" for e in d.prefixes("固有名詞X", 0))
+        # seed entries still present
+        assert d.prefixes("です", 0)
+
+    def test_kuromoji_user_dictionary_rows(self):
+        """Real kuromoji user-dict layout (surface,segmentation,readings,
+        pos-name) loads instead of crashing (round-4 review)."""
+        d = lattice.load_ipadic_csv(
+            ["日本経済新聞,日本 経済 新聞,ニホン ケイザイ シンブン,カスタム名詞",
+             "てست,て スト,テ スト,カスタム動詞"])
+        e = [x for x in d.prefixes("日本経済新聞を", 0)
+             if x.surface == "日本経済新聞"][0]
+        assert e.pos == lattice.NOUN and e.cost == 3
+        assert d.prefixes("てست", 0)[-1].pos == lattice.VERB
+
+    def test_hash_surface_not_treated_as_comment(self):
+        d = lattice.load_ipadic_csv(["#,76,76,100,記号,一般"])
+        assert d.prefixes("#", 0)[-1].pos == lattice.SYMBOL
+
+    def test_utf8_bom_file(self, tmp_path):
+        p = tmp_path / "bom.csv"
+        p.write_bytes(b"\xef\xbb\xbf" + "すもも,1,1,1000,名詞,一般".encode())
+        d = lattice.load_ipadic_csv(p)
+        assert any(e.surface == "すもも" for e in d.prefixes("すもも", 0))
