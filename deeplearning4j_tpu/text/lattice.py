@@ -438,10 +438,11 @@ def ipadic_entry(fields: Sequence[str],
 def _ja_pos_name(name: str) -> str:
     """Best-effort POS class from a Japanese POS NAME (user dictionaries
     use free-form names like カスタム名詞): substring match against the
-    IPADIC level-1 names, NOUN fallback."""
-    for ja, pos in _IPADIC_POS.items():
+    IPADIC level-1 names, LONGEST first (助動詞 must hit aux, not the
+    embedded 動詞), NOUN fallback."""
+    for ja in sorted(_IPADIC_POS, key=len, reverse=True):
         if ja in name:
-            return pos
+            return _IPADIC_POS[ja]
     return NOUN
 
 

@@ -157,3 +157,48 @@ class TestCnn:
         assert net.score(ds) < s0
         # BN running stats must have moved
         assert not np.allclose(np.asarray(net.net_state[1]["mean"]), 0.0)
+
+
+class TestConvInternalLayout:
+    def test_nhwc_internal_matches_nchw(self, monkeypatch):
+        """DL4J_CONV_LAYOUT=nhwc is a pure layout change: forward AND
+        gradients must match the NCHW path (bench A/B prerequisite)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops import convolution as conv_ops
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(5, 3, 3, 3)).astype(np.float32))
+        b = jnp.asarray(rng.normal(size=(5,)).astype(np.float32))
+
+        def loss(x, w, b):
+            return jnp.sum(conv_ops.conv2d(x, w, b, stride=(2, 2),
+                                           pad=(1, 1)) ** 2)
+
+        monkeypatch.delenv("DL4J_CONV_LAYOUT", raising=False)
+        y_nchw = conv_ops.conv2d(x, w, b, stride=(2, 2), pad=(1, 1))
+        g_nchw = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+        monkeypatch.setenv("DL4J_CONV_LAYOUT", "nhwc")
+        y_nhwc = conv_ops.conv2d(x, w, b, stride=(2, 2), pad=(1, 1))
+        g_nhwc = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+
+        np.testing.assert_allclose(np.asarray(y_nchw), np.asarray(y_nhwc),
+                                   rtol=1e-5, atol=1e-5)
+        for a, bb in zip(g_nchw, g_nhwc):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_nhwc_same_padding(self, monkeypatch):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops import convolution as conv_ops
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(1, 2, 7, 7)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(4, 2, 3, 3)).astype(np.float32))
+        monkeypatch.delenv("DL4J_CONV_LAYOUT", raising=False)
+        y0 = conv_ops.conv2d(x, w, border_mode="same")
+        monkeypatch.setenv("DL4J_CONV_LAYOUT", "nhwc")
+        y1 = conv_ops.conv2d(x, w, border_mode="same")
+        assert y0.shape == y1.shape == (1, 4, 7, 7)
+        np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                                   rtol=1e-5, atol=1e-5)

@@ -271,3 +271,7 @@ class TestIpadicCsvLoader:
         p.write_bytes(b"\xef\xbb\xbf" + "すもも,1,1,1000,名詞,一般".encode())
         d = lattice.load_ipadic_csv(p)
         assert any(e.surface == "すもも" for e in d.prefixes("すもも", 0))
+
+    def test_jodoushi_maps_to_aux_not_verb(self):
+        assert lattice._ja_pos_name("助動詞") == lattice.AUX
+        assert lattice._ja_pos_name("カスタム動詞") == lattice.VERB
