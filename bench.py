@@ -414,12 +414,14 @@ def bench_word2vec():
     t0 = time.perf_counter()
     w2v.fit()
     dt = time.perf_counter() - t0
+    from deeplearning4j_tpu.embeddings import kernels as w2v_kernels
     return {
         "metric": "Word2Vec skip-gram NS words/sec (end-to-end fit, synthetic text8-like corpus)",
         "value": round(TOKENS / dt, 1),
         "unit": "words/sec",
         "corpus_tokens": TOKENS,
         "fit_sec": round(dt, 3),
+        "chunk": w2v_kernels.CHUNK,  # DL4J_W2V_CHUNK tunes; vs 55k/s CPU
         "note": "single epoch incl. host-side windowing; fused skipgram_step kernel",
     }
 

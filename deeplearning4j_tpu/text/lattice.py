@@ -398,6 +398,8 @@ _IPADIC_POS = {
     "その他": UNK,
 }
 
+_IPADIC_POS_BY_LEN = sorted(_IPADIC_POS, key=len, reverse=True)
+
 
 def ipadic_entry(fields: Sequence[str],
                  cost_divisor: int = 1500) -> MorphEntry:
@@ -440,7 +442,7 @@ def _ja_pos_name(name: str) -> str:
     use free-form names like カスタム名詞): substring match against the
     IPADIC level-1 names, LONGEST first (助動詞 must hit aux, not the
     embedded 動詞), NOUN fallback."""
-    for ja in sorted(_IPADIC_POS, key=len, reverse=True):
+    for ja in _IPADIC_POS_BY_LEN:
         if ja in name:
             return _IPADIC_POS[ja]
     return NOUN
