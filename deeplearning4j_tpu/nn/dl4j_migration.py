@@ -343,8 +343,9 @@ def config_from_dl4j_json(text: str) -> MultiLayerConfiguration:
     confs = top.get("confs", [])
     if not confs:
         raise ValueError("configuration.json has no 'confs' — not a "
-                         "MultiLayerConfiguration (ComputationGraph "
-                         "migration is not supported yet)")
+                         "MultiLayerConfiguration (for a ComputationGraph "
+                         "zip use restore_computation_graph / "
+                         "config_from_dl4j_graph_json)")
 
     layers: List[L.Layer] = []
     g = GlobalConf()
@@ -392,6 +393,231 @@ def config_from_dl4j_json(text: str) -> MultiLayerConfiguration:
                        .startswith("truncated") else "standard"),
         tbptt_fwd_length=int(top.get("tbpttFwdLength", 20)),
         tbptt_back_length=int(top.get("tbpttBackLength", 20)))
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph configuration.json → graph conf
+# ---------------------------------------------------------------------------
+
+def _build_vertex(wrapper: dict):
+    """One Jackson GraphVertex (wrapper-object typed,
+    nn/conf/graph/GraphVertex.java:38-51) → our GraphVertexConf."""
+    from deeplearning4j_tpu.nn.conf import graph_conf as gc
+    (vtype, vj), = wrapper.items()
+    if vtype == "LayerVertex":
+        lconf = vj.get("layerConf") or {}
+        lw = lconf.get("layer") or {}
+        (tname, lj), = lw.items()
+        layer = _build_layer(tname, lj)
+        pre = None
+        pj = vj.get("preProcessor")
+        if isinstance(pj, dict) and len(pj) == 1:
+            (pname, pjj), = pj.items()
+            if pname in _PREPROC_MAP:
+                pre = _PREPROC_MAP[pname](pjj)
+        return layer, pre
+    if vtype == "MergeVertex":
+        return gc.MergeVertex(), None
+    if vtype == "ElementWiseVertex":
+        return gc.ElementWiseVertex(
+            op=str(vj.get("op", "Add")).lower()), None
+    if vtype == "SubsetVertex":
+        return gc.SubsetVertex(from_idx=int(vj.get("from", 0)),
+                               to_idx=int(vj.get("to", 0))), None
+    if vtype == "ScaleVertex":
+        return gc.ScaleVertex(scale=_num(vj.get("scaleFactor"), 1.0)), None
+    if vtype == "ShiftVertex":
+        return gc.ShiftVertex(shift=_num(vj.get("shiftFactor"), 0.0)), None
+    if vtype == "StackVertex":
+        return gc.StackVertex(), None
+    if vtype == "UnstackVertex":
+        return gc.UnstackVertex(from_idx=int(vj.get("from", 0)),
+                                stack_size=int(vj.get("stackSize", 1))), None
+    if vtype == "L2Vertex":
+        return gc.L2Vertex(), None
+    if vtype == "L2NormalizeVertex":
+        return gc.L2NormalizeVertex(), None
+    if vtype == "LastTimeStepVertex":
+        return gc.LastTimeStepVertex(
+            mask_input=vj.get("maskArrayInputName")), None
+    if vtype == "DuplicateToTimeSeriesVertex":
+        return gc.DuplicateToTimeSeriesVertex(
+            ts_input=vj.get("inputName")), None
+    if vtype == "PreprocessorVertex":
+        pj = vj.get("preProcessor") or {}
+        if isinstance(pj, dict) and len(pj) == 1:
+            (pname, pjj), = pj.items()
+            if pname in _PREPROC_MAP:
+                return gc.PreprocessorVertex.of(_PREPROC_MAP[pname](pjj)), \
+                    None
+        raise ValueError(f"unsupported PreprocessorVertex payload: {pj}")
+    raise ValueError(f"DL4J graph vertex type {vtype!r} has no migration "
+                     f"mapping yet")
+
+
+def config_from_dl4j_graph_json(text):
+    """Jackson ComputationGraphConfiguration JSON (string or parsed
+    dict) → our graph conf (schema:
+    nn/conf/ComputationGraphConfiguration.java:59-87 —
+    networkInputs/networkOutputs, vertices + vertexInputs maps,
+    defaultConfiguration)."""
+    from deeplearning4j_tpu.nn.conf import graph_conf as gc
+    from deeplearning4j_tpu.nn.conf.network import merge_layer_conf
+    top = json.loads(text) if isinstance(text, (str, bytes)) else text
+    if "vertices" not in top or "networkInputs" not in top:
+        raise ValueError("not a DL4J ComputationGraphConfiguration")
+
+    g = GlobalConf()
+    default = top.get("defaultConfiguration") or {}
+    g.seed = int(default.get("seed", 0) or 0)
+    g.minimize = bool(default.get("minimize", True))
+    g.mini_batch = bool(default.get("miniBatch", True))
+    g.use_regularization = bool(default.get("useRegularization", False))
+
+    vertices = {}
+    vertex_inputs = {k: list(v)
+                     for k, v in (top.get("vertexInputs") or {}).items()}
+    first_layer = True
+    for name, wrapper in (top.get("vertices") or {}).items():
+        built, pre = _build_vertex(wrapper)
+        if isinstance(built, L.Layer):
+            if first_layer:
+                # global training hyperparams ride the first layer,
+                # matching the MLN path
+                if built.learning_rate:
+                    g.learning_rate = built.learning_rate
+                if built.updater:
+                    g.updater = built.updater
+                if built.momentum is not None:
+                    g.momentum = built.momentum
+                first_layer = False
+            layer = merge_layer_conf(built, g)
+            vertices[name] = gc.LayerVertex(layer=layer.to_dict())
+            if pre is not None:
+                # our engine has no per-LayerVertex preprocessor slot;
+                # splice a PreprocessorVertex in front (same math)
+                pname = f"{name}__pre"
+                vertices[pname] = gc.PreprocessorVertex.of(pre)
+                vertex_inputs[pname] = vertex_inputs.get(name, [])
+                vertex_inputs[name] = [pname]
+        else:
+            vertices[name] = built
+
+    return gc.ComputationGraphConfiguration(
+        network_inputs=list(top.get("networkInputs") or []),
+        network_outputs=list(top.get("networkOutputs") or []),
+        vertices=vertices, vertex_inputs=vertex_inputs, global_conf=g,
+        backprop_type=("truncatedbptt"
+                       if str(top.get("backpropType", "")).lower()
+                       .startswith("truncated") else "standard"),
+        tbptt_fwd_length=int(top.get("tbpttFwdLength", 20)),
+        tbptt_back_length=int(top.get("tbpttBackLength", 20)))
+
+
+def dl4j_graph_topological_order(network_inputs: List[str],
+                                 vertex_names: List[str],
+                                 vertex_inputs: Dict[str, List[str]]
+                                 ) -> List[str]:
+    """Replicate ComputationGraph.topologicalSortOrder (:312) exactly:
+    indices are assigned inputs-first then vertex-map order; Kahn's with
+    a FIFO queue whose initial fill and neighbor expansion iterate in
+    ASCENDING index order (Java HashMap<Integer>/HashSet<Integer>
+    iterate small non-negative ints in value order).  The flat param row
+    is laid out in THIS order, so it must match bit-for-bit."""
+    names = list(network_inputs) + list(vertex_names)
+    idx = {n: i for i, n in enumerate(names)}
+    n = len(names)
+    in_edges: Dict[int, set] = {i: set() for i in range(n)}
+    out_edges: Dict[int, set] = {i: set() for i in range(n)}
+    for name, ins in vertex_inputs.items():
+        if name not in idx:
+            continue
+        for src in ins:
+            if src in idx:
+                in_edges[idx[name]].add(idx[src])
+                out_edges[idx[src]].add(idx[name])
+    from collections import deque
+    queue = deque(sorted(i for i in range(n) if not in_edges[i]))
+    order = []
+    while queue:
+        nxt = queue.popleft()
+        order.append(nxt)
+        for v in sorted(out_edges[nxt]):
+            in_edges[v].discard(nxt)
+            if not in_edges[v]:
+                queue.append(v)
+    if len(order) != n:
+        raise ValueError("cycle in DL4J graph configuration")
+    return [names[i] for i in order]
+
+
+def restore_computation_graph(path, load_params: bool = True,
+                              load_updater: bool = True):
+    """Load a ComputationGraph zip the ORIGINAL DL4J wrote (ref:
+    ModelSerializer.restoreComputationGraph; param layout:
+    ComputationGraph.java:336-380 — per-vertex views sliced from the
+    flat row in topological order)."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    import jax.numpy as jnp
+
+    with zipfile.ZipFile(path, "r") as zf:
+        names = set(zf.namelist())
+        if "configuration.json" not in names:
+            raise ValueError("not a DL4J model zip: no configuration.json")
+        raw = json.loads(zf.read("configuration.json").decode("utf-8"))
+        conf = config_from_dl4j_graph_json(raw)
+        net = ComputationGraph(conf)
+        net.init()
+        if load_params and "coefficients.bin" in names:
+            flat = read_nd4j_array(
+                io.BytesIO(zf.read("coefficients.bin"))).ravel(order="C")
+            # topo order over the ORIGINAL vertex map (before any
+            # PreprocessorVertex splicing, which has no params)
+            topo = dl4j_graph_topological_order(
+                list(raw.get("networkInputs") or []),
+                list((raw.get("vertices") or {}).keys()),
+                {k: list(v)
+                 for k, v in (raw.get("vertexInputs") or {}).items()})
+            off = 0
+            for vname in topo:
+                if vname not in conf.vertices:
+                    continue  # a network input
+                v = conf.vertices[vname]
+                from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+                if not isinstance(v, LayerVertex):
+                    continue
+                layer = v.layer_conf()
+                spec = _layer_param_spec(layer)
+                if not spec:
+                    continue
+                total = sum(s[2] for s in spec)
+                params, states = params_from_flat(
+                    [layer], flat[off:off + total])
+                off += total
+                merged = dict(net.net_params[vname])
+                for k, val in params[0].items():
+                    if k in merged and merged[k].shape != val.shape:
+                        raise ValueError(
+                            f"vertex {vname} param {k}: DL4J shape "
+                            f"{val.shape} != {merged[k].shape}")
+                    merged[k] = jnp.asarray(val, jnp.float32)
+                net.net_params[vname] = merged
+                ms = dict(net.net_state[vname])
+                for k, val in states[0].items():
+                    ms[k] = jnp.asarray(val, jnp.float32)
+                net.net_state[vname] = ms
+            if off != flat.size:
+                raise ValueError(f"coefficients.bin has {flat.size} "
+                                 f"params, vertex specs consume {off}")
+            net.opt_states = {n2: net.updaters[n2].init(net.net_params[n2])
+                              for n2 in net.order}
+        if load_updater and "updaterState.bin" in names:
+            import warnings
+            warnings.warn(
+                "DL4J updaterState.bin found but not migrated (nd4j "
+                "buffer layout unverifiable); training resumes with "
+                "fresh updater state", UserWarning, stacklevel=2)
+    return net
 
 
 # ---------------------------------------------------------------------------

@@ -79,6 +79,12 @@ def restore_computation_graph(path: Union[str, Path], load_updater: bool = True)
 
     with zipfile.ZipFile(path, "r") as zf:
         conf_dict = json.loads(zf.read(CONFIG_NAME))
+        if "networkInputs" in conf_dict and "vertices" in conf_dict:
+            # a zip the ORIGINAL Java DL4J wrote (Jackson camelCase
+            # graph schema) — migrate it (nn/dl4j_migration.py)
+            from deeplearning4j_tpu.nn import dl4j_migration
+            return dl4j_migration.restore_computation_graph(
+                path, load_updater=load_updater)
         conf_dict.pop("@model", None)
         conf = ComputationGraphConfiguration.from_dict(conf_dict)
         net = ComputationGraph(conf).init()

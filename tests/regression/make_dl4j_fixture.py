@@ -91,5 +91,64 @@ def build(path=HERE / "dl4j_071_mlp.zip"):
     return path
 
 
+def _lv(layer_type, lj, seed=12345):
+    """One Jackson LayerVertex wrapper (layerConf is a full
+    NeuralNetConfiguration whose 'layer' is the wrapper-object layer)."""
+    return {"LayerVertex": {
+        "layerConf": {
+            "layer": {layer_type: lj},
+            "miniBatch": True, "seed": seed, "minimize": True,
+            "useRegularization": False, "pretrain": False,
+        },
+        "preProcessor": None,
+    }}
+
+
+def _dense(n_in, n_out, act, extra=None):
+    j = {"activationFn": {act: {}}, "nIn": n_in, "nOut": n_out,
+         "weightInit": "XAVIER", "learningRate": 0.1, "updater": "SGD",
+         "l1": float("nan"), "l2": float("nan"),
+         "l1Bias": float("nan"), "l2Bias": float("nan"), "dropOut": 0.0}
+    j.update(extra or {})
+    return j
+
+
+CG_CONFIG = {
+    "networkInputs": ["in"],
+    "networkOutputs": ["out"],
+    "vertices": {
+        "d1": _lv("dense", _dense(4, 6, "TanH")),
+        "a": _lv("dense", _dense(6, 5, "TanH")),
+        "b": _lv("dense", _dense(6, 5, "Identity")),
+        "merge": {"MergeVertex": {}},
+        "out": _lv("output", _dense(10, 3, "Softmax",
+                                    {"lossFn": {"LossMCXENT": {}}})),
+    },
+    "vertexInputs": {
+        "d1": ["in"], "a": ["d1"], "b": ["d1"],
+        "merge": ["a", "b"], "out": ["merge"],
+    },
+    "defaultConfiguration": {"seed": 12345, "minimize": True,
+                             "miniBatch": True,
+                             "useRegularization": False},
+    "backprop": True, "pretrain": False, "backpropType": "Standard",
+    "tbpttFwdLength": 20, "tbpttBackLength": 20,
+}
+
+
+def build_cg(path=HERE / "dl4j_071_cg.zip"):
+    # flat params in ComputationGraph topological order (in,d1,a,b,
+    # merge,out → param vertices d1,a,b,out), each vertex W ('f') then b
+    n = (4 * 6 + 6) + (6 * 5 + 5) + (6 * 5 + 5) + (10 * 3 + 3)
+    flat = np.linspace(1, n, n, dtype=np.float32) * 0.01
+    buf = io.BytesIO()
+    write_nd4j_array(buf, flat.reshape(1, -1), order="f")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(CG_CONFIG, indent=2))
+        zf.writestr("coefficients.bin", buf.getvalue())
+    return path
+
+
 if __name__ == "__main__":
     print(build())
+    print(build_cg())

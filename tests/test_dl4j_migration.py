@@ -256,3 +256,87 @@ class TestConvMigrationValues:
                                                         merge_layer_conf)
         merged = merge_layer_conf(layer, GlobalConf())
         assert merged.momentum == 0.0
+
+
+class TestComputationGraphMigration:
+    """Java-DL4J ComputationGraph zips load with exact param placement
+    (ref: ModelSerializer.restoreComputationGraph; flat layout
+    ComputationGraph.java:336-380 in topologicalSortOrder)."""
+
+    CG = HERE / "regression" / "dl4j_071_cg.zip"
+
+    def test_topological_order_replication(self):
+        # branch graph: ascending-index FIFO Kahn (Java HashMap semantics)
+        topo = mig.dl4j_graph_topological_order(
+            ["in"], ["d1", "a", "b", "merge", "out"],
+            {"d1": ["in"], "a": ["d1"], "b": ["d1"],
+             "merge": ["a", "b"], "out": ["merge"]})
+        assert topo == ["in", "d1", "a", "b", "merge", "out"]
+        # order of the vertices map must not matter — indices follow it,
+        # and the queue pops ascending
+        topo2 = mig.dl4j_graph_topological_order(
+            ["in"], ["out", "merge", "b", "a", "d1"],
+            {"d1": ["in"], "a": ["d1"], "b": ["d1"],
+             "merge": ["a", "b"], "out": ["merge"]})
+        assert topo2[0] == "in" and topo2[1] == "d1"
+        assert set(topo2[2:4]) == {"a", "b"}
+
+    def test_output_matches_numpy(self):
+        net = mig.restore_computation_graph(self.CG)
+        n = (4 * 6 + 6) + (6 * 5 + 5) + (6 * 5 + 5) + (10 * 3 + 3)
+        flat = np.linspace(1, n, n, dtype=np.float32) * 0.01
+        o = 0
+        W1 = flat[o:o + 24].reshape(4, 6, order="F"); o += 24
+        b1 = flat[o:o + 6]; o += 6
+        Wa = flat[o:o + 30].reshape(6, 5, order="F"); o += 30
+        ba = flat[o:o + 5]; o += 5
+        Wb = flat[o:o + 30].reshape(6, 5, order="F"); o += 30
+        bb = flat[o:o + 5]; o += 5
+        Wo = flat[o:o + 30].reshape(10, 3, order="F"); o += 30
+        bo = flat[o:o + 3]
+
+        np.testing.assert_array_equal(
+            np.asarray(net.net_params["d1"]["W"]), W1)
+        np.testing.assert_array_equal(
+            np.asarray(net.net_params["b"]["W"]), Wb)
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        h = np.tanh(x @ W1 + b1)
+        av = np.tanh(h @ Wa + ba)
+        bv = h @ Wb + bb
+        m = np.concatenate([av, bv], axis=1)
+        z = m @ Wo + bo
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        want = e / e.sum(axis=1, keepdims=True)
+        got = np.asarray(net.output(x)[0])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_cg_restored_trains(self):
+        net = mig.restore_computation_graph(self.CG)
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.fit(x, y, epochs=3)
+        assert np.isfinite(float(net.score()))
+
+    def test_serialization_auto_detects_cg_schema(self):
+        from deeplearning4j_tpu.nn.serialization import (
+            restore_computation_graph)
+        net = restore_computation_graph(self.CG)
+        assert "merge" in net.conf.vertices
+
+    def test_param_count_mismatch_rejected(self, tmp_path):
+        import shutil
+        p = tmp_path / "bad.zip"
+        shutil.copy(self.CG, p)
+        import io as _io, zipfile as _zf
+        buf = _io.BytesIO()
+        mig.write_nd4j_array(buf, np.zeros((1, 7), np.float32))
+        # rewrite with truncated coefficients
+        with _zf.ZipFile(self.CG) as zin, _zf.ZipFile(p, "w") as zout:
+            zout.writestr("configuration.json",
+                          zin.read("configuration.json"))
+            zout.writestr("coefficients.bin", buf.getvalue())
+        with pytest.raises(ValueError):
+            mig.restore_computation_graph(p)
