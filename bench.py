@@ -256,48 +256,33 @@ def bench_lenet_etl():
 
 
 def bench_lenet_scan(precision="bf16", k_steps=50):
-    """Device-bound ceiling: K full train steps fused into ONE compiled
-    program via lax.scan — no per-step host dispatch.  The gap between
-    this and the per-step `lenet` number is pure host/dispatch overhead.
+    """Device-bound ceiling through the PRODUCT path:
+    ``fit(it, fused_steps=K)`` fuses K train steps into one compiled
+    lax.scan launch (nn/multilayer.py _build_fused_step) — no per-step
+    host dispatch.  The gap between this and the per-step `lenet` number
+    is pure host/dispatch overhead.
 
-    OFF by default (DL4J_BENCH_SCAN=1 enables): on XLA:CPU, wrapping
-    the conv step in lax.scan is ~8x slower than the identical unrolled
-    step even at K=1 (loop bodies miss fusion/layout optimizations), so
-    the number is only meaningful on TPU and must be validated there
-    before it's trusted."""
+    Auto-enabled on TPU only (DL4J_BENCH_SCAN=1 to force elsewhere): on
+    XLA:CPU, scan bodies miss fusion/layout optimizations and the number
+    is meaningless."""
     import jax
-    import jax.numpy as jnp
     from deeplearning4j_tpu.models.lenet import lenet
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 
     BATCH = 256
     net = lenet()
     net.conf.global_conf.precision = precision
     net.init()
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(BATCH, 1, 28, 28)).astype(np.float32))
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)])
-    raw = net._build_step_raw()
-
-    def k_train_steps(params, state, opts, it0, key):
-        def body(carry, i):
-            p, s, o = carry
-            p, s, o, score = raw(p, s, o, x, y, None, None, it0 + i,
-                                 jax.random.fold_in(key, i))
-            return (p, s, o), score
-        (params, state, opts), scores = jax.lax.scan(
-            body, (params, state, opts), jnp.arange(k_steps))
-        return params, state, opts, scores[-1]
-
-    jitted = jax.jit(k_train_steps, donate_argnums=(0, 1, 2))
-    carry = [net.net_params, net.net_state, net.opt_states]
-    key = jax.random.PRNGKey(0)
-    it = jnp.asarray(0, jnp.int32)
+    x = rng.normal(size=(BATCH, 1, 28, 28)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+    batches = [DataSet(x, y) for _ in range(k_steps)]
 
     def run():
-        carry[0], carry[1], carry[2], _ = jitted(
-            carry[0], carry[1], carry[2], it, key)
+        net.fit(ListDataSetIterator(list(batches)), fused_steps=k_steps)
 
-    times = timed_windows(run, lambda: jax.block_until_ready(carry[0]),
+    times = timed_windows(run, lambda: jax.block_until_ready(net.net_params),
                           steps=4, warmup=2)
     st = window_stats(times, BATCH * k_steps, 4)
     # normalize units to TRAIN steps so the fields recompute consistently
@@ -306,8 +291,8 @@ def bench_lenet_scan(precision="bf16", k_steps=50):
     st["step_time_ms_median"] = st["launch_time_ms_median"] / k_steps
     st["steps_per_window"] = 4 * k_steps
     return {
-        "metric": f"LeNet-MNIST scan-fused steady-state samples/sec/chip "
-                  f"({precision}, {k_steps} steps/launch)",
+        "metric": f"LeNet-MNIST fit(fused_steps={k_steps}) steady-state "
+                  f"samples/sec/chip ({precision})",
         "value": round(st["items_per_sec_median"], 1),
         "unit": "samples/sec/chip",
         "chips_used": 1,

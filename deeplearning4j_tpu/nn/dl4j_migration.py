@@ -477,20 +477,28 @@ def config_from_dl4j_graph_json(text):
     vertices = {}
     vertex_inputs = {k: list(v)
                      for k, v in (top.get("vertexInputs") or {}).items()}
-    first_layer = True
-    for name, wrapper in (top.get("vertices") or {}).items():
-        built, pre = _build_vertex(wrapper)
+    raw_vertices = top.get("vertices") or {}
+    # global training hyperparams ride the TOPOLOGICALLY first layer
+    # vertex (vertex-map order is builder-insertion order in DL4J and
+    # may start with the output layer), matching the MLN path's confs[0]
+    first_layer_name = next(
+        (n for n in dl4j_graph_topological_order(
+            list(top.get("networkInputs") or []), list(raw_vertices),
+            vertex_inputs)
+         if "LayerVertex" in raw_vertices.get(n, {})), None)
+    built_map = {}
+    for name, wrapper in raw_vertices.items():
+        built_map[name] = _build_vertex(wrapper)
+    if first_layer_name is not None:
+        first = built_map[first_layer_name][0]
+        if first.learning_rate:
+            g.learning_rate = first.learning_rate
+        if first.updater:
+            g.updater = first.updater
+        if first.momentum is not None:
+            g.momentum = first.momentum
+    for name, (built, pre) in built_map.items():
         if isinstance(built, L.Layer):
-            if first_layer:
-                # global training hyperparams ride the first layer,
-                # matching the MLN path
-                if built.learning_rate:
-                    g.learning_rate = built.learning_rate
-                if built.updater:
-                    g.updater = built.updater
-                if built.momentum is not None:
-                    g.momentum = built.momentum
-                first_layer = False
             layer = merge_layer_conf(built, g)
             vertices[name] = gc.LayerVertex(layer=layer.to_dict())
             if pre is not None:

@@ -235,6 +235,7 @@ class MultiLayerNetwork:
             self._step_fn = self._score_fn = self._output_fn = None
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
+            self._fused_fns = None
 
     # ------------------------------------------------------------------
     # The jitted train step — ONE XLA computation per step
@@ -373,9 +374,20 @@ class MultiLayerNetwork:
         self.listeners.append(listener)
         return self
 
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fused_steps: int = 1):
         """fit(DataSetIterator) | fit(DataSet) | fit(x, y)
-        (ref: MultiLayerNetwork.fit :996)."""
+        (ref: MultiLayerNetwork.fit :996).
+
+        ``fused_steps=K>1`` fuses K consecutive same-shape batches into
+        ONE compiled launch (`lax.scan` over the train step) — the
+        per-step host dispatch that bounds small-model TPU throughput
+        disappears; the reference has no analog (its fit loop is
+        inherently per-batch, MultiLayerNetwork.fit :996).  Semantics
+        divergence, documented: listeners fire once per LAUNCH (seeing
+        the last score of the group), not once per batch; groups need
+        identical shapes/mask-presence (ragged tails fall back to
+        per-step); TBPTT ignores the flag."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.datasets.iterators import (
             AsyncDataSetIterator, DataSetIterator, ListDataSetIterator)
@@ -395,22 +407,104 @@ class MultiLayerNetwork:
         if it.async_supported() and not isinstance(it, AsyncDataSetIterator):
             it = AsyncDataSetIterator(it, device_put=True)
 
+        # fused path steps the updater once per batch; a conf with
+        # iterations>1 (multiple updates per batch) keeps exact
+        # semantics on the per-step path instead
+        fuse = (max(1, int(fused_steps))
+                if (self.conf.backprop_type != "truncatedbptt"
+                    and self.conf.global_conf.iterations <= 1) else 1)
         for _ in range(epochs):
             for lst in self.listeners:
                 if isinstance(lst, TrainingListener):
                     lst.on_epoch_start(self)
             it.reset()
             t_etl = time.perf_counter()
+            pending = []
             while it.has_next():
                 ds = it.next()
                 self.last_etl_time_ms = (time.perf_counter() - t_etl) * 1e3
-                self._fit_batch(ds)
+                if fuse > 1:
+                    pending.append(ds)
+                    if len(pending) == fuse:
+                        self._fit_fused_group(pending)
+                        pending = []
+                else:
+                    self._fit_batch(ds)
                 t_etl = time.perf_counter()
+            for ds in pending:  # ragged tail: per-step path
+                self._fit_batch(ds)
             for lst in self.listeners:
                 if isinstance(lst, TrainingListener):
                     lst.on_epoch_end(self)
             self.epoch += 1
         return self
+
+    def _build_fused_step(self, k: int):
+        """K train steps as one compiled program: lax.scan over the raw
+        step with the batch axis stacked in front.  Dispatch once, step
+        K times — the bench's scan-fused ceiling as an engine feature."""
+        raw = self._build_step_raw()
+
+        def strip_rnn(state):
+            # in-trace equivalent of _strip_rnn_state: RNN layers emit a
+            # carried 'rnn_state' each step; dropping it inside the body
+            # keeps the scan carry structure closed AND stops hidden
+            # state leaking across unrelated minibatches in a group
+            return [{kk: v for kk, v in s.items() if kk != "rnn_state"}
+                    for s in state]
+
+        def k_steps(params, state, opts, xs, ys, fms, lms, it0, key):
+            def body(carry, inp):
+                p, s, o = carry
+                i, x, y, fm, lm = inp
+                p, s, o, score = raw(p, s, o, x, y, fm, lm, it0 + i,
+                                     jax.random.fold_in(key, i))
+                return (p, strip_rnn(s), o), score
+            (params, state, opts), scores = jax.lax.scan(
+                body, (params, strip_rnn(state), opts),
+                (jnp.arange(k), xs, ys, fms, lms))
+            return params, state, opts, scores[-1]
+
+        return jax.jit(k_steps, donate_argnums=(0, 1, 2))
+
+    def _fit_fused_group(self, group):
+        k = len(group)
+        shapes = {(d.features.shape, d.labels.shape,
+                   d.features_mask is None, d.labels_mask is None)
+                  for d in group}
+        if len(shapes) != 1:
+            for d in group:   # mixed shapes can't stack — per-step
+                self._fit_batch(d)
+            return
+        # first-ever launch runs ONE batch per-step so carried state
+        # (e.g. a layer adding aux-state keys) reaches its steady
+        # structure before it becomes a scan carry
+        if getattr(self, "_fused_fns", None) is None:
+            self._fused_fns = {}
+            self._fit_batch(group[0])
+            group = group[1:]
+            k = len(group)
+            if not k:
+                return
+        if k not in self._fused_fns:
+            self._fused_fns[k] = self._build_fused_step(k)
+        xs = jnp.stack([jnp.asarray(d.features) for d in group])
+        ys = jnp.stack([jnp.asarray(d.labels) for d in group])
+        fms = (jnp.stack([jnp.asarray(d.features_mask) for d in group])
+               if group[0].features_mask is not None else None)
+        lms = (jnp.stack([jnp.asarray(d.labels_mask) for d in group])
+               if group[0].labels_mask is not None else None)
+        self._key, sub = jax.random.split(self._key)
+        (self.net_params, self.net_state, self.opt_states,
+         score) = self._fused_fns[k](
+            self.net_params, self.net_state, self.opt_states,
+            xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32), sub)
+        self._strip_rnn_state()
+        self._score = score
+        self.iteration += k
+        self.last_batch_size = group[0].num_examples() * k
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
 
     def _fit_batch(self, ds):
         g = self.conf.global_conf

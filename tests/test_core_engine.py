@@ -2,6 +2,8 @@
 score decrease, evaluation — modeled on the reference's
 deeplearning4j-core test strategy (MultiLayerTest.java, BackPropMLPTest.java)."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -202,3 +204,122 @@ class TestConvInternalLayout:
         assert y0.shape == y1.shape == (1, 4, 7, 7)
         np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestFusedSteps:
+    """fit(fused_steps=K): K batches per compiled launch via lax.scan —
+    the dispatch-elimination mode (no reference analog; its fit loop is
+    per-batch, MultiLayerNetwork.fit :996)."""
+
+    def _net(self):
+        return (NeuralNetConfiguration.builder()
+                .seed(11).learning_rate(0.1).updater("adam")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+
+    def _batches(self, n_batches, batch=8, seed=0):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n_batches):
+            x = rng.normal(size=(batch, 4)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, batch)]
+            out.append(DataSet(x, y))
+        return out
+
+    def test_fused_matches_per_step_exactly(self):
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        batches = self._batches(9)
+        a = MultiLayerNetwork(self._net()).init()
+        b = MultiLayerNetwork(self._net()).init()
+        b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+        a.fit(ListDataSetIterator(list(batches)))
+        b.fit(ListDataSetIterator(list(batches)), fused_steps=4)
+        assert a.iteration == b.iteration == 9
+        for pa, pb in zip(a.net_params, b.net_params):
+            for kk in pa:
+                np.testing.assert_allclose(
+                    np.asarray(pa[kk]), np.asarray(pb[kk]),
+                    rtol=2e-5, atol=2e-6)
+
+    def test_ragged_tail_and_listener_cadence(self):
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.nn.listeners import IterationListener
+
+        fired = []
+
+        class Probe(IterationListener):
+            def iteration_done(self, model, iteration):
+                fired.append(iteration)
+
+        net = MultiLayerNetwork(self._net()).init()
+        net.set_listeners(Probe())
+        # 7 batches, K=3: first launch per-step (structure warmup),
+        # then scan groups; every batch is consumed exactly once
+        net.fit(ListDataSetIterator(self._batches(7)), fused_steps=3)
+        assert net.iteration == 7
+        assert fired[-1] == 7
+        assert fired == sorted(fired)
+
+    def test_fused_respects_dropout_rng_difference(self):
+        # not a bit-exactness case (per-step path splits the host key per
+        # batch; fused folds per index) — just convergence sanity
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).learning_rate(0.05).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=32, activation="relu",
+                                  dropout=0.5))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ListDataSetIterator(self._batches(8)), epochs=3,
+                fused_steps=4)
+        assert np.isfinite(float(net._score))
+
+    def test_fused_with_rnn_layer_standard_backprop(self):
+        """Round-4 review: an RNN layer under standard backprop emits a
+        carried rnn_state; the fused scan must strip it in-body (closed
+        carry structure, no cross-batch state leak)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).learning_rate(0.05).updater("sgd")
+                .list()
+                .layer(GravesLSTM(n_in=5, n_out=8))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        rng = np.random.default_rng(1)
+        bs = []
+        for _ in range(6):
+            x = rng.normal(size=(4, 7, 5)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[
+                rng.integers(0, 3, (4, 7))].astype(np.float32)
+            bs.append(DataSet(x, y))
+        a = MultiLayerNetwork(conf).init()
+        b = MultiLayerNetwork(conf).init()
+        b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+        a.fit(ListDataSetIterator(list(bs)))
+        b.fit(ListDataSetIterator(list(bs)), fused_steps=3)
+        assert a.iteration == b.iteration == 6
+        for pa, pb in zip(a.net_params, b.net_params):
+            for kk in pa:
+                np.testing.assert_allclose(
+                    np.asarray(pa[kk]), np.asarray(pb[kk]),
+                    rtol=2e-5, atol=2e-6)
+
+    def test_iterations_gt1_falls_back_to_per_step(self):
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        conf = self._net()
+        conf.global_conf.iterations = 3
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ListDataSetIterator(self._batches(4)), fused_steps=2)
+        # 4 batches x 3 iterations each — fused path would have lost 2
+        assert net.iteration == 12
