@@ -311,9 +311,14 @@ class ComputationGraph:
         return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
-    def fit(self, data, labels=None, epochs: int = 1):
+    def fit(self, data, labels=None, epochs: int = 1,
+            fused_steps: int = 1):
         """fit(MultiDataSet | DataSet | iterator | (features, labels))
-        (ref: ComputationGraph.fit :828)."""
+        (ref: ComputationGraph.fit :828).  ``fused_steps=K>1`` fuses K
+        same-shape batches into one compiled lax.scan launch — same
+        semantics and caveats as MultiLayerNetwork.fit(fused_steps=K):
+        listeners fire once per launch, ragged/mixed groups fall back,
+        TBPTT and iterations>1 ignore the flag."""
         if labels is not None:
             data = MultiDataSet([np.asarray(data)], [np.asarray(labels)])
         if isinstance(data, DataSet):
@@ -326,6 +331,9 @@ class ComputationGraph:
                 if isinstance(lst, TrainingListener):
                     getattr(lst, which)(self)
 
+        fuse = (max(1, int(fused_steps))
+                if (self.conf.backprop_type != "truncatedbptt"
+                    and self.conf.global_conf.iterations <= 1) else 1)
         if isinstance(data, MultiDataSet):
             batches = [data]
             for _ in range(epochs):
@@ -339,14 +347,105 @@ class ComputationGraph:
         for _ in range(epochs):
             epoch_hook("on_epoch_start")
             data.reset()
+            pending = []
             for item in data:
                 if isinstance(item, DataSet):
                     item = MultiDataSet([item.features], [item.labels],
                                         [item.features_mask], [item.labels_mask])
+                if fuse > 1:
+                    pending.append(item)
+                    if len(pending) == fuse:
+                        self._fit_fused_group(pending)
+                        pending = []
+                else:
+                    self._fit_batch(item)
+            for item in pending:
                 self._fit_batch(item)
             epoch_hook("on_epoch_end")
             self.epoch += 1
         return self
+
+    def _build_fused_step(self, k: int):
+        """K graph train steps in one lax.scan launch (see
+        MultiLayerNetwork._build_fused_step — identical contract over
+        the vertex-dict carry)."""
+        raw = self._build_step_raw()
+
+        def strip_rnn(state):
+            return {n: {kk: v for kk, v in s.items() if kk != "rnn_state"}
+                    for n, s in state.items()}
+
+        def k_steps(params, state, opts, xs, ys, fms, lms, it0, key):
+            def body(carry, inp):
+                p, s, o = carry
+                i, x, y, fm, lm = inp
+                p, s, o, score = raw(p, s, o, x, y, fm, lm, it0 + i,
+                                     jax.random.fold_in(key, i))
+                return (p, strip_rnn(s), o), score
+            (params, state, opts), scores = jax.lax.scan(
+                body, (params, strip_rnn(state), opts),
+                (jnp.arange(k), xs, ys, fms, lms))
+            return params, state, opts, scores[-1]
+
+        return jax.jit(k_steps, donate_argnums=(0, 1, 2))
+
+    def _fit_fused_group(self, group):
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+
+        def shape_sig(m):
+            # per-ELEMENT mask presence: MultiDataSet wraps a missing
+            # mask as [None], so a top-level None check alone would fuse
+            # masked and unmasked batches together (wrong gradients)
+            def mask_sig(ms):
+                return None if ms is None else tuple(
+                    x is None for x in ms)
+            return (tuple(f.shape for f in m.features),
+                    tuple(l.shape for l in m.labels),
+                    mask_sig(m.features_masks), mask_sig(m.labels_masks))
+        if len({shape_sig(m) for m in group}) != 1:
+            for m in group:
+                self._fit_batch(m)
+            return
+        if getattr(self, "_fused_fns", None) is None:
+            self._fused_fns = {}
+            self._fit_batch(group[0])   # carried-state structure warmup
+            group = group[1:]
+            if not group:
+                return
+        k = len(group)
+        if k not in self._fused_fns:
+            self._fused_fns[k] = self._build_fused_step(k)
+
+        def stack_tuple(get, present):
+            if not present:
+                return None
+            n_el = len(get(group[0]))
+            return tuple(
+                (jnp.stack([jnp.asarray(get(m)[i]) for m in group])
+                 if get(group[0])[i] is not None else None)
+                for i in range(n_el))
+
+        xs = tuple(jnp.stack([jnp.asarray(m.features[i]) for m in group])
+                   for i in range(len(group[0].features)))
+        ys = tuple(jnp.stack([jnp.asarray(m.labels[i]) for m in group])
+                   for i in range(len(group[0].labels)))
+        fms = stack_tuple(lambda m: m.features_masks,
+                          group[0].features_masks is not None)
+        lms = stack_tuple(lambda m: m.labels_masks,
+                          group[0].labels_masks is not None)
+        self._key, sub = jax.random.split(self._key)
+        (self.net_params, self.net_state, self.opt_states,
+         score) = self._fused_fns[k](
+            self.net_params, self.net_state, self.opt_states,
+            xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32), sub)
+        self._strip_rnn_state()
+        self._score = score
+        self.iteration += k
+        self.last_batch_size = group[0].num_examples() * k
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
 
     def _check_trace_token(self):
         """See MultiLayerNetwork._check_trace_token — retrace when the
@@ -361,6 +460,7 @@ class ComputationGraph:
             self._rnn_step_fn = None
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
+            self._fused_fns = None
 
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
