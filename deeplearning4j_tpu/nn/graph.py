@@ -401,8 +401,8 @@ class ComputationGraph:
             def mask_sig(ms):
                 return None if ms is None else tuple(
                     x is None for x in ms)
-            return (tuple(f.shape for f in m.features),
-                    tuple(l.shape for l in m.labels),
+            return (tuple((f.shape, f.dtype) for f in m.features),
+                    tuple((l.shape, l.dtype) for l in m.labels),
                     mask_sig(m.features_masks), mask_sig(m.labels_masks))
         if len({shape_sig(m) for m in group}) != 1:
             for m in group:

@@ -470,6 +470,7 @@ class MultiLayerNetwork:
     def _fit_fused_group(self, group):
         k = len(group)
         shapes = {(d.features.shape, d.labels.shape,
+                   d.features.dtype, d.labels.dtype,
                    d.features_mask is None, d.labels_mask is None)
                   for d in group}
         if len(shapes) != 1:

@@ -35,13 +35,21 @@ class ParallelWrapper:
     def __init__(self, model, mesh: Optional[Mesh] = None,
                  averaging_frequency: int = 1,
                  average_updaters: bool = True,
-                 prefetch_buffer: int = 4):
+                 prefetch_buffer: int = 4,
+                 fused_steps: int = 1):
+        """``fused_steps=K>1`` (all-reduce mode only) fuses K same-shape
+        sharded batches into ONE compiled lax.scan launch — the engine's
+        fit(fused_steps=K) dispatch elimination, composed with the
+        per-step gradient psum.  Same caveats: listeners fire once per
+        launch, ragged tails fall back per-step."""
         self.model = model
         self.mesh = mesh if mesh is not None else mesh_util.make_mesh()
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
         self.prefetch_buffer = prefetch_buffer
+        self.fused_steps = max(1, int(fused_steps))
         self._sharded_step = None
+        self._sharded_fused = None
         self._local_step = None
         self.n_data = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
 
@@ -91,8 +99,94 @@ class ParallelWrapper:
             return self._fit_allreduce(iterator, epochs)
         return self._fit_param_averaging(iterator, epochs)
 
-    def _fit_allreduce(self, iterator, epochs: int):
+    def _normalize_batch(self, ds, is_graph):
+        """(x, y, fm, lm) host pytrees trimmed to a data-degree multiple,
+        or None when the whole batch would be dropped."""
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        if is_graph and isinstance(ds, DataSet):
+            # ComputationGraph steps take TUPLES of inputs/labels
+            ds = MultiDataSet([ds.features], [ds.labels],
+                              [ds.features_mask], [ds.labels_mask])
+        n = ds.num_examples()
+        if n % self.n_data:
+            n_new = (n // self.n_data) * self.n_data
+            self._warn_remainder(n - n_new, n)
+            n = n_new
+            if n == 0:
+                return None
+        if isinstance(ds, MultiDataSet):
+            trim = lambda arrs: (  # noqa: E731
+                None if arrs is None else tuple(
+                    None if a is None else np.asarray(a)[:n] for a in arrs))
+            return (trim(ds.features), trim(ds.labels),
+                    trim(ds.features_masks), trim(ds.labels_masks)), n
+        return ((np.asarray(ds.features)[:n], np.asarray(ds.labels)[:n],
+                 None if ds.features_mask is None
+                 else np.asarray(ds.features_mask)[:n],
+                 None if ds.labels_mask is None
+                 else np.asarray(ds.labels_mask)[:n])), n
+
+    def _run_sharded_step(self, batch, n):
+        m = self.model
+        batch_sh = mesh_util.data_sharded(self.mesh)
+        x, y, fm, lm = jax.tree_util.tree_map(
+            lambda a: self._put_batch(a, batch_sh), batch)
+        m._key, sub = jax.random.split(m._key)
+        (m.net_params, m.net_state, m.opt_states, score) = self._sharded_step(
+            m.net_params, m.net_state, m.opt_states, x, y, fm, lm,
+            jnp.asarray(m.iteration, jnp.int32), sub)
+        m._strip_rnn_state()
+        m._score = score
+        m.last_batch_size = n
+        m.iteration += 1
+        for lst in m.listeners:
+            lst.iteration_done(m, m.iteration)
+
+    def _run_fused_group(self, group):
+        m = self.model
+        k = len(group)
+        if self._sharded_fused is None:
+            self._sharded_fused = {}
+            # structure warmup (carried-state keys) through one per-step
+            batch, n = group[0]
+            self._run_sharded_step(batch, n)
+            group = group[1:]
+            k = len(group)
+            if not k:
+                return
+        if k not in self._sharded_fused:
+            # the engine's own fused builder (MultiLayerNetwork/
+            # ComputationGraph._build_fused_step) IS the right program:
+            # params/opt/state are committed with their mesh shardings by
+            # _place() and the stacked batches carry the scan-axis
+            # sharding, so the jit composes the per-step psum with the
+            # scan without wrapper-side re-implementation
+            self._sharded_fused[k] = self.model._build_fused_step(k)
+        scan_sh = NamedSharding(self.mesh, P(None, ("data", "fsdp")))
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: self._put_batch(np.stack(leaves), scan_sh),
+            *[b for b, _ in group])
+        xs, ys, fms, lms = stacked
+        m._key, sub = jax.random.split(m._key)
+        (m.net_params, m.net_state, m.opt_states,
+         score) = self._sharded_fused[k](
+            m.net_params, m.net_state, m.opt_states, xs, ys, fms, lms,
+            jnp.asarray(m.iteration, jnp.int32), sub)
+        m._strip_rnn_state()
+        m._score = score
+        m.iteration += k
+        m.last_batch_size = group[0][1] * k
+        for lst in m.listeners:
+            lst.iteration_done(m, m.iteration)
+
+    @staticmethod
+    def _batch_sig(batch):
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        # dtype included: np.stack would silently promote a mixed-dtype
+        # group and train it at the promoted precision
+        return (treedef, tuple((a.shape, a.dtype) for a in leaves))
+
+    def _fit_allreduce(self, iterator, epochs: int):
         from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
         m = self.model
         is_graph = type(m).__name__ == "ComputationGraph"
@@ -101,50 +195,29 @@ class ParallelWrapper:
         if self._sharded_step is None:
             self._sharded_step = self._build_sharded_step()
             self._place()
-        batch_sh = mesh_util.data_sharded(self.mesh)
         it = AsyncDataSetIterator(iterator, queue_size=self.prefetch_buffer)
+        fuse = self.fused_steps
         for _ in range(epochs):
             it.reset()
+            pending = []
             while it.has_next():
-                ds = it.next()
-                # ComputationGraph steps take TUPLES of inputs/labels
-                # (MultiDataSet); normalize DataSet→MultiDataSet for it
-                if is_graph and isinstance(ds, DataSet):
-                    ds = MultiDataSet([ds.features], [ds.labels],
-                                      [ds.features_mask], [ds.labels_mask])
-                n = ds.num_examples()
-                if n % self.n_data:
-                    n_new = (n // self.n_data) * self.n_data
-                    self._warn_remainder(n - n_new, n)
-                    n = n_new
-                    if n == 0:
-                        continue
-                if isinstance(ds, MultiDataSet):
-                    put_all = lambda arrs: (  # noqa: E731
-                        None if arrs is None else tuple(
-                            None if a is None else
-                            self._put_batch(a[:n], batch_sh) for a in arrs))
-                    x = put_all(ds.features)
-                    y = put_all(ds.labels)
-                    fm = put_all(ds.features_masks)
-                    lm = put_all(ds.labels_masks)
+                norm = self._normalize_batch(it.next(), is_graph)
+                if norm is None:
+                    continue
+                if fuse > 1:
+                    if pending and self._batch_sig(pending[0][0]) != \
+                            self._batch_sig(norm[0]):
+                        for b, n in pending:   # mixed shapes: per-step
+                            self._run_sharded_step(b, n)
+                        pending = []
+                    pending.append(norm)
+                    if len(pending) == fuse:
+                        self._run_fused_group(pending)
+                        pending = []
                 else:
-                    x = self._put_batch(ds.features[:n], batch_sh)
-                    y = self._put_batch(ds.labels[:n], batch_sh)
-                    fm = (self._put_batch(ds.features_mask[:n], batch_sh)
-                          if ds.features_mask is not None else None)
-                    lm = (self._put_batch(ds.labels_mask[:n], batch_sh)
-                          if ds.labels_mask is not None else None)
-                m._key, sub = jax.random.split(m._key)
-                (m.net_params, m.net_state, m.opt_states, score) = self._sharded_step(
-                    m.net_params, m.net_state, m.opt_states, x, y, fm, lm,
-                    jnp.asarray(m.iteration, jnp.int32), sub)
-                m._strip_rnn_state()
-                m._score = score
-                m.last_batch_size = n
-                m.iteration += 1
-                for lst in m.listeners:
-                    lst.iteration_done(m, m.iteration)
+                    self._run_sharded_step(*norm)
+            for b, n in pending:
+                self._run_sharded_step(b, n)
         return m
 
     # ------------------------------------------------------------------

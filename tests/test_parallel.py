@@ -5,6 +5,7 @@ the reference's ParallelWrapperTest/ParallelInferenceTest pattern
 import threading
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -147,3 +148,29 @@ def test_tensor_parallel_model_axis():
         np.asarray(tp_net.params()), np.asarray(dp_net.params()),
         rtol=1e-4, atol=1e-5)
     assert np.isfinite(float(tp_net.score()))
+
+
+def test_allreduce_fused_steps_matches_per_step():
+    """ParallelWrapper(fused_steps=K) — K sharded batches per scan
+    launch — must take exactly the steps the per-step wrapper takes."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    rng = np.random.default_rng(9)
+    batches = []
+    for _ in range(7):
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        batches.append(DataSet(x, y))
+    a = _net(updater="adam", seed=3)
+    b = _net(updater="adam", seed=3)
+    b.init()
+    a.init()
+    b.net_params = jax.tree_util.tree_map(jnp.array, a.net_params)
+    mesh = make_mesh(MeshConfig(data=8))
+    ParallelWrapper(a, mesh).fit(ListDataSetIterator(list(batches)))
+    ParallelWrapper(b, mesh, fused_steps=3).fit(
+        ListDataSetIterator(list(batches)))
+    assert a.iteration == b.iteration == 7
+    for pa, pb in zip(a.net_params, b.net_params):
+        for k in pa:
+            np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
+                                       rtol=2e-5, atol=2e-6)
