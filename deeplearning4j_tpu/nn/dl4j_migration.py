@@ -323,9 +323,10 @@ _PREPROC_MAP = {
     "rnnToCnn": lambda j: pp.RnnToCnnPreProcessor(
         height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
         channels=int(j.get("numChannels", 0))),
-    "cnnToRnn": lambda j: pp.CnnToRnnPreProcessor(
-        height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
-        channels=int(j.get("numChannels", 0))),
+    # DL4J's CnnToRnn records h/w/c; our preprocessor only needs the
+    # timestep count, which the DL4J JSON doesn't carry (it derives T
+    # from the batch) — leave it None for runtime inference
+    "cnnToRnn": lambda j: pp.CnnToRnnPreProcessor(),
 }
 
 
@@ -696,6 +697,237 @@ def params_from_flat(layers: List[L.Layer],
         raise ValueError(f"coefficients.bin has {flat.size} params, "
                          f"layer specs consume {off}")
     return params, states
+
+
+# ---------------------------------------------------------------------------
+# Export TO the DL4J container format (the reverse direction)
+# ---------------------------------------------------------------------------
+
+# selu/gelu/swish post-date DL4J 0.8's IActivation set; exporting their
+# names keeps OUR round-trip exact (the importer substring-matches), a
+# Java 0.8 reader would reject those three
+_ACT_EXPORT = {"relu": "ReLU", "tanh": "TanH", "sigmoid": "Sigmoid",
+               "softmax": "Softmax", "identity": "Identity",
+               "leakyrelu": "LeakyReLU", "elu": "ELU", "cube": "Cube",
+               "softplus": "SoftPlus", "softsign": "SoftSign",
+               "hardtanh": "HardTanh", "hardsigmoid": "HardSigmoid",
+               "rationaltanh": "RationalTanh",
+               "rectifiedtanh": "RectifiedTanh", "selu": "SELU",
+               "gelu": "GELU", "swish": "Swish", "linear": "Identity"}
+
+_LOSS_EXPORT = {"mcxent": "LossMCXENT", "mse": "LossMSE", "l1": "LossL1",
+                "l2": "LossL2", "mae": "LossMAE", "xent": "LossBinaryXENT"}
+
+
+def _export_layer_json(layer: L.Layer, g: GlobalConf):
+    """(wrapper_type_name, layer_json) in the Jackson shape — inverse of
+    :func:`_build_layer` for the supported families."""
+    act = layer.activation or g.activation
+    if act not in _ACT_EXPORT:
+        raise ValueError(f"activation {act!r} has no DL4J export name")
+
+    def eff(field, gfield=None):
+        v = getattr(layer, field)
+        return v if v is not None else getattr(g, gfield or field)
+
+    j = {
+        "activationFn": {_ACT_EXPORT[act]: {}},
+        "weightInit": str(layer.weight_init or g.weight_init).upper(),
+        "learningRate": eff("learning_rate"),
+        "updater": str(layer.updater or g.updater).upper(),
+        "momentum": eff("momentum"),
+        "rho": eff("rho"),
+        "rmsDecay": eff("rms_decay"),
+        "adamMeanDecay": eff("adam_mean_decay"),
+        "adamVarDecay": eff("adam_var_decay"),
+        "l1": layer.l1 if layer.l1 else float("nan"),
+        "l2": layer.l2 if layer.l2 else float("nan"),
+        "l1Bias": layer.l1_bias if layer.l1_bias else float("nan"),
+        "l2Bias": layer.l2_bias if layer.l2_bias else float("nan"),
+        "dropOut": layer.dropout or 0.0,
+        "biasInit": layer.bias_init
+        if layer.bias_init is not None else g.bias_init,
+    }
+    eps = layer.epsilon if layer.epsilon is not None else g.epsilon
+    if eps is not None:
+        j["epsilon"] = eps
+    if layer.bias_learning_rate is not None:
+        j["biasLearningRate"] = layer.bias_learning_rate
+    gn = layer.gradient_normalization or g.gradient_normalization
+    if gn:
+        j["gradientNormalization"] = str(gn)
+        j["gradientNormalizationThreshold"] = (
+            layer.gradient_normalization_threshold
+            if layer.gradient_normalization_threshold is not None
+            else g.gradient_normalization_threshold)
+    if getattr(layer, "n_in", None):
+        j["nIn"] = int(layer.n_in)
+    if getattr(layer, "n_out", None):
+        j["nOut"] = int(layer.n_out)
+    if isinstance(layer, L.ConvolutionLayer):
+        j.update(kernelSize=list(layer.kernel), stride=list(layer.stride),
+                 padding=list(layer.padding),
+                 convolutionMode="Same" if layer.convolution_mode == "same"
+                 else "Truncate")
+        return "convolution", j
+    if isinstance(layer, L.SubsamplingLayer):
+        j.pop("activationFn", None)
+        j.update(poolingType=layer.pooling_type.upper(),
+                 kernelSize=list(layer.kernel), stride=list(layer.stride),
+                 padding=list(layer.padding))
+        return "subsampling", j
+    if isinstance(layer, L.BatchNormalization):
+        j.update(decay=layer.decay, eps=layer.eps,
+                 lockGammaBeta=layer.lock_gamma_beta,
+                 nOut=int(layer.n_features or 0),
+                 nIn=int(layer.n_features or 0))
+        return "batchNormalization", j
+    if isinstance(layer, L.GravesLSTM):
+        if layer.gate_activation not in _ACT_EXPORT:
+            raise ValueError(f"gate activation {layer.gate_activation!r} "
+                             f"has no DL4J export name")
+        j.update(forgetGateBiasInit=layer.forget_gate_bias_init,
+                 gateActivationFn={_ACT_EXPORT[layer.gate_activation]: {}})
+        return "gravesLSTM", j
+    if isinstance(layer, L.RnnOutputLayer):
+        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        return "rnnoutput", j
+    if isinstance(layer, L.OutputLayer):
+        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        return "output", j
+    if isinstance(layer, L.LossLayer):
+        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        return "loss", j
+    if isinstance(layer, L.EmbeddingLayer):
+        return "embedding", j
+    if isinstance(layer, L.DenseLayer):
+        return "dense", j
+    if isinstance(layer, L.ActivationLayer):
+        return "activation", j
+    if isinstance(layer, L.DropoutLayer):
+        return "dropout", j
+    if isinstance(layer, L.GlobalPoolingLayer):
+        j.pop("activationFn", None)
+        j["poolingType"] = layer.pooling_type.upper()
+        return "GlobalPooling", j
+    if isinstance(layer, L.ZeroPaddingLayer):
+        j.pop("activationFn", None)
+        j["padding"] = list(layer.padding)
+        return "zeroPadding", j
+    raise ValueError(f"layer {type(layer).__name__} has no DL4J export "
+                     f"mapping")
+
+
+def _export_preprocessor(proc) -> dict:
+    """Our InputPreProcessor → the Jackson wrapper-object form (inverse
+    of _PREPROC_MAP).  Raises for shapes with no DL4J mapping — a
+    silently dropped preprocessor would export a zip that reshapes
+    wrongly on restore."""
+    hwc = lambda p: {"inputHeight": p.height, "inputWidth": p.width,  # noqa: E731
+                     "numChannels": p.channels}
+    if isinstance(proc, pp.CnnToFeedForwardPreProcessor):
+        return {"cnnToFeedForward": hwc(proc)}
+    if isinstance(proc, pp.FeedForwardToCnnPreProcessor):
+        return {"feedForwardToCnn": hwc(proc)}
+    if isinstance(proc, pp.RnnToFeedForwardPreProcessor):
+        return {"rnnToFeedForward": {}}
+    if isinstance(proc, pp.FeedForwardToRnnPreProcessor):
+        return {"feedForwardToRnn": {}}
+    if isinstance(proc, pp.CnnToRnnPreProcessor):
+        return {"cnnToRnn": {}}
+    if isinstance(proc, pp.RnnToCnnPreProcessor):
+        return {"rnnToCnn": hwc(proc)}
+    raise ValueError(f"preprocessor {type(proc).__name__} has no DL4J "
+                     f"export mapping")
+
+
+def _flatten_layer_params(layer: L.Layer, lp: Dict, ls: Dict) -> np.ndarray:
+    """Inverse of the :func:`params_from_flat` slicing for one layer:
+    emit views in DL4J order with the per-view ravel order."""
+    spec = _layer_param_spec(layer)
+    chunks = []
+    for name, shape, n, order in spec:
+        if name == "RW+p":
+            H = shape[0]
+            m = np.zeros(shape, np.float32)
+            m[:, :4 * H] = np.asarray(lp["RW"])
+            m[:, 4 * H] = np.asarray(lp["pF"])
+            m[:, 4 * H + 1] = np.asarray(lp["pO"])
+            m[:, 4 * H + 2] = np.asarray(lp["pI"])
+            chunks.append(np.ravel(m, order=order))
+        elif name in ("mean", "var"):
+            chunks.append(np.ravel(np.asarray(ls[name]), order=order))
+        else:
+            chunks.append(np.ravel(np.asarray(lp[name]), order=order))
+    return np.concatenate(chunks) if chunks else np.empty(0, np.float32)
+
+
+def export_multi_layer_network(net, path) -> None:
+    """Write ``net`` as a zip in the ORIGINAL DL4J's container format
+    (configuration.json in the Jackson schema + coefficients.bin in the
+    legacy Nd4j.write format, util/ModelSerializer.java:79-120) so the
+    params survive a round-trip through :func:`restore_multi_layer_network`
+    bit-for-bit — and follow the documented layouts a Java DL4J reader
+    replays.  updaterState is not written (layout unverifiable, see
+    restore)."""
+    import dataclasses as _dc
+    conf = net.conf
+    g = conf.global_conf
+    # merge_layer_conf already zeroed per-layer l1/l2 when the flag was
+    # off, so any surviving nonzero value implies regularization is live
+    use_reg = bool(g.use_regularization or any(
+        (lv.l1 or lv.l2 or lv.l1_bias or lv.l2_bias)
+        for lv in conf.layers if not isinstance(lv, L.FrozenLayerConf)))
+    confs = []
+    inners = []
+    for layer, lp, ls in zip(conf.layers, net.net_params, net.net_state):
+        inner = layer._inner() if isinstance(layer, L.FrozenLayerConf) \
+            else layer  # NOTE: DL4J 0.8 has no FrozenLayer JSON type —
+        # frozen status does not survive export
+        if isinstance(inner, L.BatchNormalization) and not inner.n_features:
+            # conf-level n_features may be inferred at init; the running
+            # stats carry the realized width
+            inner = _dc.replace(inner,
+                                n_features=int(ls["mean"].shape[0]))
+        if getattr(inner, "n_in", None) in (None, 0) and "W" in lp:
+            # n_in is usually inferred at init; the weights carry it
+            W = lp["W"]
+            n_in = int(W.shape[1] if isinstance(inner, L.ConvolutionLayer)
+                       else W.shape[0])
+            inner = _dc.replace(inner, n_in=n_in)
+        inners.append(inner)
+        tname, lj = _export_layer_json(inner, g)
+        confs.append({
+            "layer": {tname: lj},
+            "miniBatch": g.mini_batch, "seed": g.seed,
+            "minimize": g.minimize,
+            "useRegularization": use_reg,
+            "pretrain": False,
+        })
+    top = {
+        "backprop": conf.backprop, "pretrain": conf.pretrain,
+        "backpropType": ("TruncatedBPTT"
+                         if conf.backprop_type == "truncatedbptt"
+                         else "Standard"),
+        "tbpttFwdLength": conf.tbptt_fwd_length,
+        "tbpttBackLength": conf.tbptt_back_length,
+        "inputPreProcessors": {
+            str(i): w for i, w in
+            ((i, _export_preprocessor(p))
+             for i, p in (conf.preprocessors or {}).items())
+            if w is not None},
+        "confs": confs,
+    }
+    flats = []
+    for inner, lp, ls in zip(inners, net.net_params, net.net_state):
+        flats.append(_flatten_layer_params(inner, lp, ls))
+    flat = (np.concatenate([f for f in flats if f.size])
+            if any(f.size for f in flats) else np.empty(0, np.float32))
+    buf = io.BytesIO()
+    write_nd4j_array(buf, flat.reshape(1, -1), order="f")
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(top, indent=2))
+        zf.writestr("coefficients.bin", buf.getvalue())
 
 
 # ---------------------------------------------------------------------------

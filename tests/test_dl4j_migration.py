@@ -340,3 +340,146 @@ class TestComputationGraphMigration:
             zout.writestr("coefficients.bin", buf.getvalue())
         with pytest.raises(ValueError):
             mig.restore_computation_graph(p)
+
+
+class TestExportToDl4j:
+    """The reverse direction: export_multi_layer_network writes the DL4J
+    container format; a round-trip through the independent import path
+    (which replays the Java initializer layouts) must be exact."""
+
+    def _roundtrip(self, net, x):
+        import tempfile
+        out_before = np.asarray(net.output(x))
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "exported.zip"
+            mig.export_multi_layer_network(net, p)
+            back = mig.restore_multi_layer_network(p)
+        for lp_a, lp_b in zip(net.net_params, back.net_params):
+            assert set(lp_a) == set(lp_b)
+            for k in lp_a:
+                np.testing.assert_array_equal(
+                    np.asarray(lp_a[k], np.float32), np.asarray(lp_b[k]),
+                    err_msg=k)
+        np.testing.assert_allclose(np.asarray(back.output(x)), out_before,
+                                   rtol=1e-6, atol=1e-7)
+        return back
+
+    def test_dense_output_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(3).learning_rate(0.2).updater("nesterovs")
+             .regularization(True).l2(0.01)
+             .list()
+             .layer(DenseLayer(n_in=5, n_out=7, activation="relu"))
+             .layer(OutputLayer(n_out=4, activation="softmax",
+                                loss="mcxent"))
+             .build())).init()
+        x = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+        back = self._roundtrip(net, x)
+        assert back.conf.layers[0].updater == "nesterovs"
+        assert back.conf.layers[0].l2 == 0.01
+        assert back.conf.global_conf.learning_rate == 0.2
+
+    def test_conv_bn_stack_roundtrip(self):
+        """Exercises the conv bias-first/'c'-order views and BN
+        state-in-params placement in BOTH directions."""
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            BatchNormalization, ConvolutionLayer, DenseLayer, OutputLayer,
+            SubsamplingLayer)
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(4).learning_rate(0.05).updater("adam")
+             .list()
+             .layer(ConvolutionLayer(n_out=6, kernel=(3, 3),
+                                     activation="relu"))
+             .layer(BatchNormalization())
+             .layer(SubsamplingLayer(kernel=(2, 2), stride=(2, 2)))
+             .layer(DenseLayer(n_out=10, activation="tanh"))
+             .layer(OutputLayer(n_out=3, activation="softmax",
+                                loss="mcxent"))
+             .set_input_type(InputType.convolutional(8, 8, 2))
+             .build())).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 2, 8, 8)).astype(np.float32)
+        net.fit(x, np.eye(3, dtype=np.float32)[[0, 1]])  # move BN stats
+        back = self._roundtrip(net, x)
+        np.testing.assert_array_equal(
+            np.asarray(net.net_state[1]["mean"], np.float32),
+            np.asarray(back.net_state[1]["mean"]))
+
+    def test_lstm_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.layers import (GravesLSTM,
+                                                       RnnOutputLayer)
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(6).learning_rate(0.1).updater("sgd")
+             .list()
+             .layer(GravesLSTM(n_in=4, n_out=5))
+             .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+             .build())).init()
+        # make peepholes nonzero so the RW+p recombination is exercised
+        lp = dict(net.net_params[0])
+        rng = np.random.default_rng(2)
+        for k in ("pI", "pF", "pO"):
+            lp[k] = rng.normal(size=lp[k].shape).astype(np.float32)
+        net.net_params[0] = lp
+        x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+        self._roundtrip(net, x)
+
+    def test_updater_hyperparams_survive_roundtrip(self):
+        """rho/rmsDecay/adam betas/epsilon/grad-clipping must survive, or
+        resumed fine-tuning silently uses different optimizer settings
+        (round-4 review)."""
+        import tempfile
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).learning_rate(0.05).updater("rmsprop")
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=4, activation="selu",
+                                  rms_decay=0.8))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        conf.layers[0] = __import__("dataclasses").replace(
+            conf.layers[0], gradient_normalization="clipl2pergradient",
+            gradient_normalization_threshold=0.7)
+        net = MultiLayerNetwork(conf).init()
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "rt.zip"
+            mig.export_multi_layer_network(net, p)
+            back = mig.restore_multi_layer_network(p)
+        l0 = back.conf.layers[0]
+        assert l0.activation == "selu"       # not swallowed into sigmoid
+        assert l0.updater == "rmsprop" and l0.rms_decay == 0.8
+        assert l0.gradient_normalization == "clipl2pergradient"
+        assert l0.gradient_normalization_threshold == 0.7
+
+    def test_unsupported_preprocessor_raises(self):
+        import tempfile
+        from deeplearning4j_tpu.nn.conf import preprocessors as ppm
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+             .updater("sgd").list()
+             .layer(DenseLayer(n_in=3, n_out=4, activation="tanh"))
+             .layer(OutputLayer(n_out=2, activation="softmax",
+                                loss="mcxent"))
+             .build())).init()
+        net.conf.preprocessors = {1: ppm.ComposableInputPreProcessor()}
+        with tempfile.TemporaryDirectory() as td:
+            with pytest.raises(ValueError, match="no DL4J export"):
+                mig.export_multi_layer_network(
+                    net, pathlib.Path(td) / "x.zip")

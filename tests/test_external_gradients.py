@@ -172,7 +172,7 @@ def reg_mlp(minimize=True):
     return MultiLayerNetwork(
         (NeuralNetConfiguration.builder()
          .seed(7).learning_rate(0.1).updater("sgd")
-         .l2(0.02).l1(0.005)
+         .regularization(True).l2(0.02).l1(0.005)
          .minimize(minimize)
          .list()
          .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
@@ -215,7 +215,8 @@ class TestExternalGradientsRegularization:
             def build():
                 conf = (GraphBuilder(GlobalConf(
                             seed=3, learning_rate=0.05, updater="sgd",
-                            l2=0.03, minimize=minimize))
+                            l2=0.03, use_regularization=True,
+                            minimize=minimize))
                         .add_inputs("in")
                         .add_layer("h", DenseLayer(n_in=4, n_out=6,
                                                    activation="tanh"), "in")
