@@ -157,9 +157,18 @@ def _parse_activation(v, default: str = "sigmoid") -> str:
     return default
 
 
-_LOSS_MAP = {"mcxent": "mcxent", "negativeloglikelihood": "mcxent",
-             "xent": "xent", "mse": "mse", "l2": "l2", "l1": "l1",
-             "mae": "mae", "squared_loss": "mse", "cosine": "mse"}
+# checked longest-key-first so e.g. squaredhinge beats hinge and
+# negativeloglikelihood beats l1/l2 substrings
+_LOSS_MAP = {"negativeloglikelihood": "negativeloglikelihood",
+             "squaredhinge": "squared_hinge",
+             "cosineproximity": "cosine_proximity",
+             "kldivergence": "kl_divergence", "kld": "kl_divergence",
+             "poisson": "poisson", "hinge": "hinge",
+             "mcxent": "mcxent", "msle": "msle", "mape": "mape",
+             "xent": "xent", "mse": "mse", "mae": "mae",
+             "l2": "l2", "l1": "l1",
+             "squared_loss": "mse", "cosine": "cosine_proximity"}
+_LOSS_KEYS_BY_LEN = sorted(_LOSS_MAP, key=len, reverse=True)
 
 
 def _parse_loss(layer_json: dict, default: str = "mse") -> str:
@@ -169,9 +178,9 @@ def _parse_loss(layer_json: dict, default: str = "mse") -> str:
     if isinstance(v, dict):
         v = v.get("@class") or next(iter(v), "")
     s = str(v).lower().replace("loss", "")
-    for k, ours in _LOSS_MAP.items():
+    for k in _LOSS_KEYS_BY_LEN:
         if k in s:
-            return ours
+            return _LOSS_MAP[k]
     return default
 
 
@@ -264,6 +273,7 @@ def _build_layer(type_name: str, j: dict) -> L.Layer:
             kernel=_ints(j.get("kernelSize"), (3, 3)),
             stride=_ints(j.get("stride"), (1, 1)),
             padding=_ints(j.get("padding"), (0, 0)),
+            dilation=_ints(j.get("dilation"), (1, 1)),
             convolution_mode=str(j.get("convolutionMode",
                                        "truncate")).lower(), **kw)
     if t == "subsampling":
@@ -273,7 +283,10 @@ def _build_layer(type_name: str, j: dict) -> L.Layer:
             pooling_type=str(j.get("poolingType", "max")).lower(),
             kernel=_ints(j.get("kernelSize"), (2, 2)),
             stride=_ints(j.get("stride"), (2, 2)),
-            padding=_ints(j.get("padding"), (0, 0)), **kw)
+            padding=_ints(j.get("padding"), (0, 0)),
+            pnorm=int(j.get("pnorm", 2) or 2),
+            convolution_mode=str(j.get("convolutionMode",
+                                       "truncate")).lower(), **kw)
     if t == "batchNormalization":
         kw.pop("n_in", None)
         # DL4J BN applies NO activation regardless of the recorded
@@ -323,10 +336,14 @@ _PREPROC_MAP = {
     "rnnToCnn": lambda j: pp.RnnToCnnPreProcessor(
         height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
         channels=int(j.get("numChannels", 0))),
-    # DL4J's CnnToRnn records h/w/c; our preprocessor only needs the
-    # timestep count, which the DL4J JSON doesn't carry (it derives T
-    # from the batch) — leave it None for runtime inference
-    "cnnToRnn": lambda j: pp.CnnToRnnPreProcessor(),
+    # DL4J's CnnToRnn derives T from the runtime minibatch; our
+    # preprocessor needs it up front — fail AT LOAD with instructions
+    # rather than with a bare TypeError at the first forward
+    "cnnToRnn": lambda j: (_ for _ in ()).throw(ValueError(
+        "cnnToRnn preprocessor migration needs an explicit timestep "
+        "count: restore with load_params=False is not enough — build "
+        "CnnToRnnPreProcessor(timesteps=T) and set it on "
+        "conf.preprocessors after restore, or edit the zip")),
 }
 
 
@@ -716,7 +733,19 @@ _ACT_EXPORT = {"relu": "ReLU", "tanh": "TanH", "sigmoid": "Sigmoid",
                "gelu": "GELU", "swish": "Swish", "linear": "Identity"}
 
 _LOSS_EXPORT = {"mcxent": "LossMCXENT", "mse": "LossMSE", "l1": "LossL1",
-                "l2": "LossL2", "mae": "LossMAE", "xent": "LossBinaryXENT"}
+                "l2": "LossL2", "mae": "LossMAE", "xent": "LossBinaryXENT",
+                "negativeloglikelihood": "LossNegativeLogLikelihood",
+                "hinge": "LossHinge", "squared_hinge": "LossSquaredHinge",
+                "poisson": "LossPoisson", "kl_divergence": "LossKLD",
+                "msle": "LossMSLE", "mape": "LossMAPE",
+                "cosine_proximity": "LossCosineProximity",
+                "squared_loss": "LossMSE"}
+
+
+def _loss_export(name: str) -> dict:
+    if name not in _LOSS_EXPORT:
+        raise ValueError(f"loss {name!r} has no DL4J export name")
+    return {_LOSS_EXPORT[name]: {}}
 
 
 def _export_layer_json(layer: L.Layer, g: GlobalConf):
@@ -767,6 +796,7 @@ def _export_layer_json(layer: L.Layer, g: GlobalConf):
     if isinstance(layer, L.ConvolutionLayer):
         j.update(kernelSize=list(layer.kernel), stride=list(layer.stride),
                  padding=list(layer.padding),
+                 dilation=list(layer.dilation),
                  convolutionMode="Same" if layer.convolution_mode == "same"
                  else "Truncate")
         return "convolution", j
@@ -774,7 +804,9 @@ def _export_layer_json(layer: L.Layer, g: GlobalConf):
         j.pop("activationFn", None)
         j.update(poolingType=layer.pooling_type.upper(),
                  kernelSize=list(layer.kernel), stride=list(layer.stride),
-                 padding=list(layer.padding))
+                 padding=list(layer.padding), pnorm=layer.pnorm,
+                 convolutionMode="Same" if layer.convolution_mode == "same"
+                 else "Truncate")
         return "subsampling", j
     if isinstance(layer, L.BatchNormalization):
         j.update(decay=layer.decay, eps=layer.eps,
@@ -790,13 +822,13 @@ def _export_layer_json(layer: L.Layer, g: GlobalConf):
                  gateActivationFn={_ACT_EXPORT[layer.gate_activation]: {}})
         return "gravesLSTM", j
     if isinstance(layer, L.RnnOutputLayer):
-        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        j["lossFn"] = _loss_export(layer.loss)
         return "rnnoutput", j
     if isinstance(layer, L.OutputLayer):
-        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        j["lossFn"] = _loss_export(layer.loss)
         return "output", j
     if isinstance(layer, L.LossLayer):
-        j["lossFn"] = {_LOSS_EXPORT.get(layer.loss, "LossMSE"): {}}
+        j["lossFn"] = _loss_export(layer.loss)
         return "loss", j
     if isinstance(layer, L.EmbeddingLayer):
         return "embedding", j
@@ -912,10 +944,8 @@ def export_multi_layer_network(net, path) -> None:
         "tbpttFwdLength": conf.tbptt_fwd_length,
         "tbpttBackLength": conf.tbptt_back_length,
         "inputPreProcessors": {
-            str(i): w for i, w in
-            ((i, _export_preprocessor(p))
-             for i, p in (conf.preprocessors or {}).items())
-            if w is not None},
+            str(i): _export_preprocessor(p)
+            for i, p in (conf.preprocessors or {}).items()},
         "confs": confs,
     }
     flats = []

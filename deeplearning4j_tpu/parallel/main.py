@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "N>1 = reference parameter-averaging compat")
     p.add_argument("--no-average-updaters", action="store_true")
     p.add_argument("--prefetch-size", type=int, default=4)
+    p.add_argument("--fused-steps", type=int, default=1,
+                   help="K>1 fuses K same-shape batches into one compiled "
+                        "lax.scan launch (all-reduce mode only)")
     p.add_argument("--workers-per-axis", nargs="*", default=[],
                    metavar="AXIS=N",
                    help="mesh layout, e.g. data=4 fsdp=2 seq=1")
@@ -60,7 +63,8 @@ def main(argv=None) -> int:
         model, mesh,
         averaging_frequency=args.averaging_frequency,
         average_updaters=not args.no_average_updaters,
-        prefetch_buffer=args.prefetch_size)
+        prefetch_buffer=args.prefetch_size,
+        fused_steps=args.fused_steps)
     it = PathDataSetIterator.from_dir(args.data_dir)
     wrapper.fit(it, epochs=args.epochs)
 

@@ -82,7 +82,8 @@ class TestConfigParsing:
         assert mig._parse_loss({"lossFn": {"LossMCXENT": {}}}) == "mcxent"
         assert mig._parse_loss({"lossFunction": "MCXENT"}) == "mcxent"
         assert mig._parse_loss(
-            {"lossFunction": "NEGATIVELOGLIKELIHOOD"}) == "mcxent"
+            {"lossFunction": "NEGATIVELOGLIKELIHOOD"}) == \
+            "negativeloglikelihood"
         assert mig._parse_loss({"lossFn": {"LossMSE": {}}}) == "mse"
 
     def test_non_dl4j_zip_rejected(self, tmp_path):

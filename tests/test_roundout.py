@@ -48,7 +48,8 @@ def test_parallel_wrapper_cli(tmp_path):
     out_path = str(tmp_path / "trained.zip")
     rc = main(["--model-path", model_path, "--data-dir", str(data_dir),
                "--output-path", out_path, "--epochs", "5",
-               "--workers-per-axis", "data=8", "--report-score"])
+               "--workers-per-axis", "data=8", "--fused-steps", "2",
+               "--report-score"])
     assert rc == 0
     trained = load_model(out_path)
     ds = _tiny_data(96)
