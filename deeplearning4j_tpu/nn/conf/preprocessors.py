@@ -116,6 +116,12 @@ class CnnToRnnPreProcessor(InputPreProcessor):
 
     def __call__(self, x, mask=None):
         t = self.timesteps
+        if t is None:
+            raise ValueError(
+                "CnnToRnnPreProcessor needs an explicit timestep count "
+                "(DL4J derives it from the runtime minibatch; e.g. a "
+                "migrated zip imports with timesteps=None) — set "
+                "CnnToRnnPreProcessor(timesteps=T) on conf.preprocessors")
         flat = x.reshape(x.shape[0], -1)
         return flat.reshape(-1, t, flat.shape[-1]), mask
 

@@ -157,8 +157,8 @@ def _parse_activation(v, default: str = "sigmoid") -> str:
     return default
 
 
-# checked longest-key-first so e.g. squaredhinge beats hinge and
-# negativeloglikelihood beats l1/l2 substrings
+# matched against the name lowercased with "loss"/"_" stripped, longest
+# key first, so SQUARED_HINGE beats hinge and KL_DIVERGENCE resolves
 _LOSS_MAP = {"negativeloglikelihood": "negativeloglikelihood",
              "squaredhinge": "squared_hinge",
              "cosineproximity": "cosine_proximity",
@@ -167,7 +167,7 @@ _LOSS_MAP = {"negativeloglikelihood": "negativeloglikelihood",
              "mcxent": "mcxent", "msle": "msle", "mape": "mape",
              "xent": "xent", "mse": "mse", "mae": "mae",
              "l2": "l2", "l1": "l1",
-             "squared_loss": "mse", "cosine": "cosine_proximity"}
+             "squared": "mse", "cosine": "cosine_proximity"}
 _LOSS_KEYS_BY_LEN = sorted(_LOSS_MAP, key=len, reverse=True)
 
 
@@ -177,7 +177,7 @@ def _parse_loss(layer_json: dict, default: str = "mse") -> str:
         return default
     if isinstance(v, dict):
         v = v.get("@class") or next(iter(v), "")
-    s = str(v).lower().replace("loss", "")
+    s = str(v).lower().replace("loss", "").replace("_", "")
     for k in _LOSS_KEYS_BY_LEN:
         if k in s:
             return _LOSS_MAP[k]
@@ -336,14 +336,12 @@ _PREPROC_MAP = {
     "rnnToCnn": lambda j: pp.RnnToCnnPreProcessor(
         height=int(j.get("inputHeight", 0)), width=int(j.get("inputWidth", 0)),
         channels=int(j.get("numChannels", 0))),
-    # DL4J's CnnToRnn derives T from the runtime minibatch; our
-    # preprocessor needs it up front — fail AT LOAD with instructions
-    # rather than with a bare TypeError at the first forward
-    "cnnToRnn": lambda j: (_ for _ in ()).throw(ValueError(
-        "cnnToRnn preprocessor migration needs an explicit timestep "
-        "count: restore with load_params=False is not enough — build "
-        "CnnToRnnPreProcessor(timesteps=T) and set it on "
-        "conf.preprocessors after restore, or edit the zip")),
+    # DL4J's CnnToRnn derives T from the runtime minibatch; ours needs
+    # it up front.  Import with timesteps=None — the preprocessor itself
+    # raises with instructions at first use, so the restore succeeds and
+    # the user can attach CnnToRnnPreProcessor(timesteps=T) to
+    # conf.preprocessors before running the net
+    "cnnToRnn": lambda j: pp.CnnToRnnPreProcessor(),
 }
 
 
@@ -742,7 +740,16 @@ _LOSS_EXPORT = {"mcxent": "LossMCXENT", "mse": "LossMSE", "l1": "LossL1",
                 "squared_loss": "LossMSE"}
 
 
+_LOSS_CANON = {"nll": "negativeloglikelihood",
+               "mean_absolute_error": "mae",
+               "mean_absolute_percentage_error": "mape",
+               "mean_squared_logarithmic_error": "msle",
+               "reconstruction_crossentropy": "xent",
+               "squared_loss": "mse"}
+
+
 def _loss_export(name: str) -> dict:
+    name = _LOSS_CANON.get(name, name)  # registry aliases (ops/losses.py)
     if name not in _LOSS_EXPORT:
         raise ValueError(f"loss {name!r} has no DL4J export name")
     return {_LOSS_EXPORT[name]: {}}

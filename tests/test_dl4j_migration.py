@@ -484,3 +484,28 @@ class TestExportToDl4j:
             with pytest.raises(ValueError, match="no DL4J export"):
                 mig.export_multi_layer_network(
                     net, pathlib.Path(td) / "x.zip")
+
+    def test_underscore_enum_loss_names(self):
+        assert mig._parse_loss(
+            {"lossFunction": "SQUARED_HINGE"}) == "squared_hinge"
+        assert mig._parse_loss(
+            {"lossFunction": "KL_DIVERGENCE"}) == "kl_divergence"
+        assert mig._parse_loss({"lossFunction": "SQUARED_LOSS"}) == "mse"
+
+    def test_loss_alias_export(self):
+        assert mig._loss_export("nll") == \
+            {"LossNegativeLogLikelihood": {}}
+        assert mig._loss_export("mean_absolute_error") == {"LossMAE": {}}
+        with pytest.raises(ValueError, match="no DL4J export"):
+            mig._loss_export("not_a_loss")
+
+    def test_cnn_to_rnn_imports_and_raises_at_use(self):
+        from deeplearning4j_tpu.nn.conf import preprocessors as ppm
+        proc = mig._PREPROC_MAP["cnnToRnn"]({})
+        assert isinstance(proc, ppm.CnnToRnnPreProcessor)
+        with pytest.raises(ValueError, match="timestep count"):
+            proc(np.zeros((4, 2, 3, 3), np.float32))
+        # the documented remedy works
+        fixed = ppm.CnnToRnnPreProcessor(timesteps=2)
+        out, _ = fixed(np.zeros((4, 2, 3, 3), np.float32))
+        assert out.shape == (2, 2, 18)
