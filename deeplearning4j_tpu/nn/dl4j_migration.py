@@ -256,7 +256,8 @@ def _common_kwargs(j: dict, default_activation: str = "sigmoid") -> dict:
 
 def _build_layer(type_name: str, j: dict) -> L.Layer:
     kw = _common_kwargs(
-        j, default_activation="tanh" if type_name == "gravesLSTM"
+        j, default_activation="tanh"
+        if type_name in ("gravesLSTM", "gravesBidirectionalLSTM")
         else "sigmoid")
     t = type_name
     if t == "dense":
@@ -298,6 +299,11 @@ def _build_layer(type_name: str, j: dict) -> L.Layer:
             decay=_num(j.get("decay"), 0.9), eps=_num(j.get("eps"), 1e-5),
             lock_gamma_beta=bool(j.get("lockGammaBeta", False)),
             n_features=n_out, **kw)
+    if t == "gravesBidirectionalLSTM":
+        return L.GravesBidirectionalLSTM(
+            forget_gate_bias_init=_num(j.get("forgetGateBiasInit"), 1.0),
+            gate_activation=_parse_activation(j.get("gateActivationFn"),
+                                              "sigmoid"), **kw)
     if t == "gravesLSTM":
         return L.GravesLSTM(
             forget_gate_bias_init=_num(j.get("forgetGateBiasInit"), 1.0),
@@ -664,6 +670,16 @@ def _layer_param_spec(layer: L.Layer):
         spec = [] if layer.lock_gamma_beta else [("gamma", (n,), n, "F"),
                                                  ("beta", (n,), n, "F")]
         return spec + [("mean", (n,), n, "F"), ("var", (n,), n, "F")]
+    if isinstance(layer, L.GravesBidirectionalLSTM):
+        # forward block then backward block, each (W, RW+peepholes, b)
+        # (nn/params/GravesBidirectionalLSTMParamInitializer.java:92-106)
+        n_in, H = layer.n_in, layer.n_out
+        out = []
+        for pre in ("f_", "b_"):
+            out += [(pre + "W", (n_in, 4 * H), n_in * 4 * H, "F"),
+                    (pre + "RW+p", (H, 4 * H + 3), H * (4 * H + 3), "F"),
+                    (pre + "b", (4 * H,), 4 * H, "F")]
+        return out
     if isinstance(layer, L.GravesLSTM):
         n_in, H = layer.n_in, layer.n_out
         return [("W", (n_in, 4 * H), n_in * 4 * H, "F"),
@@ -693,15 +709,16 @@ def params_from_flat(layers: List[L.Layer],
                     f"need {off + n}, have {flat.size}")
             view = flat[off:off + n]
             off += n
-            if name == "RW+p":
+            if name.endswith("RW+p"):
+                pre = name[:-len("RW+p")]
                 m = np.reshape(view, shape, order=order)
                 H = shape[0]
-                lp["RW"] = m[:, :4 * H]
+                lp[pre + "RW"] = m[:, :4 * H]
                 # peephole cols: wFF, wOO, wGG (LSTMHelpers.java:62);
                 # wGG→pI is documented divergence (module docstring)
-                lp["pF"] = m[:, 4 * H]
-                lp["pO"] = m[:, 4 * H + 1]
-                lp["pI"] = m[:, 4 * H + 2]
+                lp[pre + "pF"] = m[:, 4 * H]
+                lp[pre + "pO"] = m[:, 4 * H + 1]
+                lp[pre + "pI"] = m[:, 4 * H + 2]
             elif name in ("mean", "var"):
                 ls[name] = view.copy()
             else:
@@ -821,13 +838,15 @@ def _export_layer_json(layer: L.Layer, g: GlobalConf):
                  nOut=int(layer.n_features or 0),
                  nIn=int(layer.n_features or 0))
         return "batchNormalization", j
-    if isinstance(layer, L.GravesLSTM):
+    if isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM)):
         if layer.gate_activation not in _ACT_EXPORT:
             raise ValueError(f"gate activation {layer.gate_activation!r} "
                              f"has no DL4J export name")
         j.update(forgetGateBiasInit=layer.forget_gate_bias_init,
                  gateActivationFn={_ACT_EXPORT[layer.gate_activation]: {}})
-        return "gravesLSTM", j
+        return ("gravesBidirectionalLSTM"
+                if isinstance(layer, L.GravesBidirectionalLSTM)
+                else "gravesLSTM"), j
     if isinstance(layer, L.RnnOutputLayer):
         j["lossFn"] = _loss_export(layer.loss)
         return "rnnoutput", j
@@ -886,13 +905,14 @@ def _flatten_layer_params(layer: L.Layer, lp: Dict, ls: Dict) -> np.ndarray:
     spec = _layer_param_spec(layer)
     chunks = []
     for name, shape, n, order in spec:
-        if name == "RW+p":
+        if name.endswith("RW+p"):
+            pre = name[:-len("RW+p")]
             H = shape[0]
             m = np.zeros(shape, np.float32)
-            m[:, :4 * H] = np.asarray(lp["RW"])
-            m[:, 4 * H] = np.asarray(lp["pF"])
-            m[:, 4 * H + 1] = np.asarray(lp["pO"])
-            m[:, 4 * H + 2] = np.asarray(lp["pI"])
+            m[:, :4 * H] = np.asarray(lp[pre + "RW"])
+            m[:, 4 * H] = np.asarray(lp[pre + "pF"])
+            m[:, 4 * H + 1] = np.asarray(lp[pre + "pO"])
+            m[:, 4 * H + 2] = np.asarray(lp[pre + "pI"])
             chunks.append(np.ravel(m, order=order))
         elif name in ("mean", "var"):
             chunks.append(np.ravel(np.asarray(ls[name]), order=order))

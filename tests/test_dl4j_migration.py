@@ -509,3 +509,31 @@ class TestExportToDl4j:
         fixed = ppm.CnnToRnnPreProcessor(timesteps=2)
         out, _ = fixed(np.zeros((4, 2, 3, 3), np.float32))
         assert out.shape == (2, 2, 18)
+
+    def test_bidirectional_lstm_roundtrip(self):
+        """DL4J bidirectional layout = forward (W,RW+p,b) then backward
+        block (GravesBidirectionalLSTMParamInitializer.java:92-106) —
+        round-trips onto our f_/b_ param prefixes exactly."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesBidirectionalLSTM, RnnOutputLayer)
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(8).learning_rate(0.1).updater("sgd")
+             .list()
+             .layer(GravesBidirectionalLSTM(n_in=3, n_out=4))
+             .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+             .build())).init()
+        rng = np.random.default_rng(7)
+        lp = dict(net.net_params[0])
+        for k in list(lp):
+            if k.endswith(("pI", "pF", "pO")):
+                lp[k] = rng.normal(size=lp[k].shape).astype(np.float32)
+        net.net_params[0] = lp
+        x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        self._roundtrip(net, x)
+        # spec sanity: 2 * (nIn*4H + H*(4H+3) + 4H)
+        spec = mig._layer_param_spec(GravesBidirectionalLSTM(n_in=3, n_out=4))
+        assert sum(s[2] for s in spec) == 2 * (3 * 16 + 4 * 19 + 16)
