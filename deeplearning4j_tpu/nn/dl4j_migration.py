@@ -987,6 +987,125 @@ def export_multi_layer_network(net, path) -> None:
         zf.writestr("coefficients.bin", buf.getvalue())
 
 
+def _export_vertex(v, g: GlobalConf) -> dict:
+    """Our GraphVertexConf → the Jackson wrapper-object form (inverse of
+    :func:`_build_vertex`)."""
+    from deeplearning4j_tpu.nn.conf import graph_conf as gc
+    if isinstance(v, gc.MergeVertex):
+        return {"MergeVertex": {}}
+    if isinstance(v, gc.ElementWiseVertex):
+        return {"ElementWiseVertex": {"op": v.op.capitalize()}}
+    if isinstance(v, gc.SubsetVertex):
+        return {"SubsetVertex": {"from": v.from_idx, "to": v.to_idx}}
+    if isinstance(v, gc.ScaleVertex):
+        return {"ScaleVertex": {"scaleFactor": v.scale}}
+    if isinstance(v, gc.ShiftVertex):
+        return {"ShiftVertex": {"shiftFactor": v.shift}}
+    if isinstance(v, gc.StackVertex):
+        return {"StackVertex": {}}
+    if isinstance(v, gc.UnstackVertex):
+        return {"UnstackVertex": {"from": v.from_idx,
+                                  "stackSize": v.stack_size}}
+    if isinstance(v, gc.L2Vertex):
+        return {"L2Vertex": {}}
+    if isinstance(v, gc.L2NormalizeVertex):
+        return {"L2NormalizeVertex": {}}
+    if isinstance(v, gc.LastTimeStepVertex):
+        return {"LastTimeStepVertex": {"maskArrayInputName": v.mask_input}}
+    if isinstance(v, gc.DuplicateToTimeSeriesVertex):
+        return {"DuplicateToTimeSeriesVertex": {"inputName": v.ts_input}}
+    if isinstance(v, gc.PreprocessorVertex):
+        return {"PreprocessorVertex": {"preProcessor": _export_preprocessor(
+            pp.InputPreProcessor.from_dict(v.preprocessor))}}
+    raise ValueError(f"vertex {type(v).__name__} has no DL4J export "
+                     f"mapping")
+
+
+def export_computation_graph(net, path) -> None:
+    """Write a ComputationGraph as a zip in the ORIGINAL DL4J's container
+    format (graph schema: nn/conf/ComputationGraphConfiguration.java:
+    59-87; flat params in topologicalSortOrder per
+    ComputationGraph.java:336-380).  Params/outputs round-trip exactly
+    through :func:`restore_computation_graph`; frozen-vertex status does
+    NOT survive (DL4J 0.8 has no FrozenLayer JSON type — same caveat as
+    export_multi_layer_network) and neither does updater state."""
+    import dataclasses as _dc
+    from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+    conf = net.conf
+    g = conf.global_conf
+
+    def resolved_inner(name, v):
+        """Layer conf with frozen wrapper peeled and inferred n_in / BN
+        width recovered from the live params — used by BOTH the JSON
+        pass and the param-flatten pass so specs stay in sync."""
+        lc = v.layer_conf()
+        inner = lc._inner() if isinstance(lc, L.FrozenLayerConf) else lc
+        lp = net.net_params.get(name) or {}
+        W = lp.get("W")
+        if W is None:
+            W = lp.get("f_W")   # bidirectional LSTM keys f_W/b_W
+        if getattr(inner, "n_in", None) in (None, 0) and W is not None:
+            inner = _dc.replace(inner, n_in=int(
+                W.shape[1] if isinstance(inner, L.ConvolutionLayer)
+                else W.shape[0]))
+        if isinstance(inner, L.BatchNormalization) and not inner.n_features:
+            inner = _dc.replace(inner, n_features=int(
+                net.net_state[name]["mean"].shape[0]))
+        return inner
+
+    inners = {name: resolved_inner(name, v)
+              for name, v in conf.vertices.items()
+              if isinstance(v, LayerVertex)}
+    vertices_json = {}
+    for name, v in conf.vertices.items():
+        if isinstance(v, LayerVertex):
+            tname, lj = _export_layer_json(inners[name], g)
+            vertices_json[name] = {"LayerVertex": {
+                "layerConf": {"layer": {tname: lj}, "seed": g.seed,
+                              "miniBatch": g.mini_batch,
+                              "minimize": g.minimize, "pretrain": False},
+                "preProcessor": None}}
+        else:
+            vertices_json[name] = _export_vertex(v, g)
+    top = {
+        "networkInputs": list(conf.network_inputs),
+        "networkOutputs": list(conf.network_outputs),
+        "vertices": vertices_json,
+        "vertexInputs": {k: list(vv)
+                         for k, vv in conf.vertex_inputs.items()},
+        "defaultConfiguration": {"seed": g.seed, "minimize": g.minimize,
+                                 "miniBatch": g.mini_batch,
+                                 "useRegularization": bool(
+                                     g.use_regularization or any(
+                                         (i.l1 or i.l2 or i.l1_bias
+                                          or i.l2_bias)
+                                         for i in inners.values()))},
+        "backprop": True, "pretrain": False,
+        "backpropType": ("TruncatedBPTT"
+                         if conf.backprop_type == "truncatedbptt"
+                         else "Standard"),
+        "tbpttFwdLength": conf.tbptt_fwd_length,
+        "tbpttBackLength": conf.tbptt_back_length,
+    }
+    topo = dl4j_graph_topological_order(
+        list(conf.network_inputs), list(conf.vertices),
+        {k: list(vv) for k, vv in conf.vertex_inputs.items()})
+    flats = []
+    for name in topo:
+        if name not in inners:
+            continue
+        flats.append(_flatten_layer_params(
+            inners[name], net.net_params.get(name) or {},
+            net.net_state.get(name) or {}))
+    flat = (np.concatenate([f for f in flats if f.size])
+            if any(f.size for f in flats) else np.empty(0, np.float32))
+    buf = io.BytesIO()
+    write_nd4j_array(buf, flat.reshape(1, -1), order="f")
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(top, indent=2))
+        zf.writestr("coefficients.bin", buf.getvalue())
+
+
 # ---------------------------------------------------------------------------
 # Entry point
 # ---------------------------------------------------------------------------

@@ -537,3 +537,87 @@ class TestExportToDl4j:
         # spec sanity: 2 * (nIn*4H + H*(4H+3) + 4H)
         spec = mig._layer_param_spec(GravesBidirectionalLSTM(n_in=3, n_out=4))
         assert sum(s[2] for s in spec) == 2 * (3 * 16 + 4 * 19 + 16)
+
+
+class TestExportComputationGraph:
+    def test_branch_graph_roundtrip(self):
+        """CG export → independent import: params bit-exact, outputs
+        exact, through the topo-ordered flat layout."""
+        import tempfile
+        from deeplearning4j_tpu.nn.conf.network import GlobalConf
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (GraphBuilder(GlobalConf(seed=5, learning_rate=0.1,
+                                        updater="adam"))
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=6,
+                                            activation="tanh"), "in")
+                .add_layer("a", DenseLayer(n_in=6, n_out=5,
+                                           activation="relu"), "d1")
+                .add_layer("b", DenseLayer(n_in=6, n_out=5,
+                                           activation="identity"), "d1")
+                .add_vertex("m", __import__(
+                    "deeplearning4j_tpu.nn.conf.graph_conf",
+                    fromlist=["MergeVertex"]).MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_in=10, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        out_before = np.asarray(net.output(x)[0])
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "cg.zip"
+            mig.export_computation_graph(net, p)
+            back = mig.restore_computation_graph(p)
+        for name in net.net_params:
+            for k in net.net_params[name]:
+                np.testing.assert_array_equal(
+                    np.asarray(net.net_params[name][k], np.float32),
+                    np.asarray(back.net_params[name][k]),
+                    err_msg=f"{name}.{k}")
+        np.testing.assert_allclose(np.asarray(back.output(x)[0]),
+                                   out_before, rtol=1e-6, atol=1e-7)
+        # and the serialization entry point auto-detects it
+        from deeplearning4j_tpu.nn.serialization import (
+            restore_computation_graph)
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "cg2.zip"
+            mig.export_computation_graph(net, p)
+            again = restore_computation_graph(p)
+        assert "m" in again.conf.vertices
+
+    def test_inferred_nin_bidirectional_graph_export(self):
+        """n_in inferred at init + bidirectional f_W/b_W keys must not
+        crash the export spec (round-4 review)."""
+        import tempfile
+        from deeplearning4j_tpu.nn.conf.network import GlobalConf
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            GravesBidirectionalLSTM, RnnOutputLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (GraphBuilder(GlobalConf(seed=2, learning_rate=0.1,
+                                        updater="sgd"))
+                .add_inputs("in")
+                .add_layer("bi", GravesBidirectionalLSTM(n_out=4), "in")
+                .add_layer("out", RnnOutputLayer(n_out=2,
+                                                 activation="softmax",
+                                                 loss="mcxent"), "bi")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(3))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert net.conf.vertices["bi"].layer_conf().n_in in (None, 3)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        before = np.asarray(net.output(x)[0])
+        with tempfile.TemporaryDirectory() as td:
+            p = pathlib.Path(td) / "bi_cg.zip"
+            mig.export_computation_graph(net, p)
+            back = mig.restore_computation_graph(p)
+        np.testing.assert_allclose(np.asarray(back.output(x)[0]), before,
+                                   rtol=1e-6, atol=1e-7)
