@@ -474,11 +474,48 @@ def bench_resnet50(n_chips, peak):
     return out
 
 
+def probe_primary_backend(timeout_s=None):
+    """Probe the primary (TPU/axon) backend in a SUBPROCESS with a hard
+    timeout.  Backend init can hang forever in C code inside the PJRT
+    plugin when the chip relay is down — a Python signal handler never
+    runs during a C-level hang, so probing in-process is not survivable
+    (round 4 lost its bench exactly this way: jax.devices() wedged in C,
+    the SIGALRM guard never fired, the driver SIGKILLed, no JSON line).
+    Returns (probe_dict|None, error|None)."""
+    import subprocess
+    timeout_s = timeout_s or float(
+        os.environ.get("DL4J_BENCH_PROBE_TIMEOUT_SEC", 240))
+    code = (
+        "import jax, json; d = jax.devices(); "
+        "print(json.dumps({'n': len(d), 'kind': d[0].device_kind, "
+        "'platform': jax.default_backend()}))"
+    )
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, (f"probe timeout after {timeout_s:.0f}s "
+                      "(backend init hang — chip relay down?)")
+    except Exception as e:
+        return None, f"probe spawn failed: {type(e).__name__}: {e}"
+    if p.returncode != 0:
+        return None, (p.stderr or f"probe rc={p.returncode}").strip()[-500:]
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, "probe produced no JSON"
+
+
 def acquire_backend():
     """Initialize a JAX backend, falling back to CPU when the primary
     (TPU/axon) backend fails to init.  NEVER raises — round 3 died here
     (BENCH_r03.json rc=1: 'Unable to initialize backend axon') and lost
-    the round's only hardware evidence.  Returns (devices|[], info)."""
+    the round's only hardware evidence.  A subprocess probe (see
+    probe_primary_backend) guards the parent against the round-4 failure
+    mode where init HANGS instead of raising.  Returns (devices|[], info)."""
     import jax
     info = {}
     forced = os.environ.get("DL4J_BENCH_PLATFORM")
@@ -487,13 +524,25 @@ def acquire_backend():
         # so an explicit config update is the only reliable override
         jax.config.update("jax_platforms", forced)
         info["platform_forced"] = forced
+    else:
+        probe, err = probe_primary_backend()
+        if probe is None:
+            info["backend_error"] = err[:500]
+            log(f"primary backend probe FAILED: {err}\nfalling back to CPU")
+            # Forcing cpu BEFORE the first in-process backend touch means
+            # the parent never enters the plugin code path that hangs.
+            jax.config.update("jax_platforms", "cpu")
+            info["platform"] = "cpu (fallback)"
+        else:
+            log(f"backend probe ok: {probe}")
+            info["probe"] = probe
     try:
         devs = jax.devices()
-        info["platform"] = jax.default_backend()
+        info.setdefault("platform", jax.default_backend())
         return devs, info
     except Exception as e:
         info["backend_error"] = f"{type(e).__name__}: {e}"[:500]
-        log(f"primary backend init FAILED: {e}\nfalling back to CPU")
+        log(f"backend init FAILED after probe: {e}\nfalling back to CPU")
     # jax caches nothing on failure; narrowing jax_platforms to cpu makes
     # the retry skip the broken plugin.  (Env var alone is not enough —
     # the axon sitecustomize overrides JAX_PLATFORMS at import time.)
@@ -506,6 +555,67 @@ def acquire_backend():
         info["fallback_error"] = f"{type(e).__name__}: {e}"[:500]
         log(f"CPU fallback ALSO failed: {e}")
         return [], info
+
+
+_EMIT_LOCK = __import__("threading").Lock()
+_EMITTED = False
+
+
+def _emit(result):
+    """Print the one JSON line exactly once (main path and watchdog race)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        _EMITTED = True
+        print(json.dumps(result), flush=True)
+
+
+# Mutable watchdog deadline (epoch seconds): tight while acquiring the
+# backend (the likely C-hang point), extended by _run_configs once the
+# backend is up and the slow-but-progressing compile/run phase starts.
+_WATCHDOG = {"deadline": None}
+
+
+def _start_watchdog(result, deadline_s):
+    """Daemon thread that force-emits the JSON line and exits the process
+    when the (mutable) deadline passes.  This is the ONLY guard that works
+    when the main thread is wedged in C (PJRT backend init / XLA compile):
+    signal handlers only run at Python bytecode boundaries, but another
+    thread can still print and os._exit."""
+    import threading
+
+    _WATCHDOG["deadline"] = time.time() + deadline_s
+
+    def _watch():
+        while True:
+            remaining = _WATCHDOG["deadline"] - time.time()
+            if remaining <= 0:
+                break
+            time.sleep(min(remaining, 15))
+        # The main thread may be mutating `result` concurrently — any
+        # failure here (e.g. dict-changed-during-json.dumps) must still
+        # reach os._exit with SOME JSON line, or the guard is useless.
+        try:
+            result.setdefault(
+                "fatal_error",
+                "watchdog: hard deadline hit "
+                "(likely C-level hang in backend init or compile)")
+            log(result["fatal_error"])
+            _emit(result)
+        except BaseException:
+            try:
+                _emit({"metric": result.get("metric", "bench"),
+                       "value": 0.0, "unit": "samples/sec/chip",
+                       "vs_baseline": 0.0,
+                       "fatal_error": "watchdog: hard deadline hit "
+                                      "(result dict unserializable)"})
+            except BaseException:
+                pass
+        finally:
+            os._exit(3)
+
+    threading.Thread(target=_watch, daemon=True, name="bench-watchdog").start()
 
 
 def main():
@@ -524,11 +634,16 @@ def main():
             raise TimeoutError(f"signal {signum}")
         # SIGTERM (driver kill) and a hard alarm at 2x the config budget
         # both unwind through the except below so the JSON line still
-        # prints; a hang inside a C++ compile can't be interrupted this
-        # way, but every Python-level stall can.
+        # prints.  Neither can interrupt a C-level hang — that is the
+        # watchdog thread's job.
         signal.signal(signal.SIGTERM, _bail)
         signal.signal(signal.SIGALRM, _bail)
         budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
+        # Tight while acquiring the backend: probe timeout + slack.  If
+        # even the guarded acquisition wedges the parent in C, the bench
+        # still emits within ~10 minutes instead of being SIGKILLed mute.
+        probe_t = float(os.environ.get("DL4J_BENCH_PROBE_TIMEOUT_SEC", 240))
+        _start_watchdog(result, probe_t * 2 + 120)
         signal.alarm(int(budget * 2) + 300)
         _run_configs(result)
         signal.alarm(0)
@@ -536,7 +651,7 @@ def main():
         result["fatal_error"] = f"{type(e).__name__}: {e}"[:500]
         log(traceback.format_exc())
     finally:
-        print(json.dumps(result), flush=True)
+        _emit(result)
 
 
 def _run_configs(result):
@@ -547,6 +662,9 @@ def _run_configs(result):
     if not devices:
         result["configs"] = {}
         return
+    # Backend is up: extend the watchdog to cover the compile/run phase.
+    budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
+    _WATCHDOG["deadline"] = time.time() + budget * 2 + 240
     import jax
     n_chips = max(1, len(devices))
     kind = platform.device_kind()

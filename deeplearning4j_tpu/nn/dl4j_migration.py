@@ -23,12 +23,20 @@ wGG), b [4H], gate order IFOG
 (nn/params/GravesLSTMParamInitializer.java:60-148,
 nn/layers/recurrent/LSTMHelpers.java:62).
 
-Peephole caveat: DL4J applies its third peephole (wGG) to the *input
-modulation* gate (LSTMHelpers.java:202-209); this framework's cell
-applies pI to the *input* gate (ops/recurrent.py).  wFF→pF and wOO→pO map
-exactly; wGG→pI is the closest slot and is documented divergence —
-migrated LSTM nets match DL4J only when peephole weights are zero (their
-init value).
+LSTM gate-block mapping: DL4J's IFOG column order is [input(candidate,
+LAYER activation fn), forget, output, inputMod(SIGMOID multiplier)] —
+LSTMHelpers.java:180-226 applies activationFn to block 0 and
+gateActivationFn to block 3, and block 3 is the multiplier on the
+candidate in the cell update (``c = f*c_prev + inputMod*input``).  This
+framework's cell order is [i(sigmoid multiplier), f, o, g(tanh
+candidate)] (ops/recurrent.py) — blocks 0 and 3 swap ROLES.  Migration
+therefore permutes column blocks 0↔3 of W, RW and b in both directions
+(:func:`_swap_ifog_blocks`, an involution).  After the permutation the
+peephole mapping is semantically EXACT: wFF→pF (prev cell → forget),
+wOO→pO (current cell → output, LSTMHelpers.java:226-228), wGG→pI (prev
+cell → sigmoid multiplier, LSTMHelpers.java:202-209) — migrated LSTMs
+match DL4J forward activations with NONZERO peepholes
+(tests/test_dl4j_migration.py::test_lstm_forward_matches_dl4j_semantics).
 """
 
 from __future__ import annotations
@@ -642,11 +650,32 @@ def restore_computation_graph(path, load_params: bool = True,
             net.opt_states = {n2: net.updaters[n2].init(net.net_params[n2])
                               for n2 in net.order}
         if load_updater and "updaterState.bin" in names:
-            import warnings
-            warnings.warn(
-                "DL4J updaterState.bin found but not migrated (nd4j "
-                "buffer layout unverifiable); training resumes with "
-                "fresh updater state", UserWarning, stacklevel=2)
+            # ComputationGraphUpdater flattens in the SAME topological
+            # order as the params (BaseMultiLayerUpdater.getOrderedLayers)
+            try:
+                from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
+                topo = dl4j_graph_topological_order(
+                    list(raw.get("networkInputs") or []),
+                    list((raw.get("vertices") or {}).keys()),
+                    {k: list(v)
+                     for k, v in (raw.get("vertexInputs") or {}).items()})
+                indexed = [(vname, conf.vertices[vname].layer_conf())
+                           for vname in topo
+                           if vname in conf.vertices
+                           and isinstance(conf.vertices[vname], LayerVertex)]
+                ustate = read_nd4j_array(
+                    io.BytesIO(zf.read("updaterState.bin"))).ravel(order="C")
+                migrated = updater_state_from_flat(indexed, ustate,
+                                                   conf.global_conf)
+                for vname in migrated:
+                    net.opt_states[vname] = _merge_updater_state(
+                        net.opt_states[vname], migrated[vname])
+            except Exception as e:
+                import warnings
+                warnings.warn(
+                    f"updaterState.bin could not be migrated ({e}); "
+                    "training resumes with fresh updater state",
+                    UserWarning, stacklevel=2)
     return net
 
 
@@ -692,6 +721,73 @@ def _layer_param_spec(layer: L.Layer):
     return []
 
 
+def _is_lstm_gated(layer: L.Layer, name: str) -> bool:
+    """True for LSTM param views whose last axis is 4H gate blocks
+    (W/b, incl. the f_/b_ bidirectional variants) — these need the
+    IFOG block swap.  RW is handled inside the RW+p branch."""
+    return (isinstance(layer, (L.GravesLSTM, L.GravesBidirectionalLSTM))
+            and (name.endswith("W") or name.endswith("b")))
+
+
+def _swap_ifog_blocks(a: np.ndarray, H: int) -> np.ndarray:
+    """Permute LSTM gate column blocks 0↔3 along the last axis.
+
+    DL4J's IFOG order puts the tanh candidate in block 0 and the sigmoid
+    multiplier in block 3 (GravesLSTMParamInitializer.java:108 "Order:
+    input, forget, output, input modulation"; LSTMHelpers.java:180-226);
+    this framework's cell is [i(sigmoid), f, o, g(tanh)]
+    (ops/recurrent.py).  Swapping blocks 0 and 3 converts either layout
+    to the other (involution), for W [*,4H], RW [H,4H] and b [4H]."""
+    out = np.array(a, copy=True)
+    out[..., 0:H] = a[..., 3 * H:4 * H]
+    out[..., 3 * H:4 * H] = a[..., 0:H]
+    return out
+
+
+def _decode_view(layer: L.Layer, name: str, shape, order: str,
+                 view: np.ndarray) -> Dict[str, np.ndarray]:
+    """Decode ONE flat DL4J view into this framework's param keys.
+    Shared by params_from_flat and each updater-state plane — updater
+    state aligns elementwise with the flat param layout
+    (BaseMultiLayerUpdater.java:61-120 slices both from parallel views),
+    so the same reshapes/permutations apply."""
+    if name.endswith("RW+p"):
+        pre = name[:-len("RW+p")]
+        m = np.reshape(view, shape, order=order)
+        H = shape[0]
+        # peephole cols: wFF, wOO, wGG (LSTMHelpers.java:62); after the
+        # IFOG block swap the mapping is exact (module docstring):
+        # wFF→pF, wOO→pO, wGG→pI
+        return {pre + "RW": _swap_ifog_blocks(m[:, :4 * H], H),
+                pre + "pF": m[:, 4 * H],
+                pre + "pO": m[:, 4 * H + 1],
+                pre + "pI": m[:, 4 * H + 2]}
+    if _is_lstm_gated(layer, name):
+        H = shape[-1] // 4
+        return {name: _swap_ifog_blocks(
+            np.reshape(view, shape, order=order), H)}
+    return {name: np.reshape(view, shape, order=order)}
+
+
+def _encode_view(layer: L.Layer, name: str, shape, order: str,
+                 values: Dict) -> np.ndarray:
+    """Inverse of _decode_view: one raveled DL4J view from param keys."""
+    if name.endswith("RW+p"):
+        pre = name[:-len("RW+p")]
+        H = shape[0]
+        m = np.zeros(shape, np.float32)
+        m[:, :4 * H] = _swap_ifog_blocks(np.asarray(values[pre + "RW"]), H)
+        m[:, 4 * H] = np.asarray(values[pre + "pF"])
+        m[:, 4 * H + 1] = np.asarray(values[pre + "pO"])
+        m[:, 4 * H + 2] = np.asarray(values[pre + "pI"])
+        return np.ravel(m, order=order)
+    if _is_lstm_gated(layer, name):
+        H = shape[-1] // 4
+        return np.ravel(_swap_ifog_blocks(np.asarray(values[name]), H),
+                        order=order)
+    return np.ravel(np.asarray(values[name]), order=order)
+
+
 def params_from_flat(layers: List[L.Layer],
                      flat: np.ndarray) -> Tuple[List[Dict], List[Dict]]:
     """Replay DefaultParamInitializer's flattening: slice the flat row
@@ -709,26 +805,195 @@ def params_from_flat(layers: List[L.Layer],
                     f"need {off + n}, have {flat.size}")
             view = flat[off:off + n]
             off += n
-            if name.endswith("RW+p"):
-                pre = name[:-len("RW+p")]
-                m = np.reshape(view, shape, order=order)
-                H = shape[0]
-                lp[pre + "RW"] = m[:, :4 * H]
-                # peephole cols: wFF, wOO, wGG (LSTMHelpers.java:62);
-                # wGG→pI is documented divergence (module docstring)
-                lp[pre + "pF"] = m[:, 4 * H]
-                lp[pre + "pO"] = m[:, 4 * H + 1]
-                lp[pre + "pI"] = m[:, 4 * H + 2]
-            elif name in ("mean", "var"):
+            if name in ("mean", "var"):
                 ls[name] = view.copy()
             else:
-                lp[name] = np.reshape(view, shape, order=order)
+                lp.update(_decode_view(layer, name, shape, order, view))
         params.append(lp)
         states.append(ls)
     if off != flat.size:
         raise ValueError(f"coefficients.bin has {flat.size} params, "
                          f"layer specs consume {off}")
     return params, states
+
+
+# ---------------------------------------------------------------------------
+# updaterState.bin — the updater's flat state view
+# ---------------------------------------------------------------------------
+
+# Plane names map nd4j's legacy per-rule buffers onto this framework's
+# ops/updaters.Updater.init keys.  Per-view state sizes:
+# UpdaterUtils.stateSizeForLayerVariable:42-61 — SGD/NONE 0×, NESTEROVS
+# (momentum v) / ADAGRAD (historical g²) / RMSPROP (moving-avg g²) 1×,
+# ADAM (m then v) / ADADELTA (msg then msdx) 2× the param length; the
+# 2-plane rules split their block view in half, first plane first
+# (nd4j legacy AdamUpdater/AdaDeltaUpdater.setStateViewArray).
+_STATE_PLANES = {
+    "sgd": (), "none": (),
+    "nesterovs": ("v",),
+    "adagrad": ("g2",),
+    "rmsprop": ("g2",),
+    "adam": ("m", "v"),
+    "adamax": ("m", "v"),
+    "adadelta": ("g2", "dx2"),
+}
+
+
+def _view_updater(layer: L.Layer, name: str, g: GlobalConf) -> str:
+    """Effective updater rule for one param view.  BN mean/var are
+    Updater.NONE (BatchNormalization.java:151-161)."""
+    if name in ("mean", "var"):
+        return "none"
+    return (layer.updater or g.updater or "sgd").lower()
+
+
+def _updater_sig(layer: L.Layer, name: str, g: GlobalConf):
+    """UpdaterBlock merge key: contiguous param views with equal updater
+    configuration share one block (UpdaterUtils
+    .updaterConfigurationsEquals:64-120 — same rule, same per-param
+    learning rate incl. biasLearningRate, same LR schedule, same
+    rule-specific hyperparameters).  Hyperparameters are RESOLVED to
+    their effective values (layer → global → rule default, the same
+    resolution nn/multilayer._updater_for applies) before comparison —
+    DL4J compares resolved configs, so an explicit epsilon=1e-8 on one
+    layer and an unset-default 1e-8 on the next must still merge."""
+    upd = _view_updater(layer, name, g)
+    is_bias = name == "b" or name.endswith("_b")
+    lr = layer.learning_rate if layer.learning_rate is not None \
+        else g.learning_rate
+    if is_bias and layer.bias_learning_rate is not None:
+        lr = layer.bias_learning_rate
+
+    def res(field, default):
+        v = getattr(layer, field, None)
+        if v is None:
+            v = getattr(g, field, None)
+        return default if v is None else v
+
+    hyper = ()
+    if upd == "nesterovs":
+        hyper = (res("momentum", 0.9),)
+    elif upd in ("adam", "adamax"):
+        hyper = (res("adam_mean_decay", 0.9), res("adam_var_decay", 0.999),
+                 res("epsilon", 1e-8))
+    elif upd == "adadelta":
+        hyper = (res("rho", 0.95), res("epsilon", 1e-6))
+    elif upd == "rmsprop":
+        hyper = (res("rms_decay", 0.95), res("epsilon", 1e-8))
+    elif upd == "adagrad":
+        hyper = (res("epsilon", 1e-6),)
+    sched = (g.lr_policy, g.lr_policy_decay_rate, g.lr_policy_steps,
+             g.lr_policy_power,
+             tuple(sorted((g.learning_rate_schedule or {}).items())))
+    return (upd, lr, hyper, sched)
+
+
+def _updater_blocks(indexed_layers, g: GlobalConf):
+    """Walk (index, layer) pairs in flat-param order and group contiguous
+    views with equal updater config into UpdaterBlocks
+    (BaseMultiLayerUpdater.java:55-120).  Returns
+    [{"updater", "views": [(idx, layer, name, shape, n, order)]}]."""
+    blocks = []
+    cur_sig = object()
+    for idx, layer in indexed_layers:
+        for name, shape, n, order in _layer_param_spec(layer):
+            sig = _updater_sig(layer, name, g)
+            if blocks and sig == cur_sig:
+                blocks[-1]["views"].append((idx, layer, name, shape, n,
+                                            order))
+            else:
+                cur_sig = sig
+                blocks.append({"updater": sig[0],
+                               "views": [(idx, layer, name, shape, n,
+                                          order)]})
+    return blocks
+
+
+def updater_state_from_flat(indexed_layers, flat: np.ndarray,
+                            g: GlobalConf) -> Dict:
+    """Distribute a DL4J ``updaterState.bin`` row onto per-layer updater
+    state in this framework's ops/updaters structure.
+
+    Layout (BaseMultiLayerUpdater.java:55-130): layers input→output
+    (topological order for a ComputationGraph), param views in
+    initializer order, contiguous views with equal updater config merged
+    into UpdaterBlocks; each block contributes its planes back-to-back —
+    a 2-plane rule stores plane 0 for ALL the block's params, then plane
+    1.  State elements align 1:1 with the flat param layout, so each
+    plane decodes with the same per-view reshapes (incl. the LSTM IFOG
+    swap) as coefficients.bin.
+
+    Returns {layer_index: {plane: {param_key: array}}}."""
+    out: Dict = {}
+    off = 0
+    for block in _updater_blocks(indexed_layers, g):
+        planes = _STATE_PLANES.get(block["updater"])
+        if planes is None:
+            raise ValueError(
+                f"unknown updater {block['updater']!r} in updater state")
+        block_n = sum(v[4] for v in block["views"])
+        for k, plane in enumerate(planes):
+            row = flat[off + k * block_n: off + (k + 1) * block_n]
+            if row.size != block_n:
+                raise ValueError(
+                    f"updaterState.bin too short: block needs {block_n} "
+                    f"per plane at offset {off}")
+            vo = 0
+            for idx, layer, name, shape, n, order in block["views"]:
+                vals = _decode_view(layer, name, shape, order,
+                                    row[vo:vo + n])
+                vo += n
+                out.setdefault(idx, {}).setdefault(plane, {}).update(vals)
+        off += len(planes) * block_n
+    if off != flat.size:
+        raise ValueError(f"updaterState.bin has {flat.size} entries, "
+                         f"updater blocks consume {off}")
+    return out
+
+
+def updater_state_to_flat(indexed_layers, states: Dict,
+                          g: GlobalConf) -> np.ndarray:
+    """Inverse of :func:`updater_state_from_flat`: emit the flat DL4J
+    updater-state row from {layer_index: {plane: {param_key: array}}}."""
+    chunks = []
+    for block in _updater_blocks(indexed_layers, g):
+        planes = _STATE_PLANES.get(block["updater"])
+        if planes is None:
+            raise ValueError(
+                f"updater {block['updater']!r} has no DL4J state layout")
+        for plane in planes:
+            for idx, layer, name, shape, n, order in block["views"]:
+                vals = states.get(idx, {}).get(plane, {})
+                try:
+                    chunks.append(_encode_view(layer, name, shape, order,
+                                               vals))
+                except KeyError:
+                    # missing state (e.g. frozen layer) → zeros, matching
+                    # a freshly initialized Java updater view
+                    chunks.append(np.zeros(n, np.float32))
+    if not chunks:
+        return np.empty(0, np.float32)
+    return np.concatenate(chunks).astype(np.float32)
+
+
+def _merge_updater_state(opt_state, migrated: Dict):
+    """Overwrite the engine-initialized opt-state leaves for one layer
+    with migrated arrays (structure comes from Updater.init so jitted
+    steps see the exact pytree they expect)."""
+    import jax.numpy as jnp
+    if not migrated or not isinstance(opt_state, dict):
+        return opt_state
+    new = dict(opt_state)
+    for plane, vals in migrated.items():
+        if plane not in new or not isinstance(new[plane], dict):
+            continue
+        np_new = dict(new[plane])
+        for k, v in vals.items():
+            if k in np_new:
+                np_new[k] = jnp.asarray(
+                    v, getattr(np_new[k], "dtype", jnp.float32))
+        new[plane] = np_new
+    return new
 
 
 # ---------------------------------------------------------------------------
@@ -905,19 +1170,10 @@ def _flatten_layer_params(layer: L.Layer, lp: Dict, ls: Dict) -> np.ndarray:
     spec = _layer_param_spec(layer)
     chunks = []
     for name, shape, n, order in spec:
-        if name.endswith("RW+p"):
-            pre = name[:-len("RW+p")]
-            H = shape[0]
-            m = np.zeros(shape, np.float32)
-            m[:, :4 * H] = np.asarray(lp[pre + "RW"])
-            m[:, 4 * H] = np.asarray(lp[pre + "pF"])
-            m[:, 4 * H + 1] = np.asarray(lp[pre + "pO"])
-            m[:, 4 * H + 2] = np.asarray(lp[pre + "pI"])
-            chunks.append(np.ravel(m, order=order))
-        elif name in ("mean", "var"):
+        if name in ("mean", "var"):
             chunks.append(np.ravel(np.asarray(ls[name]), order=order))
         else:
-            chunks.append(np.ravel(np.asarray(lp[name]), order=order))
+            chunks.append(_encode_view(layer, name, shape, order, lp))
     return np.concatenate(chunks) if chunks else np.empty(0, np.float32)
 
 
@@ -927,8 +1183,8 @@ def export_multi_layer_network(net, path) -> None:
     legacy Nd4j.write format, util/ModelSerializer.java:79-120) so the
     params survive a round-trip through :func:`restore_multi_layer_network`
     bit-for-bit — and follow the documented layouts a Java DL4J reader
-    replays.  updaterState is not written (layout unverifiable, see
-    restore)."""
+    replays.  Non-empty updater state is written as ``updaterState.bin``
+    in the UpdaterBlock layout (see :func:`updater_state_to_flat`)."""
     import dataclasses as _dc
     conf = net.conf
     g = conf.global_conf
@@ -982,9 +1238,19 @@ def export_multi_layer_network(net, path) -> None:
             if any(f.size for f in flats) else np.empty(0, np.float32))
     buf = io.BytesIO()
     write_nd4j_array(buf, flat.reshape(1, -1), order="f")
+    ustates = {i: s for i, s in enumerate(net.opt_states)
+               if isinstance(s, dict) and s}
+    uflat = updater_state_to_flat(list(enumerate(inners)), ustates, g) \
+        if ustates else np.empty(0, np.float32)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", json.dumps(top, indent=2))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if uflat.size:
+            # ModelSerializer.writeModel:106-125 appends the updater
+            # state view only when present and non-empty
+            ubuf = io.BytesIO()
+            write_nd4j_array(ubuf, uflat.reshape(1, -1), order="f")
+            zf.writestr("updaterState.bin", ubuf.getvalue())
 
 
 def _export_vertex(v, g: GlobalConf) -> dict:
@@ -1117,11 +1383,10 @@ def restore_multi_layer_network(path, load_params: bool = True,
     ModelSerializer.restoreMultiLayerNetwork, util/ModelSerializer.java;
     regression contract: regressiontest/RegressionTest071.java).
 
-    ``updaterState.bin`` is NOT migrated: its per-rule buffer layout is
-    defined by nd4j GradientUpdater implementations whose source is not
-    part of the reference tree, so a faithful decode can't be verified.
-    When present and ``load_updater=True`` a UserWarning is emitted and
-    fresh updater state is used (one warm-up period on resume)."""
+    ``updaterState.bin`` is migrated through the UpdaterBlock layout
+    (see :func:`updater_state_from_flat`; docs/MIGRATION.md documents
+    the byte-level spec) so fine-tuning resumes with the Java updater's
+    momentum/moment buffers instead of a cold restart."""
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     import jax.numpy as jnp
 
@@ -1157,9 +1422,21 @@ def restore_multi_layer_network(path, load_params: bool = True,
             net.opt_states = [net.updaters[i].init(net.net_params[i])
                               for i in range(len(net.layers))]
         if load_updater and "updaterState.bin" in names:
-            import warnings
-            warnings.warn(
-                "DL4J updaterState.bin found but not migrated (nd4j "
-                "buffer layout unverifiable); training resumes with "
-                "fresh updater state", UserWarning, stacklevel=2)
+            try:
+                ustate = read_nd4j_array(
+                    io.BytesIO(zf.read("updaterState.bin"))).ravel(order="C")
+                migrated = updater_state_from_flat(
+                    list(enumerate(conf.layers)), ustate, conf.global_conf)
+                net.opt_states = [
+                    _merge_updater_state(net.opt_states[i],
+                                         migrated.get(i, {}))
+                    for i in range(len(net.layers))]
+            except Exception as e:  # e.g. an updater rule outside the
+                # 0.8 set (NADAM/CUSTOM) whose state layout we can't
+                # place — params still load, resume with fresh state
+                import warnings
+                warnings.warn(
+                    f"updaterState.bin could not be migrated ({e}); "
+                    "training resumes with fresh updater state",
+                    UserWarning, stacklevel=2)
     return net

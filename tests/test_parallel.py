@@ -66,6 +66,103 @@ def test_allreduce_matches_single_device_math():
                                np.asarray(net_b.params()), rtol=2e-4, atol=2e-6)
 
 
+def test_allreduce_nondivisible_batch_pads_not_drops():
+    """Round-4 verdict weak #5: a batch not divisible by the data degree
+    must train EVERY example (the reference's round-robin feedDataSet —
+    ParallelWrapper.java:383) — padded rows are masked out and the valid
+    rows' mask rescaled, so the sharded step equals the unsharded step
+    on the ragged batch exactly.  No warning may fire."""
+    import warnings
+    ds = _data()
+    batch = DataSet(ds.features[:58], ds.labels[:58])   # 58 % 8 = 2
+
+    net_a = _net(updater="sgd", lr=0.1)
+    net_a.fit(ListDataSetIterator(batch, 58), epochs=3)
+
+    net_b = _net(updater="sgd", lr=0.1)
+    pw = ParallelWrapper(net_b, make_mesh())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pw.fit(ListDataSetIterator(batch, 58), epochs=3)
+    assert not [w for w in rec if "dropping" in str(w.message)]
+
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_b.params()),
+                               rtol=2e-4, atol=2e-6)
+    assert net_b.last_batch_size == 58  # real examples, not padded count
+
+
+def test_allreduce_pads_batch_smaller_than_degree():
+    """n < data degree (6 examples over 8 devices) used to drop the
+    WHOLE batch; now it pads up and trains all 6."""
+    ds = _data()
+    batch = DataSet(ds.features[:6], ds.labels[:6])
+    net_a = _net(updater="sgd", lr=0.1)
+    net_a.fit(ListDataSetIterator(batch, 6), epochs=2)
+    net_b = _net(updater="sgd", lr=0.1)
+    ParallelWrapper(net_b, make_mesh()).fit(
+        ListDataSetIterator(batch, 6), epochs=2)
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_b.params()),
+                               rtol=2e-4, atol=2e-6)
+
+
+def test_rnn_masked_nondivisible_batch_pads_exactly():
+    """Variable-length RNN batch (features_mask set, labels_mask None)
+    with a ragged size: the pad path must scale the PROPAGATED time mask
+    rather than overriding it with an all-ones row mask (round-5 review
+    finding) — padded training equals the unsharded step."""
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    rng = np.random.default_rng(5)
+    N, T = 12, 6                      # 12 % 8 = 4
+    x = rng.normal(size=(N, T, 3)).astype(np.float32)
+    fm = np.zeros((N, T), np.float32)
+    for i in range(N):
+        fm[i, : rng.integers(2, T + 1)] = 1.0
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (N, T))]
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).learning_rate(0.1).updater("sgd")
+                .list()
+                .layer(GravesLSTM(n_in=3, n_out=5))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    ds = DataSet(x, y, features_mask=fm)
+    net_a = build()
+    net_a.fit(ListDataSetIterator(ds, N), epochs=2)
+    net_b = build()
+    ParallelWrapper(net_b, make_mesh()).fit(
+        ListDataSetIterator(ds, N), epochs=2)
+    np.testing.assert_allclose(np.asarray(net_a.params()),
+                               np.asarray(net_b.params()),
+                               rtol=3e-4, atol=3e-6)
+
+
+def test_sum_reduced_net_falls_back_to_trim():
+    """mini_batch=False (sum loss reduction) cannot use the mask-rescale
+    padding — the trim fallback must warn instead of silently scaling
+    gradients by target/n."""
+    import warnings
+    ds = _data()
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.05).updater("sgd").mini_batch(False)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = ParallelWrapper(net, make_mesh())
+    batch = DataSet(ds.features[:58], ds.labels[:58])
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pw.fit(ListDataSetIterator(batch, 58), epochs=1)
+    assert [w for w in rec if "dropping" in str(w.message)]
+
+
 def test_param_averaging_mode():
     """averaging_frequency>1 reference-compat mode trains and converges."""
     ds = _data()
