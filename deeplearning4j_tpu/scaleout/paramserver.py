@@ -17,8 +17,11 @@ On-mesh training should prefer the per-step psum path
 loosely-coupled workers — e.g. hosts feeding independent TPU slices
 without a shared mesh.
 
-Wire format: 1-byte op ('P' push, 'G' get, 'Q' quit) + u32 little-endian
-payload length + float32 array bytes.  Responses: u32 length + payload.
+Wire format: 1-byte op ('P' push, 'G' get, 'N' push count, 'C' increment
+named counter, 'R' read named counter, 'Q' quit) + u32 little-endian
+payload length + payload (float32 array bytes for P, a UTF-8 counter
+name for C/R).  Responses: u32 length + payload; N/C/R answer with the
+count/value in the length field.
 """
 
 from __future__ import annotations
@@ -53,6 +56,7 @@ class ParameterServerNode:
     def __init__(self, initial_params: np.ndarray, host: str = "127.0.0.1",
                  port: int = 0):
         self.params = np.array(initial_params, np.float32, copy=True)
+        self.counters: dict = {}
         self._lock = threading.Lock()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -93,6 +97,23 @@ class ParameterServerNode:
                     with self._lock:
                         payload = self.params.tobytes()
                     conn.sendall(_LEN.pack(len(payload)) + payload)
+                elif op == b"N":  # push count — lets loosely-coupled
+                    # workers build a sync barrier ("wait until all P
+                    # peers pushed round r") on top of async pushes
+                    with self._lock:
+                        count = self.updates_received
+                    conn.sendall(_LEN.pack(count))
+                elif op == b"C":  # increment named counter → new value
+                    key = _recv_exact(conn, n).decode()
+                    with self._lock:
+                        self.counters[key] = self.counters.get(key, 0) + 1
+                        val = self.counters[key]
+                    conn.sendall(_LEN.pack(val))
+                elif op == b"R":  # read named counter
+                    key = _recv_exact(conn, n).decode()
+                    with self._lock:
+                        val = self.counters.get(key, 0)
+                    conn.sendall(_LEN.pack(val))
                 elif op == b"Q":
                     break
                 else:
@@ -132,6 +153,29 @@ class ParameterServerClient:
             (n,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
             payload = _recv_exact(self._sock, n)
         return np.frombuffer(payload, np.float32).copy()
+
+    def push_count(self) -> int:
+        """Total pushes the server has accepted (sync-barrier primitive)."""
+        with self._lock:
+            self._sock.sendall(_HDR.pack(b"N", 0))
+            (count,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+        return int(count)
+
+    def increment_counter(self, key: str) -> int:
+        """Atomically bump a named server-side counter; returns the new
+        value (the ack half of a two-phase barrier)."""
+        payload = key.encode()
+        with self._lock:
+            self._sock.sendall(_HDR.pack(b"C", len(payload)) + payload)
+            (val,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+        return int(val)
+
+    def read_counter(self, key: str) -> int:
+        payload = key.encode()
+        with self._lock:
+            self._sock.sendall(_HDR.pack(b"R", len(payload)) + payload)
+            (val,) = _LEN.unpack(_recv_exact(self._sock, _LEN.size))
+        return int(val)
 
     def close(self) -> None:
         try:

@@ -33,15 +33,35 @@ class DeepLearning4jEntryPoint:
         from deeplearning4j_tpu.nn.serialization import load_model
         return load_model(str(p))
 
+    @staticmethod
+    def _data_iterator(data_dir: str):
+        """Minibatch source for a data directory, by layout:
+
+        * ``features/`` + ``labels/`` subdirs of ``batch_%d.h5`` — the
+          reference's HDF5 layout (HDF5MiniBatchDataSetIterator.java:24);
+        * ``batch_%d.h5`` files carrying features+labels datasets;
+        * ``.npz`` exports (scaleout.data.PathDataSetIterator).
+        """
+        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
+        from deeplearning4j_tpu.keras_import.hdf5_data import (
+            _BATCH_RE, HDF5MiniBatchDataSetIterator)
+        d = Path(data_dir)
+        if (d / "features").is_dir() and (d / "labels").is_dir():
+            return HDF5MiniBatchDataSetIterator(d / "features", d / "labels")
+        # the iterator's own strict batch_%d.h5 pattern decides — a stray
+        # non-conforming .h5 must not hijack a directory of .npz exports
+        if any(_BATCH_RE.match(p.name) for p in d.iterdir()):
+            return HDF5MiniBatchDataSetIterator(d)
+        return PathDataSetIterator.from_dir(data_dir)
+
     def fit(self, model_path: str, data_dir: str, epochs: int = 1,
             save_path: Optional[str] = None) -> dict:
-        """Train ``model_path`` on the .npz minibatches in ``data_dir``
-        (the HDF5MiniBatchDataSetIterator role is played by
-        scaleout.data.PathDataSetIterator)."""
+        """Train ``model_path`` on the minibatches in ``data_dir``
+        (HDF5 ``batch_%d.h5`` layouts or .npz exports —
+        :meth:`_data_iterator`)."""
         from deeplearning4j_tpu.nn.serialization import write_model
-        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
         model = self._load_model(model_path)
-        it = PathDataSetIterator.from_dir(data_dir)
+        it = self._data_iterator(data_dir)
         for _ in range(int(epochs)):
             it.reset()
             while it.has_next():
@@ -53,16 +73,14 @@ class DeepLearning4jEntryPoint:
         return {"score": float(model.score()), "model_path": out}
 
     def evaluate(self, model_path: str, data_dir: str) -> dict:
-        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
         model = self._load_model(model_path)
-        ev = model.evaluate(PathDataSetIterator.from_dir(data_dir))
+        ev = model.evaluate(self._data_iterator(data_dir))
         return {"accuracy": ev.accuracy(), "f1": ev.f1()}
 
     def predict(self, model_path: str, data_dir: str) -> dict:
         import numpy as np
-        from deeplearning4j_tpu.scaleout.data import PathDataSetIterator
         model = self._load_model(model_path)
-        it = PathDataSetIterator.from_dir(data_dir)
+        it = self._data_iterator(data_dir)
         outs = []
         while it.has_next():
             outs.append(np.asarray(model.output(it.next().features)))

@@ -1,0 +1,87 @@
+"""Record → array/DataSet conversion strategies for streaming routes
+(ref: dl4j-streaming/.../streaming/conversion/ndarray/RecordToNDArray.java:13
+interface + CSVRecordToINDArray / NDArrayRecordToNDArray impls;
+conversion/dataset/RecordToDataSet.java + CSVRecordToDataSet).
+
+A "record" is one message's worth of values: a CSV line/string, a
+sequence of numbers, or an ndarray.  Converters collapse a batch of
+records into one array (rows) or a DataSet (features + one-hot labels
+from the trailing column)."""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+Record = Union[str, Sequence[float], np.ndarray]
+
+
+class RecordToNDArray:
+    """(ref: conversion/ndarray/RecordToNDArray.java:13)"""
+
+    def convert(self, records: Iterable[Record]) -> np.ndarray:
+        raise NotImplementedError
+
+
+class CSVRecordToNDArray(RecordToNDArray):
+    """CSV lines (or value sequences) → [N, F] float32 rows
+    (ref: conversion/ndarray/CSVRecordToINDArray.java)."""
+
+    def __init__(self, delimiter: str = ","):
+        self.delimiter = delimiter
+
+    def _row(self, rec: Record) -> np.ndarray:
+        if isinstance(rec, str):
+            vals = [v for v in rec.strip().split(self.delimiter) if v != ""]
+            return np.asarray([float(v) for v in vals], np.float32)
+        return np.asarray(rec, np.float32).ravel()
+
+    def convert(self, records: Iterable[Record]) -> np.ndarray:
+        rows = [self._row(r) for r in records]
+        if not rows:
+            return np.zeros((0, 0), np.float32)
+        return np.stack(rows)
+
+
+class NDArrayRecordToNDArray(RecordToNDArray):
+    """Pre-built arrays → one stacked batch
+    (ref: conversion/ndarray/NDArrayRecordToNDArray.java — concats the
+    record arrays along the batch axis)."""
+
+    def convert(self, records: Iterable[Record]) -> np.ndarray:
+        arrs = [np.asarray(r, np.float32) for r in records]
+        if not arrs:
+            return np.zeros((0, 0), np.float32)
+        arrs = [a[None] if a.ndim == 1 else a for a in arrs]
+        return np.concatenate(arrs, axis=0)
+
+
+class RecordToDataSet:
+    """(ref: conversion/dataset/RecordToDataSet.java — records +
+    numLabels → DataSet)"""
+
+    def convert(self, records: Iterable[Record],
+                num_labels: int) -> DataSet:
+        raise NotImplementedError
+
+
+class CSVRecordToDataSet(RecordToDataSet):
+    """CSV rows whose LAST column is the class index → features +
+    one-hot labels (ref: conversion/dataset/CSVRecordToDataSet.java)."""
+
+    def __init__(self, delimiter: str = ","):
+        self._nd = CSVRecordToNDArray(delimiter)
+
+    def convert(self, records: Iterable[Record],
+                num_labels: int) -> DataSet:
+        m = self._nd.convert(records)
+        if m.size == 0:
+            return DataSet(np.zeros((0, 0), np.float32),
+                           np.zeros((0, num_labels), np.float32))
+        feats = m[:, :-1]
+        idx = m[:, -1].astype(np.int64)
+        labels = np.eye(num_labels, dtype=np.float32)[idx]
+        return DataSet(feats, labels)

@@ -1,0 +1,132 @@
+"""Streaming serve/publish routes
+(ref: dl4j-streaming/.../streaming/routes/DL4jServeRouteBuilder.java:27-95
+— consume messages from a topic, decode each payload to an array,
+run the model, hand predictions to the output; CamelKafkaRouteBuilder —
+records → conversion → serialized bytes → topic).
+
+Camel's route DSL collapses to plain composition: a route is a message
+SOURCE (any iterable — a Kafka consumer when kafka-python is present,
+a directory watcher, an in-process queue), per-message processors, and
+a SINK callable.  The payload decode accepts the reference's own wire
+shapes: a base64-encoded legacy ``Nd4j.write`` buffer (the
+DL4jServeRouteBuilder byte path), npz bytes (this framework's export
+format), or a ready array."""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import io
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.conversion import RecordToNDArray
+
+
+def decode_payload(payload) -> np.ndarray:
+    """One message → ndarray.  Accepts ndarrays/sequences, npz bytes
+    (``features`` or the first entry), or base64-encoded legacy
+    Nd4j.write bytes (ref: DL4jServeRouteBuilder.java:68-74 decodes
+    Base64 then Nd4j.read)."""
+    if isinstance(payload, np.ndarray):
+        return payload
+    if isinstance(payload, (bytes, bytearray)):
+        raw = bytes(payload)
+        if raw[:2] == b"PK":  # npz (zip magic)
+            with np.load(io.BytesIO(raw)) as z:
+                key = "features" if "features" in z.files else z.files[0]
+                return np.asarray(z[key])
+        try:
+            from deeplearning4j_tpu.nn.dl4j_migration import read_nd4j_array
+            return np.asarray(read_nd4j_array(
+                io.BytesIO(base64.b64decode(raw, validate=True))))
+        except (binascii.Error, ValueError, KeyError, EOFError) as e:
+            raise ValueError(
+                f"payload bytes are neither npz nor base64 Nd4j.write: {e}")
+    return np.asarray(payload, np.float32)
+
+
+class DL4jServeRoute:
+    """Model-serving route (ref: DL4jServeRouteBuilder.java:27-95).
+
+    ``before`` / ``final`` processors mirror the builder's
+    beforeProcessor/finalProcessor hooks; ``converter`` turns non-array
+    records (e.g. CSV lines) into the model input."""
+
+    def __init__(self, model_path: str, computation_graph: bool = False,
+                 before: Optional[Callable] = None,
+                 final: Optional[Callable] = None,
+                 converter: Optional[RecordToNDArray] = None):
+        from deeplearning4j_tpu.nn.serialization import (
+            restore_computation_graph, restore_multi_layer_network)
+        if computation_graph:
+            self.model = restore_computation_graph(model_path)
+        else:
+            self.model = restore_multi_layer_network(model_path)
+        self.computation_graph = computation_graph
+        self.before = before
+        self.final = final
+        self.converter = converter
+
+    def process(self, payload) -> np.ndarray:
+        """One message → model prediction."""
+        if self.before is not None:
+            payload = self.before(payload)
+        if self.converter is not None and not isinstance(
+                payload, (np.ndarray, bytes, bytearray)):
+            x = self.converter.convert(
+                payload if isinstance(payload, list) else [payload])
+        else:
+            x = decode_payload(payload)
+        out = self.model.output(x)
+        out = (np.asarray(out[0]) if isinstance(out, (list, tuple))
+               else np.asarray(out))
+        if self.final is not None:
+            out = self.final(out)
+        return out
+
+    def serve(self, source: Iterable, sink: Callable[[np.ndarray], None],
+              max_messages: Optional[int] = None) -> int:
+        """Drain ``source`` through the model into ``sink``; returns the
+        number of messages served (the from(kafka).process(...).to(out)
+        pipeline of the reference, transport supplied by the caller)."""
+        n = 0
+        for msg in source:
+            sink(self.process(msg))
+            n += 1
+            if max_messages is not None and n >= max_messages:
+                break
+        return n
+
+
+class RecordPublishRoute:
+    """Records → conversion → serialized bytes → sink
+    (ref: routes/CamelKafkaRouteBuilder.java — the producing half).
+    The sink is any callable (a Kafka producer's send when available)."""
+
+    def __init__(self, converter: RecordToNDArray,
+                 sink: Callable[[bytes], None]):
+        self.converter = converter
+        self.sink = sink
+
+    @staticmethod
+    def serialize(arr: np.ndarray,
+                  labels: Optional[np.ndarray] = None) -> bytes:
+        """npz bytes in the wire format streaming/kafka.py's
+        ``decode_dataset_message`` consumes: BOTH ``features`` and
+        ``labels`` entries (labels default to an empty [N, 0] block for
+        unlabeled serving traffic)."""
+        feats = np.asarray(arr, np.float32)
+        if labels is None:
+            labels = np.zeros((feats.shape[0] if feats.ndim else 0, 0),
+                              np.float32)
+        buf = io.BytesIO()
+        np.savez(buf, features=feats, labels=np.asarray(labels, np.float32))
+        return buf.getvalue()
+
+    def publish(self, records: List,
+                labels: Optional[np.ndarray] = None) -> bytes:
+        payload = self.serialize(self.converter.convert(records), labels)
+        self.sink(payload)
+        return payload

@@ -1,0 +1,184 @@
+"""UI internationalization
+(ref: deeplearning4j-play/.../ui/i18n/DefaultI18N.java:38-160 — a
+singleton I18N with per-language key→message tables loaded from
+``dl4j_i18n`` resource files, a current language, and an English
+fallback when a key is missing in the requested language; the Play
+resources ship train.<lang> files for en/de/ja/ko/ru/zh).
+
+Resource files become in-module tables plus an optional directory
+loader (``load_directory``) accepting the reference's
+``<prefix>.<lang>`` files of ``key=value`` lines."""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+from typing import Dict, Optional, Union
+
+DEFAULT_LANGUAGE = "en"
+FALLBACK_LANGUAGE = "en"
+
+# Train-UI messages, keyed as the reference's train.* resources
+_MESSAGES: Dict[str, Dict[str, str]] = {
+    "en": {
+        "train.pagetitle": "Training UI",
+        "train.nav.overview": "Overview",
+        "train.nav.model": "Model",
+        "train.nav.histograms": "Histograms",
+        "train.nav.graph": "Graph",
+        "train.nav.flow": "Flow",
+        "train.nav.activations": "Activations",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "System",
+        "train.overview.chart.score": "Score vs iteration",
+        "train.overview.chart.rate": "Samples/sec",
+        "train.model.paramtable.title": "Parameters (latest)",
+        "train.system.memory": "Host RSS (MB)",
+    },
+    "de": {
+        "train.pagetitle": "Trainings-UI",
+        "train.nav.overview": "Übersicht",
+        "train.nav.model": "Modell",
+        "train.nav.histograms": "Histogramme",
+        "train.nav.graph": "Graph",
+        "train.nav.flow": "Fluss",
+        "train.nav.activations": "Aktivierungen",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "System",
+        "train.overview.chart.score": "Score je Iteration",
+        "train.overview.chart.rate": "Beispiele/Sek",
+        "train.model.paramtable.title": "Parameter (aktuell)",
+        "train.system.memory": "Host-RSS (MB)",
+    },
+    "ja": {
+        "train.pagetitle": "トレーニングUI",
+        "train.nav.overview": "概要",
+        "train.nav.model": "モデル",
+        "train.nav.histograms": "ヒストグラム",
+        "train.nav.graph": "グラフ",
+        "train.nav.flow": "フロー",
+        "train.nav.activations": "活性化",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "システム",
+        "train.overview.chart.score": "スコア対反復",
+        "train.overview.chart.rate": "サンプル/秒",
+        "train.model.paramtable.title": "パラメータ（最新）",
+        "train.system.memory": "ホストRSS (MB)",
+    },
+    "ko": {
+        "train.pagetitle": "훈련 UI",
+        "train.nav.overview": "개요",
+        "train.nav.model": "모델",
+        "train.nav.histograms": "히스토그램",
+        "train.nav.graph": "그래프",
+        "train.nav.flow": "플로우",
+        "train.nav.activations": "활성화",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "시스템",
+        "train.overview.chart.score": "반복별 점수",
+        "train.overview.chart.rate": "샘플/초",
+        "train.model.paramtable.title": "파라미터 (최신)",
+        "train.system.memory": "호스트 RSS (MB)",
+    },
+    "ru": {
+        "train.pagetitle": "Интерфейс обучения",
+        "train.nav.overview": "Обзор",
+        "train.nav.model": "Модель",
+        "train.nav.histograms": "Гистограммы",
+        "train.nav.graph": "Граф",
+        "train.nav.flow": "Поток",
+        "train.nav.activations": "Активации",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "Система",
+        "train.overview.chart.score": "Ошибка по итерациям",
+        "train.overview.chart.rate": "Примеров/сек",
+        "train.model.paramtable.title": "Параметры (последние)",
+        "train.system.memory": "RSS хоста (МБ)",
+    },
+    "zh": {
+        "train.pagetitle": "训练界面",
+        "train.nav.overview": "概览",
+        "train.nav.model": "模型",
+        "train.nav.histograms": "直方图",
+        "train.nav.graph": "图",
+        "train.nav.flow": "流程",
+        "train.nav.activations": "激活",
+        "train.nav.tsne": "t-SNE",
+        "train.nav.system": "系统",
+        "train.overview.chart.score": "得分随迭代变化",
+        "train.overview.chart.rate": "样本/秒",
+        "train.model.paramtable.title": "参数（最新）",
+        "train.system.memory": "主机RSS (MB)",
+    },
+}
+
+
+class DefaultI18N:
+    """Singleton message lookup with English fallback
+    (ref: DefaultI18N.java:48 getInstance, :128-152 getMessage with
+    fallback, :155-165 default-language accessors)."""
+
+    _instance: Optional["DefaultI18N"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._messages: Dict[str, Dict[str, str]] = {
+            lang: dict(tbl) for lang, tbl in _MESSAGES.items()}
+        self._current = DEFAULT_LANGUAGE
+
+    @classmethod
+    def get_instance(cls) -> "DefaultI18N":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # -- I18N surface (ref: i18n/I18N.java) --------------------------------
+    def get_message(self, key: str, lang_code: Optional[str] = None) -> str:
+        lang = lang_code or self._current
+        msg = self._messages.get(lang, {}).get(key)
+        if msg is None and lang != FALLBACK_LANGUAGE:
+            msg = self._messages.get(FALLBACK_LANGUAGE, {}).get(key)
+        return msg if msg is not None else key
+
+    def get_default_language(self) -> str:
+        return self._current
+
+    def set_default_language(self, lang_code: str) -> None:
+        self._current = lang_code
+
+    def languages(self):
+        return sorted(self._messages)
+
+    def messages_for(self, lang_code: str) -> Dict[str, str]:
+        """Fallback-merged table for one language (what the dashboard
+        fetches to relabel itself)."""
+        out = dict(self._messages.get(FALLBACK_LANGUAGE, {}))
+        out.update(self._messages.get(lang_code, {}))
+        return out
+
+    # -- resource loading ---------------------------------------------------
+    def load_directory(self, directory: Union[str, Path]) -> int:
+        """Load ``<prefix>.<lang>`` files of ``key=value`` lines — the
+        reference's dl4j_i18n resource layout (DefaultI18N.java:69-106).
+        Returns the number of messages loaded."""
+        import re
+        n = 0
+        for p in sorted(Path(directory).iterdir()):
+            lang = p.suffix.lstrip(".").lower()
+            # the extension must be a 2-letter ISO 639-1 code (the
+            # reference's train.en/.de/... layout) — a stray README.md
+            # or notes.txt must not register an "md"/"txt" UI language
+            if not p.is_file() or not re.fullmatch(r"[a-z]{2}", lang):
+                continue
+            entries = {}
+            for line in p.read_text(encoding="utf-8").splitlines():
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                key, _, val = line.partition("=")
+                entries[key.strip()] = val.strip()
+            if entries:   # never create empty language tables
+                self._messages.setdefault(lang, {}).update(entries)
+                n += len(entries)
+        return n

@@ -15,8 +15,11 @@ browser).  Endpoints:
   GET  /train/model?sid=       per-layer param/update summary stats
   GET  /train/histograms?sid=  per-param parameter/update histograms
                                (ref: TrainModule histogram pages)
-  GET  /train/graph?sid=       model topology for the flow/graph view
+  GET  /train/graph?sid=       model topology for the graph view
                                (ref: TrainModule layer-flow page)
+  GET  /train/flow?sid=        DAG + per-layer stats + performance
+                               state (ref: FlowListenerModule,
+                               flow/FlowIterationListener.java)
   GET  /train/system?sid=      static info + memory timeline
   GET  /train/activations?sid= latest conv/dense activation grids
                                (ref: ConvolutionalListenerModule)
@@ -50,16 +53,18 @@ table{border-collapse:collapse;font-size:13px}
 td,th{border:1px solid #ddd;padding:4px 8px;text-align:right}
 th:first-child,td:first-child{text-align:left}
 </style></head><body>
-<header><b>deeplearning4j_tpu</b> — Training UI
-<select id="session"></select></header>
+<header><b>deeplearning4j_tpu</b> — <span data-i18n="train.pagetitle">Training UI</span>
+<select id="session"></select>
+<select id="lang" style="float:right"></select></header>
 <nav style="padding:8px 20px;background:#34495e">
-<button data-tab="overview" class="active">Overview</button>
-<button data-tab="model">Model</button>
-<button data-tab="histograms">Histograms</button>
-<button data-tab="graph">Graph</button>
-<button data-tab="activations">Activations</button>
-<button data-tab="tsne">t-SNE</button>
-<button data-tab="system">System</button></nav>
+<button data-tab="overview" data-i18n="train.nav.overview" class="active">Overview</button>
+<button data-tab="model" data-i18n="train.nav.model">Model</button>
+<button data-tab="histograms" data-i18n="train.nav.histograms">Histograms</button>
+<button data-tab="graph" data-i18n="train.nav.graph">Graph</button>
+<button data-tab="flow" data-i18n="train.nav.flow">Flow</button>
+<button data-tab="activations" data-i18n="train.nav.activations">Activations</button>
+<button data-tab="tsne" data-i18n="train.nav.tsne">t-SNE</button>
+<button data-tab="system" data-i18n="train.nav.system">System</button></nav>
 <main id="main"></main>
 <script>
 let tab='overview', sid=null;
@@ -131,6 +136,41 @@ async function render(){
    <defs><marker id="arr" markerWidth="8" markerHeight="8" refX="7" refY="4"
     orient="auto"><path d="M0,0 L8,4 L0,8 z" fill="#95a5a6"/></marker></defs>
    ${lines}${boxes}</svg></div>`;}
+ else if(tab=='flow'){const d=await j('/train/flow?sid='+sid);
+  const p=d.performance||{};
+  const fmt=v=>v==null?'—':(typeof v=='number'?v.toPrecision(4):v);
+  const strip=`<div class="card"><h3>Performance</h3><table><tr>
+   <th>iteration</th><th>score</th><th>samples/sec</th><th>iter ms</th><th>RSS MB</th></tr>
+   <tr><td>${fmt(p.iteration)}</td><td>${fmt(p.score)}</td>
+   <td>${fmt(p.samples_per_sec)}</td><td>${fmt(p.duration_ms)}</td>
+   <td>${fmt(p.memory_mb)}</td></tr></table>
+   ${line(p.score_history||[],'#e74c3c')}</div>`;
+  const W=860,rh=56,H=Math.max(120,d.nodes.length*rh+40);
+  const pos={};d.nodes.forEach((n,i)=>pos[n.name]=[W/2,30+i*rh]);
+  const mags=d.nodes.map(n=>n.update_mean_magnitude||0);
+  const mx=Math.max(...mags,1e-12);
+  const lines2=d.edges.filter(e=>pos[e[0]]&&pos[e[1]]).map(e=>{
+   const a=pos[e[0]],b=pos[e[1]];
+   return `<line x1="${a[0]}" y1="${a[1]+18}" x2="${b[0]}" y2="${b[1]-20}"
+    stroke="#95a5a6" stroke-width="1.5" marker-end="url(#arr2)"/>`;}).join('');
+  const boxes=d.nodes.map(n=>{const pp=pos[n.name];
+   const t=(n.update_mean_magnitude||0)/mx;
+   const fill=`rgb(${Math.round(234-100*t)},${Math.round(242-60*t)},248)`;
+   const stats=(n.param_mean_magnitude!=null)
+    ?`|w| ${n.param_mean_magnitude.toPrecision(3)}`
+      +(n.update_mean_magnitude!=null?` · |Δw| ${n.update_mean_magnitude.toPrecision(3)}`:'')
+    :'';
+   return `<rect x="${pp[0]-160}" y="${pp[1]-18}" width="320" height="38" rx="5"
+    fill="${fill}" stroke="#2980b9"/><text x="${pp[0]}" y="${pp[1]-2}"
+    text-anchor="middle" font-size="12">${esc(n.name)} · ${esc(n.type)}</text>
+    <text x="${pp[0]}" y="${pp[1]+13}" text-anchor="middle" font-size="10"
+    fill="#555">${esc(stats)}</text>`;}).join('');
+  m.innerHTML=strip+`<div class="card"><h3>Flow — per-layer state
+   (shade = latest update magnitude)</h3>
+   <svg viewBox="0 0 ${W} ${H}" style="height:${H}px">
+   <defs><marker id="arr2" markerWidth="8" markerHeight="8" refX="7" refY="4"
+    orient="auto"><path d="M0,0 L8,4 L0,8 z" fill="#95a5a6"/></marker></defs>
+   ${lines2}${boxes}</svg></div>`;}
  else if(tab=='activations'){const d=await j('/train/activations?sid='+sid);
   if(!d.layers.length){m.innerHTML='<p>no activation captures — attach an ActivationsListener</p>';}
   else{m.innerHTML=`<h2>Activations (iter ${d.iteration})</h2>`+
@@ -164,8 +204,19 @@ document.querySelectorAll('nav button').forEach(b=>b.onclick=()=>{
  tab=b.dataset.tab;document.querySelectorAll('nav button').forEach(x=>
  x.classList.toggle('active',x===b));render();});
 document.getElementById('session').onchange=e=>{sid=e.target.value;render();};
+async function applyLang(code){
+ const d=await j('/lang/messages'+(code?('?lang='+code):''));
+ const sel=document.getElementById('lang');
+ if(!sel.options.length){for(const l of d.languages){
+  const o=document.createElement('option');o.textContent=l;o.value=l;
+  sel.appendChild(o);}}
+ sel.value=d.language;
+ document.querySelectorAll('[data-i18n]').forEach(el=>{
+  const m=d.messages[el.dataset.i18n];if(m)el.textContent=m;});}
+document.getElementById('lang').onchange=async e=>{
+ await j('/lang/setCurrent/'+e.target.value);await applyLang(e.target.value);};
 setInterval(async()=>{await refreshSessions();await render();},2000);
-refreshSessions().then(render);
+refreshSessions().then(render);applyLang();
 </script></body></html>"""
 
 
@@ -213,6 +264,8 @@ class UIServer:
                         self._json(server._histograms(sid))
                     elif u.path == "/train/graph":
                         self._json(server._graph(sid))
+                    elif u.path == "/train/flow":
+                        self._json(server._flow(sid))
                     elif u.path == "/train/system":
                         self._json(server._system(sid))
                     elif u.path == "/train/activations":
@@ -220,6 +273,24 @@ class UIServer:
                     elif u.path == "/train/tsne":
                         self._json(server._tsne.get(sid) or
                                    {"words": [], "coords": []})
+                    elif u.path == "/lang/getCurrent":
+                        from deeplearning4j_tpu.ui.i18n import DefaultI18N
+                        self._json({"currentLanguage":
+                                    DefaultI18N.get_instance()
+                                    .get_default_language()})
+                    elif u.path.startswith("/lang/setCurrent/"):
+                        from deeplearning4j_tpu.ui.i18n import DefaultI18N
+                        code = u.path.rsplit("/", 1)[-1]
+                        DefaultI18N.get_instance().set_default_language(code)
+                        self._json({"ok": True, "currentLanguage": code})
+                    elif u.path == "/lang/messages":
+                        from deeplearning4j_tpu.ui.i18n import DefaultI18N
+                        i18n = DefaultI18N.get_instance()
+                        lang = q.get("lang", [None])[0] or \
+                            i18n.get_default_language()
+                        self._json({"language": lang,
+                                    "languages": i18n.languages(),
+                                    "messages": i18n.messages_for(lang)})
                     else:
                         self._send(404, b'{"error":"not found"}')
                 except Exception as e:
@@ -401,6 +472,61 @@ class UIServer:
                 edges.append([prev, name])
                 prev = name
         return {"nodes": nodes, "edges": edges}
+
+    def _flow(self, sid) -> dict:
+        """Flow page payload: the network DAG annotated with per-layer
+        parameter/update stats plus the model performance state
+        (ref: ui/module/flow/FlowListenerModule.java routes;
+        ui/flow/FlowIterationListener.java:251-266 — ModelInfo layers +
+        ModelState score/performance/memory)."""
+        topo = self._graph(sid)
+        ups = self._updates(sid)
+        latest = ups[-1] if ups else {}
+        params = latest.get("params", {})
+        updates = latest.get("updates", {})
+
+        static = self._static(sid) or {}
+        is_mln = static.get("model_class") == "MultiLayerNetwork"
+
+        def prefix_for(name):
+            # StatsListener flattens MLN params as "<i>_<key>" while the
+            # topology names chain nodes "layer<i>"; CG params use the
+            # vertex name directly — decided by the recorded model class
+            # (a CG vertex may legitimately be NAMED "layer1")
+            if is_mln and name.startswith("layer") and name[5:].isdigit():
+                return name[5:] + "_"
+            return name + "_"
+
+        def agg(src, pre):
+            vals = [v.get("mean_magnitude") for k, v in src.items()
+                    if k.startswith(pre)
+                    and v.get("mean_magnitude") is not None]
+            return (sum(vals) / len(vals)) if vals else None
+
+        nodes = []
+        for nd in topo["nodes"]:
+            pre = prefix_for(nd["name"])
+            node = dict(nd)
+            node["param_mean_magnitude"] = agg(params, pre)
+            node["update_mean_magnitude"] = agg(updates, pre)
+            node["params"] = sorted(
+                k[len(pre):] for k in params if k.startswith(pre))
+            nodes.append(node)
+        perf = latest.get("perf", {})
+        mem = latest.get("memory", {})
+        return {
+            "nodes": nodes,
+            "edges": topo["edges"],
+            "performance": {
+                "iteration": latest.get("iteration"),
+                "score": latest.get("score"),
+                "samples_per_sec": perf.get("samples_per_sec"),
+                "duration_ms": perf.get("duration_ms"),
+                "memory_mb": mem.get("host_rss_mb"),
+                "score_history": [[u["iteration"], u["score"]]
+                                  for u in ups][-100:],
+            },
+        }
 
     def _system(self, sid) -> dict:
         ups = self._updates(sid)

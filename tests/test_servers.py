@@ -109,3 +109,98 @@ def test_gateway_fit_evaluate(tmp_path):
 def test_entry_point_direct(tmp_path):
     ep = DeepLearning4jEntryPoint()
     assert hasattr(ep, "fit") and hasattr(ep, "evaluate")
+
+
+def test_gateway_hdf5_minibatch_dirs(tmp_path):
+    """The reference's HDF5 minibatch layout (round-4 verdict next #9,
+    ref: keras/HDF5MiniBatchDataSetIterator.java:24 batch_%d.h5 in
+    separate features/labels dirs, each array in a "data" dataset —
+    NDArrayHDF5Reader.java:33): gateway fit + evaluate over it."""
+    import h5py
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.serialization import write_model
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(60, 5)).astype(np.float32)
+    w = rng.normal(size=(5, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    data_dir = tmp_path / "data"
+    (data_dir / "features").mkdir(parents=True)
+    (data_dir / "labels").mkdir()
+    for i in range(3):
+        sl = slice(20 * i, 20 * (i + 1))
+        with h5py.File(data_dir / "features" / f"batch_{i}.h5", "w") as f:
+            f.create_dataset("data", data=x[sl])
+        with h5py.File(data_dir / "labels" / f"batch_{i}.h5", "w") as f:
+            f.create_dataset("data", data=y[sl])
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=5, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    model_path = str(tmp_path / "model.zip")
+    write_model(MultiLayerNetwork(conf).init(), model_path)
+
+    ep = DeepLearning4jEntryPoint()
+    out = ep.fit(model_path, str(data_dir), epochs=30)
+    assert np.isfinite(out["score"])
+    ev = ep.evaluate(out["model_path"], str(data_dir))
+    assert ev["accuracy"] > 0.8
+
+
+def test_hdf5_iterator_single_dir_and_errors(tmp_path):
+    """Single-dir convenience layout (features+labels datasets per
+    file), index ordering past 9, and missing-file errors."""
+    import h5py
+    from deeplearning4j_tpu.keras_import.hdf5_data import (
+        HDF5MiniBatchDataSetIterator)
+
+    d = tmp_path / "mb"
+    d.mkdir()
+    # 11 files: lexicographic order would put batch_10 before batch_2
+    for i in range(11):
+        with h5py.File(d / f"batch_{i}.h5", "w") as f:
+            f.create_dataset("features",
+                             data=np.full((2, 3), float(i), np.float32))
+            f.create_dataset("labels",
+                             data=np.full((2, 2), float(i), np.float32))
+    it = HDF5MiniBatchDataSetIterator(d)
+    assert len(it) == 11
+    seen = [float(ds.features[0, 0]) for ds in it]
+    assert seen == [float(i) for i in range(11)]   # numeric index order
+    it.reset()
+    assert it.has_next()
+
+    # reference layout with a missing labels file → explicit error
+    (tmp_path / "f").mkdir()
+    (tmp_path / "l").mkdir()
+    with h5py.File(tmp_path / "f" / "batch_0.h5", "w") as f:
+        f.create_dataset("data", data=np.zeros((2, 3), np.float32))
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError, match="missing"):
+        HDF5MiniBatchDataSetIterator(tmp_path / "f", tmp_path / "l")
+
+
+def test_gateway_stray_h5_does_not_hijack_npz_dir(tmp_path):
+    """A non-conforming .h5 file next to valid .npz minibatches must not
+    reroute the directory away from the npz path (round-5 review)."""
+    import h5py
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.scaleout.data import export_dataset
+
+    d = tmp_path / "data"
+    d.mkdir()
+    rng = np.random.default_rng(2)
+    export_dataset(DataSet(rng.normal(size=(4, 3)).astype(np.float32),
+                           np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]),
+                   d / "b0.npz")
+    with h5py.File(d / "batch_old.h5", "w") as f:   # no numeric index
+        f.create_dataset("junk", data=np.zeros(3))
+    it = DeepLearning4jEntryPoint._data_iterator(str(d))
+    ds = it.next()
+    assert ds.features.shape == (4, 3)

@@ -200,6 +200,74 @@ def test_ui_server_graph_for_computation_graph():
         srv.stop()
 
 
+def test_ui_server_flow_page_mln():
+    """Flow page (round-4 verdict next #8, ref: ui/module/flow/
+    FlowListenerModule.java): DAG nodes annotated with per-layer
+    param/update magnitudes + the performance state, for an MLN session."""
+    st = InMemoryStatsStorage()
+    _train_with_listener(st)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        d = _get(base + "/train/flow?sid=sess-test")
+        names = [n["name"] for n in d["nodes"]]
+        assert names == ["input", "layer0", "layer1"]
+        by = {n["name"]: n for n in d["nodes"]}
+        # param layers annotated; the input node has no params
+        assert by["layer0"]["param_mean_magnitude"] is not None
+        assert by["layer0"]["params"] == ["W", "b"]
+        assert by["layer1"]["update_mean_magnitude"] is not None
+        assert by["input"]["param_mean_magnitude"] is None
+        p = d["performance"]
+        assert p["iteration"] is not None and np.isfinite(p["score"])
+        assert p["samples_per_sec"] is not None
+        assert len(p["score_history"]) == 3
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            assert 'data-tab="flow"' in r.read().decode()
+    finally:
+        srv.stop()
+
+
+def test_ui_server_flow_page_cg():
+    """Flow page for a ComputationGraph session: vertex-named stats —
+    including a vertex literally named "layer1", which must NOT be
+    misrouted through the MLN index-prefix heuristic (round-5 review)."""
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = GlobalConf(seed=3, learning_rate=0.1, updater="adam")
+    conf = (GraphBuilder(g)
+            .add_inputs("in")
+            .add_layer("layer1", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                          activation="softmax",
+                                          loss="mcxent"), "layer1")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    st = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(st, session_id="cg-flow"))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(x, y)
+    net.fit(x, y)
+    srv = UIServer()
+    try:
+        srv.attach(st)
+        base = f"http://{srv.host}:{srv.port}"
+        d = _get(base + "/train/flow?sid=cg-flow")
+        by = {n["name"]: n for n in d["nodes"]}
+        assert by["layer1"]["param_mean_magnitude"] is not None
+        assert by["layer1"]["params"] == ["W", "b"]
+        assert by["out"]["update_mean_magnitude"] is not None
+        assert d["performance"]["score"] is not None
+    finally:
+        srv.stop()
+
+
 def test_ui_server_activations_page():
     """(ref: ConvolutionalListenerModule /activations — per-layer feature
     map grids served to the dashboard)"""
@@ -330,3 +398,51 @@ def test_roc_binary_elementwise_mask():
     rb.eval(labels, scores, mask=mask)  # per-element mask must not crash
     assert rb.num_outputs() == 3
     assert 0.0 <= rb.auc(0) <= 1.0
+
+
+def test_i18n_messages_and_fallback(tmp_path):
+    """(ref: ui/i18n/DefaultI18N.java:38-160 — per-language tables,
+    English fallback, resource-file loading, current language)"""
+    from deeplearning4j_tpu.ui.i18n import DefaultI18N
+    i18n = DefaultI18N()   # fresh, not the singleton
+    assert i18n.get_message("train.nav.overview") == "Overview"
+    assert i18n.get_message("train.nav.overview", "de") == "Übersicht"
+    assert i18n.get_message("train.nav.overview", "ja") == "概要"
+    # missing key in a known language falls back to English
+    i18n._messages["de"].pop("train.system.memory", None)
+    assert i18n.get_message("train.system.memory", "de") == "Host RSS (MB)"
+    # unknown key comes back verbatim (the reference returns the key)
+    assert i18n.get_message("no.such.key", "zh") == "no.such.key"
+    # current language
+    i18n.set_default_language("ko")
+    assert i18n.get_message("train.nav.model") == "모델"
+    # the reference's resource layout: <prefix>.<lang> key=value files
+    (tmp_path / "train.fr").write_text(
+        "train.nav.overview=Aperçu\ntrain.nav.model=Modèle\n")
+    (tmp_path / "README.md").write_text("# not a language resource\n")
+    (tmp_path / "notes.txt").write_text("key=value\n")
+    n = i18n.load_directory(tmp_path)
+    assert n == 2
+    assert "md" not in i18n.languages() and "txt" not in i18n.languages()
+    assert i18n.get_message("train.nav.overview", "fr") == "Aperçu"
+    assert "fr" in i18n.languages()
+
+
+def test_ui_server_lang_endpoints():
+    """(ref: the Play UI lang/getCurrent + lang/setCurrent routes)"""
+    from deeplearning4j_tpu.ui.i18n import DefaultI18N
+    srv = UIServer()
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        cur = _get(base + "/lang/getCurrent")["currentLanguage"]
+        d = _get(base + "/lang/messages?lang=ja")
+        assert d["messages"]["train.nav.overview"] == "概要"
+        assert "en" in d["languages"] and "zh" in d["languages"]
+        assert _get(base + "/lang/setCurrent/de")["ok"]
+        assert _get(base + "/lang/getCurrent")["currentLanguage"] == "de"
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            html = r.read().decode()
+        assert 'data-i18n="train.nav.flow"' in html
+    finally:
+        DefaultI18N.get_instance().set_default_language(cur)
+        srv.stop()

@@ -1,0 +1,45 @@
+"""Subprocess worker for the multi-process distributed Word2Vec test
+(ref: the per-executor side of spark/models/embeddings/word2vec/
+Word2Vec.java:55).  Invoked by tests/test_scaleout.py with argv:
+host port process_id num_processes corpus_path epochs
+
+Prints `SYN0_DIGEST <pid> <sha1>` and `SIM <pid> <same> <cross>` for
+the parent to compare across processes.
+"""
+import hashlib
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.scaleout.nlp import DistributedWord2Vec  # noqa: E402
+
+
+def main():
+    host, port, pid, nproc, corpus_path, epochs = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
+        sys.argv[5], int(sys.argv[6]))
+    with open(corpus_path) as f:
+        sentences = [ln.strip() for ln in f if ln.strip()]
+    dist = DistributedWord2Vec(layer_size=16, window=3,
+                               min_word_frequency=1, negative=5,
+                               seed=7, epochs=epochs)
+    model = dist.fit_process_shard(
+        sentences, process_id=pid, num_processes=nproc,
+        server_host=host, server_port=port)
+    syn0 = np.asarray(model.lookup_table.syn0, np.float32)
+    digest = hashlib.sha1(syn0.tobytes()).hexdigest()[:16]
+    print(f"SYN0_DIGEST {pid} {digest}")
+    same = model.similarity("dog", "cat")
+    cross = model.similarity("dog", "moon")
+    print(f"SIM {pid} {same:.4f} {cross:.4f}")
+
+
+if __name__ == "__main__":
+    main()
