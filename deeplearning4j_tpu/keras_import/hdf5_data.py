@@ -77,8 +77,15 @@ class HDF5MiniBatchDataSetIterator(DataSetIterator):
             x = read_hdf5_ndarray(p)
             y = read_hdf5_ndarray(self.labels_dir / p.name)
         else:
-            x = read_hdf5_ndarray(p, "features")
-            y = read_hdf5_ndarray(p, "labels")
+            # one open for both datasets
+            import h5py
+            with h5py.File(str(p), "r") as f:
+                for ds in ("features", "labels"):
+                    if ds not in f:
+                        raise KeyError(f"{p}: no {ds!r} dataset "
+                                       f"(has {list(f.keys())})")
+                x = np.asarray(f["features"], np.float32)
+                y = np.asarray(f["labels"], np.float32)
         return DataSet(x, y)
 
     def reset(self) -> None:

@@ -38,8 +38,19 @@ def batch_norm_train(x, gamma, beta, running_mean, running_var, *,
 
 
 def batch_norm_infer(x, gamma, beta, running_mean, running_var, *, eps: float = 1e-5):
+    """Inference-mode batchnorm from carried running stats.
+
+    Running stats stay float32 under a bf16 policy (see batch_norm_train),
+    so normalization runs in float32 but the OUTPUT is cast back to the
+    activation dtype — otherwise a bf16 net's activations silently
+    promote to f32 after every BN and the next conv crashes on the
+    lhs/rhs dtype mismatch (lax.conv requires equal dtypes)."""
     shape = (1, -1) if x.ndim == 2 else (1, -1, 1, 1)
-    xn = (x - running_mean.reshape(shape)) / jnp.sqrt(running_var.reshape(shape) + eps)
+    stat_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    xs = x.astype(stat_dtype)
+    xn = ((xs - running_mean.astype(stat_dtype).reshape(shape))
+          / jnp.sqrt(running_var.astype(stat_dtype).reshape(shape) + eps)
+          ).astype(x.dtype)
     return gamma.reshape(shape) * xn + beta.reshape(shape)
 
 

@@ -302,7 +302,18 @@ class DistributedWord2Vec:
 
         client = ParameterServerClient(server_host, server_port)
         try:
-            current = client.get_nd_array()   # identical seed for all
+            # round-0 barrier: every process must pull the seed before
+            # ANY round-1 push lands (the server applies pushes
+            # immediately, so an unguarded seed pull could read a fast
+            # peer's round-1 delta)
+            current = client.get_nd_array()
+            client.increment_counter("pulled:0")
+            deadline0 = time.time() + timeout
+            while client.read_counter("pulled:0") < num_processes:
+                if time.time() > deadline0:
+                    raise TimeoutError(
+                        f"seed barrier not reached within {timeout}s")
+                time.sleep(poll_interval)
             for rnd in range(1, self.epochs + 1):
                 syn0, syn1, syn1neg = self._unpack(current, shapes)
                 if replica is not None:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import base64
 import binascii
 import io
+import struct
 from typing import Callable, Iterable, List, Optional
 
 import numpy as np
@@ -41,7 +42,8 @@ def decode_payload(payload) -> np.ndarray:
             from deeplearning4j_tpu.nn.dl4j_migration import read_nd4j_array
             return np.asarray(read_nd4j_array(
                 io.BytesIO(base64.b64decode(raw, validate=True))))
-        except (binascii.Error, ValueError, KeyError, EOFError) as e:
+        except (binascii.Error, ValueError, KeyError, EOFError,
+                struct.error) as e:   # short/garbage buffers included
             raise ValueError(
                 f"payload bytes are neither npz nor base64 Nd4j.write: {e}")
     return np.asarray(payload, np.float32)

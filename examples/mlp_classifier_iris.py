@@ -1,0 +1,53 @@
+"""Feedforward classifier on Iris — the dl4j-examples
+``IrisClassifier``/``MLPClassifier*`` recipe: builder DSL, normalizer,
+train/test split, evaluation.
+
+Run:  python examples/mlp_classifier_iris.py [--platform cpu]
+"""
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    ds = load_iris().shuffle(seed=42)
+    train, test = ds.split_test_and_train(120)
+    norm = NormalizerStandardize().fit(train)
+    train, test = norm.transform(train), norm.transform(test)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(6).learning_rate(0.1).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ListDataSetIterator(train, 30), epochs=args.epochs)
+    ev = net.evaluate(ListDataSetIterator(test, 30))
+    print(ev.stats())
+    print(f"accuracy={ev.accuracy():.4f}")
+
+
+if __name__ == "__main__":
+    main()

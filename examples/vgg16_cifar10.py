@@ -1,0 +1,51 @@
+"""VGG16 on CIFAR-10 — the BASELINE.md north-star conv/BN recipe
+(dl4j-examples VGG/CIFAR training + the Keras-modelimport path).
+
+Run:  python examples/vgg16_cifar10.py [--steps 20] [--platform cpu]
+
+Use ``--tiny`` on CPU: the full 15-conv stack at batch 256 is a
+TPU-shaped workload (bf16 MXU gemms), not a laptop one.
+"""
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--tiny", action="store_true",
+                    help="batch 8 / 2 steps, for a quick CPU check")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.tiny:
+        args.batch, args.steps = 8, 2
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.fetchers import load_cifar10
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models.vgg import vgg16_cifar10
+    from deeplearning4j_tpu.nn.listeners import PerformanceListener
+
+    net = vgg16_cifar10()
+    net.conf.global_conf.precision = "bf16"
+    net.set_listeners(PerformanceListener(frequency=5))
+
+    data = load_cifar10(train=True)
+    n = min(args.batch * args.steps, data.features.shape[0])
+    ds = DataSet(np.asarray(data.features[:n]), np.asarray(data.labels[:n]))
+    net.fit(ListDataSetIterator(ds, args.batch), epochs=1)
+    print(f"final score={float(net.score(ds.get_range(0, args.batch))):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Word2Vec on raw text — the dl4j-examples ``Word2VecRawTextExample``
+recipe: sentence iterator + tokenizer → skip-gram training (fused XLA
+kernels) → nearest-word queries; optionally distributed over a worker
+pool (the Spark Word2Vec tier).
+
+Run:  python examples/word2vec_raw_text.py [--partitions 4] [--platform cpu]
+"""
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
+import argparse
+
+_SENTENCES = (
+    ["the cat and the dog play together in the garden",
+     "a dog chases the cat around the house",
+     "my pet cat sleeps near the friendly dog",
+     "the dog and cat share a pet bed"] * 25
+    + ["the sun and the moon light the evening sky",
+       "a bright moon rises in the clear night sky",
+       "the sun warms the morning sky over the hills",
+       "the moon follows the sun across the sky"] * 25)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layer-size", type=int, default=50)
+    ap.add_argument("--partitions", type=int, default=1,
+                    help=">1 trains distributed with parameter averaging")
+    ap.add_argument("--text-file", default=None)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    sentences = ([ln.strip() for ln in open(args.text_file) if ln.strip()]
+                 if args.text_file else _SENTENCES)
+
+    if args.partitions > 1:
+        from deeplearning4j_tpu.scaleout.nlp import DistributedWord2Vec
+        model = DistributedWord2Vec(
+            layer_size=args.layer_size, window=5, min_word_frequency=2,
+            num_partitions=args.partitions, seed=42, epochs=2,
+        ).fit(sentences)
+    else:
+        from deeplearning4j_tpu.embeddings.word2vec import Word2Vec
+        from deeplearning4j_tpu.text.sentence_iterators import (
+            CollectionSentenceIterator)
+        builder = Word2Vec.Builder().iterate(
+            CollectionSentenceIterator(sentences))
+        builder.conf.layer_size = args.layer_size
+        builder.conf.window = 5
+        builder.conf.min_word_frequency = 2
+        builder.conf.seed = 42
+        model = builder.build()
+        model.fit()
+
+    for w in ("dog", "sun"):
+        print(f"nearest({w}) = {model.words_nearest(w, top=5)}")
+    print(f"similarity(dog, cat) = {model.similarity('dog', 'cat'):.3f}")
+    print(f"similarity(dog, moon) = {model.similarity('dog', 'moon'):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""examples/ recipes run end-to-end — the dl4j-examples role
+(BASELINE.md names its targets as dl4j-examples recipes; these are the
+switch-over entry points a reference user reaches for first).
+
+Each example is executed as a real subprocess (its own interpreter,
+sys.path bootstrap, CLI parsing) with tiny settings."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+HERE = Path(__file__).resolve().parent
+EXAMPLES = HERE.parent / "examples"
+
+
+def _run(script, *args, timeout=420, env=None):
+    import os
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    p = subprocess.run(
+        [sys.executable, str(EXAMPLES / script), "--platform", "cpu",
+         *args],
+        capture_output=True, text=True, timeout=timeout, env=full_env,
+        cwd=str(EXAMPLES.parent))
+    assert p.returncode == 0, f"{script} failed:\n{p.stdout}\n{p.stderr[-3000:]}"
+    return p.stdout
+
+
+def test_mlp_classifier_iris():
+    out = _run("mlp_classifier_iris.py", "--epochs", "20")
+    assert "accuracy=" in out
+
+
+def test_lenet_mnist():
+    out = _run("lenet_mnist.py", "--epochs", "1", "--examples", "256",
+               "--batch", "64")
+    assert "accuracy=" in out
+
+
+def test_char_rnn_generation():
+    out = _run("char_rnn_generation.py", "--epochs", "1", "--hidden", "32",
+               "--sample-chars", "20")
+    assert "generated:" in out
+
+
+def test_word2vec_raw_text():
+    out = _run("word2vec_raw_text.py", "--layer-size", "16")
+    assert "nearest(dog)" in out
+
+
+def test_word2vec_distributed():
+    out = _run("word2vec_raw_text.py", "--layer-size", "16",
+               "--partitions", "2")
+    assert "similarity(dog, cat)" in out
+
+
+def test_vgg16_cifar10_tiny():
+    out = _run("vgg16_cifar10.py", "--tiny", timeout=600)
+    assert "final score=" in out
+
+
+def test_resnet50_data_parallel_tiny():
+    out = _run("resnet50_data_parallel.py", "--tiny", timeout=600,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "trained 2 steps" in out
+
+
+def test_transfer_learning():
+    out = _run("transfer_learning.py", "--epochs", "5")
+    assert "checkpoint round-trip exact" in out

@@ -170,3 +170,56 @@ def test_bf16_computation_graph():
         assert leaf.dtype == jnp.float32
     out = net.output(x)[0]
     assert out.dtype == jnp.float32
+
+
+def test_bf16_conv_after_bn_inference():
+    """Round-5 bug (caught by examples/resnet50_data_parallel.py):
+    BN INFERENCE promoted bf16 activations to f32 through its float32
+    running stats, crashing the next conv (lax.conv requires equal
+    dtypes).  score()/output() on a bf16 conv->BN->conv net must work."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        BatchNormalization, ConvolutionLayer, OutputLayer)
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater("sgd").precision("bf16")
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                    activation="relu"))
+            .layer(BatchNormalization())
+            .layer(ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                    activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 1, 8, 8)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+    net.fit(x, y)                       # train mode already worked
+    s = float(net.score(DataSet(x, y)))     # eval mode used to crash
+    out = np.asarray(net.output(x))
+    assert np.isfinite(s) and out.shape == (4, 2)
+
+
+def test_bf16_resnet18_graph_score():
+    """Same bug through the ComputationGraph eval path (residual conv
+    net with BN between convs)."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import resnet18
+
+    net = resnet18(height=16, width=16, n_classes=4)
+    net.conf.global_conf.precision = "bf16"
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 3, 16, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[[0, 1, 2, 3]]
+    net.fit(x, y)
+    assert np.isfinite(float(net.score(DataSet(x, y))))
+    assert np.asarray(net.output(x)[0]).shape == (4, 4)

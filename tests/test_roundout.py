@@ -223,3 +223,13 @@ def test_csv_record_to_dataset():
     ds = CSVRecordToDataSet().convert(["0.5,1.5,0", "2.5,3.5,2"], 3)
     np.testing.assert_allclose(ds.features, [[0.5, 1.5], [2.5, 3.5]])
     np.testing.assert_allclose(ds.labels, [[1, 0, 0], [0, 0, 1]])
+
+
+def test_decode_payload_garbage_bytes_raise_valueerror():
+    """Short/garbage byte payloads must fail with the designed
+    ValueError, not an opaque struct.error (round-5 review)."""
+    from deeplearning4j_tpu.streaming.routes import decode_payload
+    with pytest.raises(ValueError, match="neither npz nor base64"):
+        decode_payload(b"abcd")
+    with pytest.raises(ValueError, match="neither npz nor base64"):
+        decode_payload(b"!!not-base64!!")
