@@ -100,6 +100,31 @@ def _build_local_w2v(vocab, sentences, layer_size, window,
     return w2v
 
 
+def _run_averaging_rounds(replicas, weights, lookup_table, rounds):
+    """The parameter-averaging core shared by DistributedWord2Vec and
+    DistributedSequenceVectors: each round, every replica trains one
+    epoch on its shard from the CURRENT shared weights, then the shared
+    weights absorb the weight_i-scaled deltas.  Mutates and finalizes
+    ``lookup_table`` in place."""
+    import numpy as np
+    import jax.numpy as jnp
+    syn0 = np.array(lookup_table.syn0, np.float32)
+    syn1 = np.array(lookup_table.syn1, np.float32)
+    syn1neg = np.array(lookup_table.syn1neg, np.float32)
+    for _round in range(rounds):
+        with ThreadPoolExecutor(max_workers=len(replicas)) as ex:
+            deltas = list(ex.map(
+                lambda r: _shard_round(r, syn0, syn1, syn1neg),
+                replicas))
+        for (d0, d1, d1n), w in zip(deltas, weights):
+            syn0 += w * d0
+            syn1 += w * d1
+            syn1neg += w * d1n
+    lookup_table.syn0 = jnp.asarray(syn0)
+    lookup_table.syn1 = jnp.asarray(syn1)
+    lookup_table.syn1neg = jnp.asarray(syn1neg)
+
+
 def _shard_round(w2v, syn0, syn1, syn1neg):
     """One parameter-averaging round on one shard: seed the replica with
     the shared weights, train one epoch, return the weight deltas.
@@ -201,13 +226,10 @@ class DistributedWord2Vec:
         import numpy as np
         sentences = list(sentences)
         vocab, shards, weights = self._vocab_and_shards(sentences)
+        if not shards:
+            raise ValueError("DistributedWord2Vec.fit: corpus has no "
+                             "non-empty sentences")
         shared = self._seed_model(vocab, sentences)
-        lt = shared.lookup_table
-        # writable host copies (np.asarray of a jax array is read-only)
-        syn0 = np.array(lt.syn0, np.float32)
-        syn1 = np.array(lt.syn1, np.float32)
-        syn1neg = np.array(lt.syn1neg, np.float32)
-
         replicas = [
             _build_local_w2v(
                 vocab, shard, self.layer_size, self.window,
@@ -216,21 +238,8 @@ class DistributedWord2Vec:
                 self.iterations, self.learning_rate,
                 self.tokenizer_factory, self.stop_words)
             for i, shard in enumerate(shards)]
-
-        for _round in range(self.epochs):
-            with ThreadPoolExecutor(max_workers=len(replicas)) as ex:
-                deltas = list(ex.map(
-                    lambda r: _shard_round(r, syn0, syn1, syn1neg),
-                    replicas))
-            for (d0, d1, d1n), w in zip(deltas, weights):
-                syn0 += w * d0
-                syn1 += w * d1
-                syn1neg += w * d1n
-
-        import jax.numpy as jnp
-        lt.syn0 = jnp.asarray(syn0)
-        lt.syn1 = jnp.asarray(syn1)
-        lt.syn1neg = jnp.asarray(syn1neg)
+        _run_averaging_rounds(replicas, weights, shared.lookup_table,
+                              self.epochs)
         self.model = shared
         return shared
 
@@ -341,6 +350,92 @@ class DistributedWord2Vec:
         lt.syn0 = jnp.asarray(syn0)
         lt.syn1 = jnp.asarray(syn1)
         lt.syn1neg = jnp.asarray(syn1neg)
+        self.model = shared
+        return shared
+
+
+class DistributedSequenceVectors:
+    """Generic SequenceVectors trained across SEQUENCE shards with
+    per-round parameter averaging — the reference's
+    SparkSequenceVectors / SparkParagraphVectors tier
+    (ref: dl4j-spark-nlp-java8/.../SparkSequenceVectors.java — executors
+    train the shared vocab on sequence partitions and the driver
+    aggregates; SparkParagraphVectors is the same engine with
+    ``train_sequences=True``).
+
+    Works for any Sequence stream — DeepWalk walks, labeled paragraph
+    sequences, token sequences — using the same round structure as
+    :class:`DistributedWord2Vec`: each round every worker trains a
+    replica of the shared weights on its shard, and the shared weights
+    absorb the element-count-weighted average of the deltas.
+
+    Convergence rule of thumb: when shards are statistically similar,
+    the averaged round moves the shared weights about as far as ONE
+    shard's epoch — i.e. one round ≈ 1/num_partitions of a full
+    single-process epoch.  Budget ``epochs ≈ num_partitions ×
+    single-process epochs`` for equivalent data passes (measured: P=4
+    at 4×6 rounds matches P=1 at 6 epochs on a community-separation
+    task).  The reference's Spark tier has the same trade; it mitigates
+    with sub-epoch averaging frequencies."""
+
+    def __init__(self, configuration=None, num_partitions: int = 4,
+                 epochs: Optional[int] = None, seed_offset: int = 13):
+        """``epochs`` is the number of averaging ROUNDS (one collective
+        pass over the corpus each); when omitted it follows
+        ``configuration.epochs`` so a VectorsConfiguration(epochs=N) is
+        honored rather than silently reduced to one round."""
+        from deeplearning4j_tpu.embeddings.sequencevectors import (
+            VectorsConfiguration)
+        self.conf = configuration or VectorsConfiguration()
+        self.num_partitions = num_partitions
+        self.epochs = epochs if epochs is not None else self.conf.epochs
+        self.seed_offset = seed_offset
+        self.model = None
+
+    def _replica(self, vocab, shard, seed):
+        import dataclasses as _dc
+        from deeplearning4j_tpu.embeddings.sequencevectors import (
+            SequenceVectors)
+        conf = _dc.replace(self.conf, seed=seed, epochs=1)
+        sv = SequenceVectors(conf, vocab=vocab)
+        sv._sequence_source = list(shard)
+        return sv
+
+    def fit(self, sequences) -> "object":
+        """``sequences``: a list/iterable of
+        :class:`~deeplearning4j_tpu.text.sequence.Sequence`.  Returns
+        the trained queryable SequenceVectors holding the averaged
+        weights."""
+        import numpy as np
+        from deeplearning4j_tpu.embeddings.sequencevectors import (
+            SequenceVectors)
+        from deeplearning4j_tpu.text.vocab import VocabConstructor
+
+        sequences = list(sequences)
+        if not sequences:
+            raise ValueError(
+                "DistributedSequenceVectors.fit: no sequences")
+        ctor = VocabConstructor(
+            min_element_frequency=self.conf.min_word_frequency,
+            build_huffman=True)
+        ctor.add_source(sequences)
+        vocab = ctor.build_joint_vocabulary()
+
+        shards = repartition_balanced(sequences, self.num_partitions)
+        shards = [s for s in shards if s]
+        counts = [sum(seq.size() for seq in s) for s in shards]
+        total = float(sum(counts)) or 1.0
+        weights = np.asarray(counts, np.float64) / total
+
+        shared = SequenceVectors(self.conf, vocab=vocab)
+        shared._sequence_source = sequences
+        shared.build_vocab()
+        replicas = [
+            self._replica(vocab, shard,
+                          self.conf.seed + self.seed_offset * (i + 1))
+            for i, shard in enumerate(shards)]
+        _run_averaging_rounds(replicas, weights, shared.lookup_table,
+                              self.epochs)
         self.model = shared
         return shared
 

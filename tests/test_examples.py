@@ -71,3 +71,15 @@ def test_resnet50_data_parallel_tiny():
 def test_transfer_learning():
     out = _run("transfer_learning.py", "--epochs", "5")
     assert "checkpoint round-trip exact" in out
+
+
+def test_graph_deepwalk():
+    out = _run("graph_deepwalk.py", "--walks-per-vertex", "4")
+    assert "nearest(1)" in out
+
+
+def test_long_context_attention():
+    out = _run("long_context_attention.py", "--steps", "3",
+               "--seq-len", "32", timeout=600,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "time dim sharded" in out and "score" in out
