@@ -391,7 +391,8 @@ def test_distributed_word2vec_empty_shard_process():
                 subprocess.Popen(
                     [sys.executable, str(here / "w2v_worker.py"),
                      node.host, str(node.port), str(i), "2",
-                     str(corpus_path), "1"],
+                     str(corpus_path), "1", "2"],   # 2 syncs/round:
+                    # chunked multi-process barriers + empty chunks
                     stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                     text=True, cwd=str(here.parent))
                 for i in range(2)]
@@ -488,3 +489,38 @@ def test_distributed_paragraph_vectors_mode():
     # each doc label lands nearer its own community's tokens
     assert model.similarity("DOC_X", "x0") > model.similarity("DOC_X", "y0")
     assert model.similarity("DOC_Y", "y0") > model.similarity("DOC_Y", "x0")
+
+
+def test_aggregation_sum_beats_reference_averaging():
+    """aggregation='sum' (default, gradient-accumulation semantics over
+    disjoint shards) converges like sequential SGD per data pass, while
+    the reference-compat 'average' mode moves only ~one shard-epoch per
+    round and does NOT separate this corpus in the same 6-round
+    budget."""
+    from deeplearning4j_tpu.embeddings.sequencevectors import (
+        VectorsConfiguration)
+    from deeplearning4j_tpu.scaleout.nlp import DistributedSequenceVectors
+    from deeplearning4j_tpu.text.sequence import Sequence, SequenceElement
+
+    rng = np.random.default_rng(0)
+    seqs = []
+    for comm in ("a", "b"):
+        toks = [f"{comm}{i}" for i in range(6)]
+        for _ in range(120):
+            s = Sequence()
+            for t in rng.choice(toks, size=8):
+                s.add_element(SequenceElement(str(t), frequency=1.0))
+            seqs.append(s)
+
+    def margin(aggregation):
+        conf = VectorsConfiguration(layer_size=16, window=3, epochs=6,
+                                    min_word_frequency=1, negative=0,
+                                    use_hierarchic_softmax=True, seed=11)
+        m = DistributedSequenceVectors(conf, num_partitions=4,
+                                       aggregation=aggregation).fit(seqs)
+        return m.similarity("a0", "a1") - m.similarity("a0", "b0")
+
+    avg = margin("average")
+    summed = margin("sum")
+    assert summed > 0.5, (avg, summed)           # sum mode separates
+    assert summed > avg + 0.5, (avg, summed)     # and beats averaging

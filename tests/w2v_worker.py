@@ -1,7 +1,7 @@
 """Subprocess worker for the multi-process distributed Word2Vec test
 (ref: the per-executor side of spark/models/embeddings/word2vec/
 Word2Vec.java:55).  Invoked by tests/test_scaleout.py with argv:
-host port process_id num_processes corpus_path epochs
+host port process_id num_processes corpus_path epochs [syncs_per_round]
 
 Prints `SYN0_DIGEST <pid> <sha1>` and `SIM <pid> <same> <cross>` for
 the parent to compare across processes.
@@ -25,11 +25,13 @@ def main():
     host, port, pid, nproc, corpus_path, epochs = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]),
         sys.argv[5], int(sys.argv[6]))
+    syncs = int(sys.argv[7]) if len(sys.argv) > 7 else 1
     with open(corpus_path) as f:
         sentences = [ln.strip() for ln in f if ln.strip()]
     dist = DistributedWord2Vec(layer_size=16, window=3,
                                min_word_frequency=1, negative=5,
-                               seed=7, epochs=epochs)
+                               seed=7, epochs=epochs,
+                               syncs_per_round=syncs)
     model = dist.fit_process_shard(
         sentences, process_id=pid, num_processes=nproc,
         server_host=host, server_port=port)
