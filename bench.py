@@ -31,6 +31,7 @@ import json
 import os
 import statistics
 import sys
+import threading
 import time
 import traceback
 
@@ -610,7 +611,7 @@ def acquire_backend():
         return [], info
 
 
-_EMIT_LOCK = __import__("threading").Lock()
+_EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
 
@@ -636,8 +637,6 @@ def _start_watchdog(result, deadline_s):
     when the main thread is wedged in C (PJRT backend init / XLA compile):
     signal handlers only run at Python bytecode boundaries, but another
     thread can still print and os._exit."""
-    import threading
-
     _WATCHDOG["deadline"] = time.time() + deadline_s
 
     def _watch():

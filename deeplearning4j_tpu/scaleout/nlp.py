@@ -45,20 +45,30 @@ class TextPipeline:
                     c[tok] += 1
         return c
 
-    def build_word_counts(self) -> Counter:
+    def build_partition_counts(self):
+        """(partitions, per-partition token counters) from ONE balanced
+        split and ONE tokenization pass.  Returning the partitions with
+        their counters makes the alignment explicit — callers that also
+        train per shard reuse these exact partitions instead of relying
+        on a second repartition call happening to agree."""
         parts = repartition_balanced(self.sentences, self.num_partitions)
         with ThreadPoolExecutor(max_workers=self.num_partitions) as ex:
-            counters = list(ex.map(self._count_partition, parts))
+            return parts, list(ex.map(self._count_partition, parts))
+
+    def build_word_counts(self) -> Counter:
         total: Counter = Counter()
-        for c in counters:
+        for c in self.build_partition_counts()[1]:
             total.update(c)
         return total
 
-    def build_vocab_cache(self):
-        """→ AbstractCache with Huffman codes, ready for training."""
+    def build_vocab_cache(self, counts: Optional[Counter] = None):
+        """→ AbstractCache with Huffman codes, ready for training.
+        Pass pre-computed ``counts`` to skip re-tokenizing (e.g. from
+        build_partition_counts when per-shard weights are also needed)."""
         from deeplearning4j_tpu.text.sequence import SequenceElement
         from deeplearning4j_tpu.text.vocab import AbstractCache, Huffman
-        counts = self.build_word_counts()
+        if counts is None:
+            counts = self.build_word_counts()
         cache = AbstractCache()
         for word, n in counts.items():
             if n >= self.min_word_frequency:
@@ -255,21 +265,28 @@ class DistributedWord2Vec:
 
     # -- shared plumbing ----------------------------------------------------
     def _vocab_and_shards(self, sentences: List[str],
-                          keep_empty: bool = False):
+                          keep_empty: bool = False,
+                          num_partitions: Optional[int] = None):
         """Distributed vocab build + balanced corpus shards with
-        per-shard token weights.  ``keep_empty=True`` preserves the
-        shard↔index alignment (one shard per PROCESS, weight 0 for an
-        empty shard) — required by fit_process_shard, where dropping a
-        shard would misalign every process_id behind it."""
+        per-shard token weights (one tokenization pass: the vocab counts
+        ARE the per-partition counters).  ``keep_empty=True`` preserves
+        the shard↔index alignment (one shard per PROCESS, weight 0 for
+        an empty shard) — required by fit_process_shard, where dropping
+        a shard would misalign every process_id behind it."""
         import numpy as np
+        P = num_partitions or self.num_partitions
         pipeline = TextPipeline(
             sentences, self.tokenizer_factory, self.stop_words,
-            self.min_word_frequency, self.num_partitions)
-        vocab = pipeline.build_vocab_cache()
-        shards = repartition_balanced(sentences, self.num_partitions)
+            self.min_word_frequency, P)
+        shards, part_counts = pipeline.build_partition_counts()
+        total_counts: Counter = Counter()
+        for c in part_counts:
+            total_counts.update(c)
+        vocab = pipeline.build_vocab_cache(total_counts)
+        counts = [sum(c.values()) for c in part_counts]
         if not keep_empty:
+            counts = [n for s, n in zip(shards, counts) if s]
             shards = [s for s in shards if s]
-        counts = [sum(pipeline._count_partition(s).values()) for s in shards]
         total = float(sum(counts)) or 1.0
         weights = np.asarray(counts, np.float64) / total
         return vocab, shards, weights
@@ -350,13 +367,8 @@ class DistributedWord2Vec:
         from deeplearning4j_tpu.scaleout.paramserver import (
             ParameterServerClient)
         sentences = list(sentences)
-        save = self.num_partitions
-        self.num_partitions = num_processes
-        try:
-            vocab, shards, weights = self._vocab_and_shards(
-                sentences, keep_empty=True)
-        finally:
-            self.num_partitions = save
+        vocab, shards, weights = self._vocab_and_shards(
+            sentences, keep_empty=True, num_partitions=num_processes)
         shared = self._seed_model(vocab, sentences)
         lt = shared.lookup_table
         shapes = [np.asarray(a).shape for a in (lt.syn0, lt.syn1,
@@ -389,12 +401,9 @@ class DistributedWord2Vec:
             # peer's round-1 delta)
             current = client.get_nd_array()
             client.increment_counter("pulled:0")
-            deadline0 = time.time() + timeout
-            while client.read_counter("pulled:0") < num_processes:
-                if time.time() > deadline0:
-                    raise TimeoutError(
-                        f"seed barrier not reached within {timeout}s")
-                time.sleep(poll_interval)
+            wait_until(
+                lambda: client.read_counter("pulled:0") >= num_processes,
+                "seed barrier")
             sync_no = 0
             for rnd in range(1, self.epochs + 1):
                 for m in range(M):
