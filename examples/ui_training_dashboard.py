@@ -1,0 +1,69 @@
+"""Training dashboard — the dl4j-examples UI recipe: attach a
+StatsListener, train, and browse the live dashboard (overview / model /
+histograms / graph / flow / activations / t-SNE / system tabs, language
+selector top-right).
+
+Run:  python examples/ui_training_dashboard.py [--platform cpu]
+then open the printed URL.  --seconds 0 exits immediately after
+training (used by the smoke test).
+"""
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
+import argparse
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--seconds", type=float, default=600,
+                    help="keep serving this long after training")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+    from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui import (InMemoryStatsStorage, StatsListener,
+                                       UIServer)
+
+    storage = InMemoryStatsStorage()
+    server = UIServer.get_instance()
+    server.attach(storage)
+    print(f"dashboard: http://{server.host}:{server.port}/")
+
+    ds = load_iris()
+    ds = NormalizerStandardize().fit(ds).transform(ds)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, session_id="iris-demo"))
+    for _ in range(args.epochs):
+        net.fit(ds)
+    print(f"trained {args.epochs} epochs, score={float(net.score(ds)):.4f}")
+    print("flow tab:", f"http://{server.host}:{server.port}/"
+                       "#  (click Flow)")
+
+    if args.seconds > 0:
+        try:
+            time.sleep(args.seconds)
+        except KeyboardInterrupt:
+            pass
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,15 @@ def test_long_context_attention():
                "--seq-len", "32", timeout=600,
                env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
     assert "time dim sharded" in out and "score" in out
+
+
+def test_keras_model_import():
+    pytest.importorskip("keras")   # the example no-ops without keras
+    out = _run("keras_model_import.py", "--epochs", "3", timeout=600)
+    assert "matches Keras outputs" in out
+
+
+def test_ui_training_dashboard():
+    out = _run("ui_training_dashboard.py", "--epochs", "3",
+               "--seconds", "0")
+    assert "dashboard: http://" in out and "trained 3 epochs" in out
