@@ -95,3 +95,9 @@ def test_ui_training_dashboard():
     out = _run("ui_training_dashboard.py", "--epochs", "3",
                "--seconds", "0")
     assert "dashboard: http://" in out and "trained 3 epochs" in out
+
+
+def test_sharded_checkpointing():
+    out = _run("sharded_checkpointing.py", "--steps", "3", timeout=600,
+               env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "outputs match" in out and "second leg done" in out
