@@ -697,6 +697,11 @@ def main():
         probe_t = float(os.environ.get("DL4J_BENCH_PROBE_TIMEOUT_SEC", 240))
         _start_watchdog(result, probe_t * 2 + 120)
         signal.alarm(int(budget * 2) + 300)
+        # the run-phase watchdog (set after backend acquisition) must
+        # fire AFTER this alarm so a budget overrun takes the graceful
+        # SIGALRM unwind (traceback recorded) and the watchdog stays a
+        # C-hang backstop only
+        _WATCHDOG["alarm_time"] = time.time() + budget * 2 + 300
         _run_configs(result)
         signal.alarm(0)
     except BaseException as e:  # incl. KeyboardInterrupt from a driver kill
@@ -714,9 +719,12 @@ def _run_configs(result):
     if not devices:
         result["configs"] = {}
         return
-    # Backend is up: extend the watchdog to cover the compile/run phase.
+    # Backend is up: extend the watchdog to cover the compile/run phase —
+    # strictly AFTER the SIGALRM guard so the graceful unwind goes first.
     budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
-    _WATCHDOG["deadline"] = time.time() + budget * 2 + 240
+    _WATCHDOG["deadline"] = max(
+        time.time() + budget * 2 + 240,
+        (_WATCHDOG.get("alarm_time") or 0) + 60)
     import jax
     n_chips = max(1, len(devices))
     kind = platform.device_kind()

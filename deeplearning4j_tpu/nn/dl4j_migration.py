@@ -1291,10 +1291,12 @@ def export_computation_graph(net, path) -> None:
     """Write a ComputationGraph as a zip in the ORIGINAL DL4J's container
     format (graph schema: nn/conf/ComputationGraphConfiguration.java:
     59-87; flat params in topologicalSortOrder per
-    ComputationGraph.java:336-380).  Params/outputs round-trip exactly
-    through :func:`restore_computation_graph`; frozen-vertex status does
-    NOT survive (DL4J 0.8 has no FrozenLayer JSON type — same caveat as
-    export_multi_layer_network) and neither does updater state."""
+    ComputationGraph.java:336-380).  Params, outputs AND updater state
+    round-trip exactly through :func:`restore_computation_graph`
+    (non-empty updater state is written as ``updaterState.bin`` in the
+    same topological UpdaterBlock layout the restore side decodes);
+    frozen-vertex status does NOT survive (DL4J 0.8 has no FrozenLayer
+    JSON type — same caveat as export_multi_layer_network)."""
     import dataclasses as _dc
     from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertex
     conf = net.conf
@@ -1367,9 +1369,19 @@ def export_computation_graph(net, path) -> None:
             if any(f.size for f in flats) else np.empty(0, np.float32))
     buf = io.BytesIO()
     write_nd4j_array(buf, flat.reshape(1, -1), order="f")
+    # updater state in the same topological order the restore side walks
+    indexed = [(name, inners[name]) for name in topo if name in inners]
+    ustates = {name: s for name, s in (net.opt_states or {}).items()
+               if isinstance(s, dict) and s}
+    uflat = updater_state_to_flat(indexed, ustates, g) \
+        if ustates else np.empty(0, np.float32)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
         zf.writestr("configuration.json", json.dumps(top, indent=2))
         zf.writestr("coefficients.bin", buf.getvalue())
+        if uflat.size:
+            ubuf = io.BytesIO()
+            write_nd4j_array(ubuf, uflat.reshape(1, -1), order="f")
+            zf.writestr("updaterState.bin", ubuf.getvalue())
 
 
 # ---------------------------------------------------------------------------

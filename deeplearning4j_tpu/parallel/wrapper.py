@@ -134,7 +134,7 @@ class ParallelWrapper:
                     self._MASK_NONLINEAR_LOSSES:
                 return False
         for lc in all_layers:
-            if "MoE" in type(lc).__name__:
+            if "MixtureOfExperts" in type(lc).__name__:
                 return False
         return True
 
@@ -194,7 +194,13 @@ class ParallelWrapper:
             # of the scaled mask — and only when its shape provably
             # matches the labels' time layout; otherwise trim.
             if isinstance(ds, MultiDataSet):
-                if ds.features_masks is not None and ds.labels_masks is None:
+                # container-level None checks are not enough: the
+                # DataSet→MultiDataSet wrap above produces [None] lists,
+                # so compare the ENTRIES
+                def _all_none(t):
+                    return t is None or all(m is None for m in t)
+                if not _all_none(ds.features_masks) \
+                        and _all_none(ds.labels_masks):
                     pad_ok = False  # multi-input→output mask routing is
                     # ambiguous; don't guess
             elif ds.labels_mask is not None:

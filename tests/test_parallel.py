@@ -271,3 +271,58 @@ def test_allreduce_fused_steps_matches_per_step():
         for k in pa:
             np.testing.assert_allclose(np.asarray(pa[k]), np.asarray(pb[k]),
                                        rtol=2e-5, atol=2e-6)
+
+
+def test_cg_rnn_features_mask_falls_back_to_trim():
+    """CG batches wrap masks in LISTS, so the features-mask-without-
+    labels-mask guard must inspect entries, not containers (round-5
+    high review): a ragged CG RNN batch with a features mask must trim
+    + warn, never synthesize a mask that overrides the propagated one."""
+    import warnings
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = GlobalConf(seed=1, learning_rate=0.1, updater="sgd")
+    conf = (GraphBuilder(g)
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=5), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "lstm")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(3, 6))
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(2)
+    N, T = 12, 6                       # 12 % 8 = 4 → ragged
+    x = rng.normal(size=(N, T, 3)).astype(np.float32)
+    fm = np.ones((N, T), np.float32)
+    fm[:, 4:] = 0.0
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (N, T))]
+    ds = DataSet(x, y, features_mask=fm)
+    pw = ParallelWrapper(net, make_mesh())
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        pw.fit(ListDataSetIterator(ds, N), epochs=1)
+    assert [w for w in rec if "dropping" in str(w.message)], \
+        "guard must fire (trim+warn), not silently pad"
+
+
+def test_moe_net_falls_back_to_trim():
+    """MixtureOfExpertsLayer's batch-coupled aux loss makes exact
+    padding impossible; _pad_supported must detect the real class name
+    (round-5 high review: the old 'MoE' substring never matched)."""
+    from deeplearning4j_tpu.nn.conf.layers import MixtureOfExpertsLayer
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.05).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(MixtureOfExpertsLayer(n_out=8, n_experts=2))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    pw = ParallelWrapper(net, make_mesh())
+    assert not pw._pad_supported()
