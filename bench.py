@@ -528,6 +528,67 @@ def bench_resnet50(n_chips, peak):
     return out
 
 
+def bench_ragged():
+    """Ragged-minibatch micro-workload: the same stream of
+    variable-batch-size minibatches trained twice — with shape bucketing
+    (ops/bucketing.py pads each batch up to its power-of-two bucket, the
+    jitted step compiles once per bucket) and without (every distinct
+    shape is an XLA retrace).  Emits the CompileTelemetry retrace counts
+    so compile-behavior regressions show up in the bench JSON, not just
+    in wall-clock noise."""
+    import jax
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+
+    rng = np.random.default_rng(5)
+    N_BATCHES, F, C = 40, 64, 10
+    sizes = [int(s) for s in rng.integers(3, 65, size=N_BATCHES)]
+    batches = [DataSet(rng.normal(size=(s, F)).astype(np.float32),
+                       np.eye(C, dtype=np.float32)[rng.integers(0, C, s)])
+               for s in sizes]
+
+    def make_net(bucketed):
+        b = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+             .updater("sgd"))
+        if bucketed:
+            b.shape_bucketing(True)
+        conf = (b.list()
+                .layer(L.DenseLayer(n_in=F, n_out=64, activation="relu"))
+                .layer(L.OutputLayer(n_in=64, n_out=C, activation="softmax",
+                                     loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    legs = {}
+    for label, bucketed in (("bucketed", True), ("raw", False)):
+        net = make_net(bucketed)
+        t0 = time.perf_counter()
+        net.fit(ListDataSetIterator(list(batches)))
+        jax.block_until_ready(net.net_params)
+        snap = net.compile_telemetry.snapshot()
+        legs[label] = {
+            "wall_sec": round(time.perf_counter() - t0, 3),
+            "retraces": snap["retraces"],
+            "step_calls": snap["calls"],
+            "bucket_hits": snap["bucket_hits"],
+        }
+    buckets_hit = len(legs["bucketed"]["bucket_hits"])
+    return {
+        "metric": f"ragged stream ({N_BATCHES} variable-size batches) "
+                  "train-step retraces, bucketed",
+        "value": legs["bucketed"]["retraces"],
+        "unit": "retraces",
+        "distinct_batch_shapes": len(set(sizes)),
+        "buckets_hit": buckets_hit,
+        "retraces_bounded_by_buckets":
+            legs["bucketed"]["retraces"] <= max(1, buckets_hit),
+        **legs,
+    }
+
+
 def probe_primary_backend(timeout_s=None):
     """Probe the primary (TPU/axon) backend in a SUBPROCESS with a hard
     timeout.  Backend init can hang forever in C code inside the PJRT
@@ -585,25 +646,33 @@ def acquire_backend():
             log(f"primary backend probe FAILED: {err}\nfalling back to CPU")
             # Forcing cpu BEFORE the first in-process backend touch means
             # the parent never enters the plugin code path that hangs.
+            # (env too, for any subprocess the configs spawn)
+            os.environ["JAX_PLATFORMS"] = "cpu"
             jax.config.update("jax_platforms", "cpu")
             info["platform"] = "cpu (fallback)"
+            info["backend"] = "cpu-fallback"
         else:
             log(f"backend probe ok: {probe}")
             info["probe"] = probe
     try:
         devs = jax.devices()
         info.setdefault("platform", jax.default_backend())
+        info.setdefault("backend", info["platform"])
         return devs, info
     except Exception as e:
+        # jax.devices() raising here (e.g. 'Unable to initialize backend
+        # axon' — BENCH_r03's rc=1 tail) must not crash the bench
         info["backend_error"] = f"{type(e).__name__}: {e}"[:500]
         log(f"backend init FAILED after probe: {e}\nfalling back to CPU")
     # jax caches nothing on failure; narrowing jax_platforms to cpu makes
     # the retry skip the broken plugin.  (Env var alone is not enough —
     # the axon sitecustomize overrides JAX_PLATFORMS at import time.)
     try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
         devs = jax.devices()
         info["platform"] = "cpu (fallback)"
+        info["backend"] = "cpu-fallback"
         return devs, info
     except Exception as e:
         info["fallback_error"] = f"{type(e).__name__}: {e}"[:500]
@@ -713,6 +782,7 @@ def main():
 
 def _run_configs(result):
     from deeplearning4j_tpu.ops import platform
+    from deeplearning4j_tpu.ops import bucketing as bucketing_mod
 
     devices, backend_info = acquire_backend()
     result.update(backend_info)
@@ -750,10 +820,13 @@ def _run_configs(result):
     budget = float(os.environ.get("DL4J_BENCH_BUDGET_SEC", 1500))
     t_start = time.perf_counter()
     configs = {}
+    result["persistent_compile_cache"] = \
+        bucketing_mod.maybe_enable_persistent_cache()
     config_list = [
         ("lenet", lambda: bench_lenet("bf16")),
         ("lenet_etl", bench_lenet_etl),
         ("lenet_f32", lambda: bench_lenet("f32")),
+        ("bench_ragged", bench_ragged),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
         ("word2vec", bench_word2vec),
@@ -779,8 +852,8 @@ def _run_configs(result):
         # CPU (fallback when the chip is down): the conv giants take the
         # whole wall-clock budget — run the cheap configs first so a
         # fallback round still yields charrnn/word2vec evidence
-        order = ["lenet", "lenet_etl", "lenet_f32", "charrnn", "word2vec",
-                 "vgg16", "resnet50"]
+        order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
+                 "charrnn", "word2vec", "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":

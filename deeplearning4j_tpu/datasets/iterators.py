@@ -164,10 +164,15 @@ class AsyncDataSetIterator(DataSetIterator):
     _SENTINEL = object()
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 4,
-                 device_put: bool = False):
+                 device_put: bool = False, transform=None):
+        """``transform`` runs on the prefetch thread BEFORE device_put —
+        the shape-bucketing hook (ops/bucketing.py): batches are padded
+        up to their bucket off the critical path, so the H2D transfer
+        is already bucket-shaped."""
         self.underlying = underlying
         self.queue_size = queue_size
         self.device_put = device_put
+        self.transform_fn = transform
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._thread: Optional[threading.Thread] = None
         self._peek = None
@@ -176,6 +181,8 @@ class AsyncDataSetIterator(DataSetIterator):
         # reset() right after construction doesn't drain a prefetch pass
 
     def _transform(self, d):
+        if self.transform_fn is not None:
+            d = self.transform_fn(d)
         if self.device_put:
             import jax
             d = DataSet(jax.device_put(d.features), jax.device_put(d.labels),
@@ -290,12 +297,12 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
     only the item transform differs (MultiDataSets pass through)."""
 
     def __init__(self, underlying: MultiDataSetIterator,
-                 queue_size: int = 4):
+                 queue_size: int = 4, transform=None):
         super().__init__(underlying, queue_size=queue_size,
-                         device_put=False)
+                         device_put=False, transform=transform)
 
     def _transform(self, d):
-        return d
+        return d if self.transform_fn is None else self.transform_fn(d)
 
     def batch_size(self):  # MultiDataSet iterators need not expose this
         fn = getattr(self.underlying, "batch_size", None)

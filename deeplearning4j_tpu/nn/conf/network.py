@@ -63,6 +63,14 @@ class GlobalConf:
     # instead of keeping its activations in HBM (jax.checkpoint per
     # layer/vertex) — the FLOPs-for-memory trade for deep nets on TPU.
     gradient_checkpointing: bool = False
+    # Shape bucketing (ops/bucketing.py): pad ragged batch/time dims up
+    # to a small ladder of buckets so jitted entry points compile once
+    # per bucket instead of once per exact shape.  None ladders mean
+    # powers of two.  Padded rows/timesteps are mask-excluded; outputs
+    # and scores are un-padded, so results match the unbucketed run.
+    shape_bucketing: bool = False
+    bucket_batch_sizes: Optional[List[int]] = None
+    bucket_time_sizes: Optional[List[int]] = None
 
 
 _MERGE_FIELDS = [
@@ -253,6 +261,18 @@ class Builder:
         — trades ~33% more FLOPs for O(depth) less activation HBM, the
         standard remat recipe for deep nets on TPU."""
         self._g.gradient_checkpointing = bool(on)
+        return self
+
+    def shape_bucketing(self, on: bool = True, batch_sizes=None,
+                        time_sizes=None):
+        """Pad ragged batch/time dims up to a bucket ladder (powers of
+        two unless given) so every jitted path compiles once per bucket
+        — see ops/bucketing.py and docs/PERFORMANCE.md."""
+        self._g.shape_bucketing = bool(on)
+        if batch_sizes is not None:
+            self._g.bucket_batch_sizes = [int(s) for s in batch_sizes]
+        if time_sizes is not None:
+            self._g.bucket_time_sizes = [int(s) for s in time_sizes]
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

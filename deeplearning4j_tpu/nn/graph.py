@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration, GraphVertexConf, LayerVertex)
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, LossLayer
 from deeplearning4j_tpu.nn.listeners import IterationListener
+from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.ops import dtypes as dtype_ops
 from deeplearning4j_tpu.ops import updaters as upd_ops
 from deeplearning4j_tpu.nn.multilayer import (
@@ -50,6 +51,8 @@ class ComputationGraph:
         self._apply_fn = None
         self.last_batch_size = 0
         self.last_etl_time_ms = 0.0
+        self.compile_telemetry = bucketing.CompileTelemetry()
+        self._bucket_train_ok: Optional[bool] = None
 
     # ------------------------------------------------------------------
     def init(self, params: Optional[Dict[str, dict]] = None) -> "ComputationGraph":
@@ -319,6 +322,7 @@ class ComputationGraph:
         semantics and caveats as MultiLayerNetwork.fit(fused_steps=K):
         listeners fire once per launch, ragged/mixed groups fall back,
         TBPTT and iterations>1 ignore the flag."""
+        bucketing.maybe_enable_persistent_cache()
         if labels is not None:
             data = MultiDataSet([np.asarray(data)], [np.asarray(labels)])
         if isinstance(data, DataSet):
@@ -393,6 +397,10 @@ class ComputationGraph:
         if self.net_params is None:
             self.init()
         self._check_trace_token()
+        sizes = [m.num_examples() for m in group]
+        # ragged groups become bucket-uniform and stay on the fused scan
+        # path instead of degrading to per-step (see MultiLayerNetwork)
+        group = [self._maybe_bucket_train(m)[0] for m in group]
 
         def shape_sig(m):
             # per-ELEMENT mask presence: MultiDataSet wraps a missing
@@ -411,7 +419,7 @@ class ComputationGraph:
         if getattr(self, "_fused_fns", None) is None:
             self._fused_fns = {}
             self._fit_batch(group[0])   # carried-state structure warmup
-            group = group[1:]
+            group, sizes = group[1:], sizes[1:]
             if not group:
                 return
         k = len(group)
@@ -435,6 +443,8 @@ class ComputationGraph:
                           group[0].features_masks is not None)
         lms = stack_tuple(lambda m: m.labels_masks,
                           group[0].labels_masks is not None)
+        self.compile_telemetry.record(f"fused_step_k{k}",
+                                      (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
         (self.net_params, self.net_state, self.opt_states,
          score) = self._fused_fns[k](
@@ -443,7 +453,7 @@ class ComputationGraph:
         self._strip_rnn_state()
         self._score = score
         self.iteration += k
-        self.last_batch_size = group[0].num_examples() * k
+        self.last_batch_size = sum(sizes)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
 
@@ -461,6 +471,24 @@ class ComputationGraph:
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
             self._fused_fns = None
+            self.compile_telemetry.invalidate()
+
+    # ------------------------------------------------------------------
+    # Shape bucketing (ops/bucketing.py) — see MultiLayerNetwork
+    # ------------------------------------------------------------------
+    def _bucket_train_enabled(self) -> bool:
+        g = self.conf.global_conf
+        if not g.shape_bucketing or self.conf.backprop_type == "truncatedbptt":
+            return False
+        if self._bucket_train_ok is None:
+            self._bucket_train_ok = bucketing.pad_supported(self)
+        return self._bucket_train_ok
+
+    def _maybe_bucket_train(self, mds, scale_loss: bool = True):
+        if self._bucket_train_enabled():
+            return bucketing.bucket_train_multidataset(
+                mds, self.conf.global_conf, scale_loss=scale_loss)
+        return mds, None
 
     def _fit_batch(self, mds: MultiDataSet):
         if self.net_params is None:
@@ -473,6 +501,7 @@ class ComputationGraph:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         self.last_batch_size = mds.num_examples()
+        mds, bucket = self._maybe_bucket_train(mds)
         xs = tuple(jnp.asarray(f) for f in mds.features)
         ys = tuple(jnp.asarray(l) for l in mds.labels)
         fm = (tuple(None if m is None else jnp.asarray(m)
@@ -481,6 +510,8 @@ class ComputationGraph:
         lm = (tuple(None if m is None else jnp.asarray(m)
                     for m in mds.labels_masks)
               if mds.labels_masks is not None else None)
+        self.compile_telemetry.record("train_step", (xs, ys, fm, lm),
+                                      bucket=bucket)
         self._key, sub = jax.random.split(self._key)
         (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
             self.net_params, self.net_state, self.opt_states, xs, ys, fm, lm,
@@ -596,18 +627,54 @@ class ComputationGraph:
         if self._output_fn is None:
             policy = dtype_ops.resolve(self.conf.global_conf.precision)
 
-            def out_fn(params, state, xs):
-                pc, xs_c = policy.cast_to_compute((params, xs))
+            def out_fn(params, state, xs, ms):
+                pc, xs_c, ms_c = policy.cast_to_compute((params, xs, ms))
                 ins = dict(zip(self.conf.network_inputs, xs_c))
-                acts, _, _, _ = self._forward_all(pc, state, ins, {},
+                masks = ({n: m for n, m in zip(self.conf.network_inputs,
+                                               ms_c) if m is not None}
+                         if ms_c is not None else {})
+                acts, _, _, _ = self._forward_all(pc, state, ins, masks,
                                                   False, jax.random.PRNGKey(0))
                 return tuple(policy.cast_to_param(acts[n])
                              for n in self.conf.network_outputs)
             self._output_fn = jax.jit(out_fn)
         state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
                  for n, s in self.net_state.items()}
+        g = self.conf.global_conf
+        masks = unpad = bucket = None
+        if g.shape_bucketing:
+            xs_p, ms_p, pairs, n = [], [], [], None
+            for x in inputs:
+                xp, mp, n, t, b = bucketing.bucket_inference_features(
+                    x, None, g)
+                xs_p.append(xp)
+                ms_p.append(mp)
+                pairs.append((t, b[1]))
+            inputs = xs_p
+            if any(m is not None for m in ms_p):
+                masks = tuple(ms_p)
+            bucket = (b[0], tuple(tb for _, tb in pairs))
+            unpad = (n, pairs)
         xs = tuple(jnp.asarray(x) for x in inputs)
-        return self._output_fn(self.net_params, state, xs)
+        self.compile_telemetry.record("output", (xs, masks), bucket=bucket)
+        outs = self._output_fn(self.net_params, state, xs, masks)
+        if unpad is not None:
+            n, pairs = unpad
+            outs = tuple(self._unpad_graph_output(o, n, pairs)
+                         for o in outs)
+        return outs
+
+    @staticmethod
+    def _unpad_graph_output(out, n, time_pairs):
+        """Slice one padded graph output back to the real extent: rows
+        always; the time axis when it matches a padded input's time
+        bucket (multi-input graphs may mix time lengths)."""
+        out = out[:n]
+        for t, tb in time_pairs:
+            if t is not None and tb != t and out.ndim >= 3 \
+                    and out.shape[1] == tb:
+                return out[:, :t]
+        return out
 
     def feed_forward(self, *inputs, train: bool = False):
         """All vertex activations by name (ref: ComputationGraph.feedForward
@@ -626,7 +693,8 @@ class ComputationGraph:
         if data is None:
             return float(self._score)
         if isinstance(data, DataSet):
-            data = MultiDataSet([data.features], [data.labels])
+            data = MultiDataSet([data.features], [data.labels],
+                                [data.features_mask], [data.labels_mask])
         self._check_trace_token()
         if self._score_fn is None:
             out_confs = self._output_layer_confs()
@@ -634,25 +702,42 @@ class ComputationGraph:
             g = self.conf.global_conf
             policy = dtype_ops.resolve(g.precision)
 
-            def score_fn(params, state, xs, ys):
-                pc, xs_c = policy.cast_to_compute((params, xs))
+            def score_fn(params, state, xs, ys, fms, lms):
+                pc, xs_c, fm_c = policy.cast_to_compute((params, xs, fms))
                 inputs = dict(zip(self.conf.network_inputs, xs_c))
-                _, preouts, _, _ = self._forward_all(
-                    pc, state, inputs, {}, False, jax.random.PRNGKey(0),
+                masks = ({n: m for n, m in zip(self.conf.network_inputs,
+                                               fm_c) if m is not None}
+                         if fm_c is not None else {})
+                _, preouts, _, out_masks = self._forward_all(
+                    pc, state, inputs, masks, False, jax.random.PRNGKey(0),
                     preout_for=list(out_confs))
                 total = 0.0
                 for name, lc in out_confs.items():
-                    per_ex = lc.compute_score(
-                        ys[out_pos[name]],
-                        policy.cast_to_accum(preouts[name]), None)
+                    pre = policy.cast_to_accum(preouts[name])
+                    lm = self._resolve_label_mask(
+                        pre, lms[out_pos[name]] if lms is not None else None,
+                        out_masks.get(name))
+                    per_ex = lc.compute_score(ys[out_pos[name]], pre, lm)
                     total = total + (jnp.mean(per_ex) if g.mini_batch
                                      else jnp.sum(per_ex))
                 return total + self._reg_penalty(params)
 
             self._score_fn = jax.jit(score_fn)
+        data, bucket = self._maybe_bucket_train(data)
         xs = tuple(jnp.asarray(f) for f in data.features)
         ys = tuple(jnp.asarray(l) for l in data.labels)
-        return float(self._score_fn(self.net_params, self.net_state, xs, ys))
+
+        def mask_tuple(ms):
+            if ms is None or all(m is None for m in ms):
+                return None
+            return tuple(None if m is None else jnp.asarray(m) for m in ms)
+
+        fms = mask_tuple(data.features_masks)
+        lms = mask_tuple(data.labels_masks)
+        self.compile_telemetry.record("score", (xs, ys, fms, lms),
+                                      bucket=bucket)
+        return float(self._score_fn(self.net_params, self.net_state,
+                                    xs, ys, fms, lms))
 
     def evaluate(self, iterator_or_dataset, output_idx: int = 0):
         from deeplearning4j_tpu.nn.evaluation import Evaluation
@@ -778,17 +863,30 @@ class ComputationGraph:
             data = MultiDataSet([data.features], [data.labels],
                                 [data.features_mask], [data.labels_mask])
         batches = [data] if isinstance(data, MultiDataSet) else data
+        g = self.conf.global_conf
+        bucket_ok = (g.shape_bucketing
+                     and bucketing.pad_supported(self, require_mean=False))
         out = []
         for mds in batches:
             if isinstance(mds, DataSet):
                 mds = MultiDataSet([mds.features], [mds.labels],
                                    [mds.features_mask], [mds.labels_mask])
-            out.append(np.asarray(self._score_ex_fn(
-                self.net_params, self.net_state, tuple(mds.features),
-                tuple(mds.labels),
-                tuple(mds.features_masks) if mds.features_masks else None,
-                tuple(mds.labels_masks) if mds.labels_masks else None,
-                jnp.asarray(add_regularization_terms))))
+            n = mds.num_examples()
+            bucket = None
+            if bucket_ok:
+                # per-example scoring: masks stay unscaled, padded rows
+                # are sliced back off below
+                mds, bucket = bucketing.bucket_train_multidataset(
+                    mds, g, scale_loss=False)
+            args = (tuple(mds.features), tuple(mds.labels),
+                    tuple(mds.features_masks) if mds.features_masks else None,
+                    tuple(mds.labels_masks) if mds.labels_masks else None)
+            self.compile_telemetry.record("score_examples", args,
+                                          bucket=bucket)
+            per = np.asarray(self._score_ex_fn(
+                self.net_params, self.net_state, *args,
+                jnp.asarray(add_regularization_terms)))
+            out.append(per[:n] if bucket is not None else per)
         return np.concatenate(out)
 
     # ------------------------------------------------------------------

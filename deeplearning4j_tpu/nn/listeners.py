@@ -136,6 +136,35 @@ class ProfilerListener(IterationListener):
             self._stop()
 
 
+class CompileTelemetryListener(IterationListener):
+    """Surface the engine's ``CompileTelemetry`` (ops/bucketing.py)
+    through the listener interface: logs whenever an iteration caused a
+    new XLA trace (a retrace — the compile-cost event shape bucketing
+    exists to bound) and keeps periodic snapshots of the retrace counter
+    and per-bucket hit counts for dashboards/benches."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.history: List[dict] = []
+        self._last_retraces = 0
+
+    def iteration_done(self, model, iteration):
+        tel = getattr(model, "compile_telemetry", None)
+        if tel is None:
+            return
+        if tel.retraces > self._last_retraces:
+            log.info("iteration %d: %d new XLA trace(s), %d total "
+                     "(ragged shapes? enable conf.shape_bucketing)",
+                     iteration, tel.retraces - self._last_retraces,
+                     tel.retraces)
+            self._last_retraces = tel.retraces
+        if iteration % self.frequency == 0:
+            self.history.append({"iteration": iteration, **tel.snapshot()})
+
+    def snapshot(self) -> Optional[dict]:
+        return self.history[-1] if self.history else None
+
+
 class ParamAndGradientIterationListener(IterationListener):
     """Per-iteration parameter/update magnitude stats, optionally written
     as TSV (ref: optimize/listeners/ParamAndGradientIterationListener.java

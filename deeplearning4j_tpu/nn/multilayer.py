@@ -28,6 +28,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.listeners import IterationListener, TrainingListener
+from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.ops import dtypes as dtype_ops
 from deeplearning4j_tpu.ops import updaters as upd_ops
 
@@ -93,6 +94,8 @@ class MultiLayerNetwork:
         self._apply_fn = None
         self.last_batch_size = 0
         self.last_etl_time_ms = 0.0
+        self.compile_telemetry = bucketing.CompileTelemetry()
+        self._bucket_train_ok: Optional[bool] = None
         self.frozen: List[bool] = [type(l).__name__ == "FrozenLayerConf"
                                    for l in self.layers]
 
@@ -236,6 +239,28 @@ class MultiLayerNetwork:
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
             self._fused_fns = None
+            self.compile_telemetry.invalidate()
+
+    # ------------------------------------------------------------------
+    # Shape bucketing (ops/bucketing.py)
+    # ------------------------------------------------------------------
+    def _bucket_train_enabled(self) -> bool:
+        """Bucketing for loss-bearing paths (fit/score): needs the conf
+        knob AND the exact pad-and-mask preconditions (mask-linear
+        losses, mean reduction, no batch-coupled aux losses).  TBPTT
+        segments its own time axis — excluded."""
+        g = self.conf.global_conf
+        if not g.shape_bucketing or self.conf.backprop_type == "truncatedbptt":
+            return False
+        if self._bucket_train_ok is None:
+            self._bucket_train_ok = bucketing.pad_supported(self)
+        return self._bucket_train_ok
+
+    def _maybe_bucket_train(self, ds):
+        """(ds, bucket) — ds padded up to its bucket when enabled."""
+        if self._bucket_train_enabled():
+            return bucketing.bucket_train_dataset(ds, self.conf.global_conf)
+        return ds, None
 
     # ------------------------------------------------------------------
     # The jitted train step — ONE XLA computation per step
@@ -399,13 +424,23 @@ class MultiLayerNetwork:
         assert isinstance(data, DataSetIterator)
         if self.net_params is None:
             self.init()
+        bucketing.maybe_enable_persistent_cache()
         self._check_trace_token()
         if self._step_fn is None:
             self._step_fn = self._build_step()
 
         it = data
         if it.async_supported() and not isinstance(it, AsyncDataSetIterator):
-            it = AsyncDataSetIterator(it, device_put=True)
+            transform = None
+            if self._bucket_train_enabled():
+                gg = self.conf.global_conf
+                # bucket on the prefetch thread, BEFORE device_put: the
+                # H2D transfer is then already bucket-shaped and the
+                # engine's own bucketing hits its no-op fast path
+                transform = lambda d: bucketing.bucket_train_dataset(  # noqa: E731
+                    d, gg)[0]
+            it = AsyncDataSetIterator(it, device_put=True,
+                                      transform=transform)
 
         # fused path steps the updater once per batch; a conf with
         # iterations>1 (multiple updates per batch) keeps exact
@@ -468,6 +503,11 @@ class MultiLayerNetwork:
         return jax.jit(k_steps, donate_argnums=(0, 1, 2))
 
     def _fit_fused_group(self, group):
+        sizes = [d.num_examples() for d in group]
+        # bucketing makes ragged groups (mixed batch sizes / RNN time
+        # lengths, the tail of any real stream) bucket-uniform so they
+        # STAY on the fused scan path instead of degrading to per-step
+        group = [self._maybe_bucket_train(d)[0] for d in group]
         k = len(group)
         shapes = {(d.features.shape, d.labels.shape,
                    d.features.dtype, d.labels.dtype,
@@ -483,7 +523,7 @@ class MultiLayerNetwork:
         if getattr(self, "_fused_fns", None) is None:
             self._fused_fns = {}
             self._fit_batch(group[0])
-            group = group[1:]
+            group, sizes = group[1:], sizes[1:]
             k = len(group)
             if not k:
                 return
@@ -495,6 +535,8 @@ class MultiLayerNetwork:
                if group[0].features_mask is not None else None)
         lms = (jnp.stack([jnp.asarray(d.labels_mask) for d in group])
                if group[0].labels_mask is not None else None)
+        self.compile_telemetry.record(f"fused_step_k{k}",
+                                      (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
         (self.net_params, self.net_state, self.opt_states,
          score) = self._fused_fns[k](
@@ -503,7 +545,7 @@ class MultiLayerNetwork:
         self._strip_rnn_state()
         self._score = score
         self.iteration += k
-        self.last_batch_size = group[0].num_examples() * k
+        self.last_batch_size = sum(sizes)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
 
@@ -513,6 +555,10 @@ class MultiLayerNetwork:
         if self.conf.backprop_type == "truncatedbptt" and ds.features.ndim == 3:
             self._fit_tbptt(ds)
             return
+        ds, bucket = self._maybe_bucket_train(ds)
+        self.compile_telemetry.record(
+            "train_step", (ds.features, ds.labels, ds.features_mask,
+                           ds.labels_mask), bucket=bucket)
         for _ in range(max(1, g.iterations)):
             self._key, sub = jax.random.split(self._key)
             (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
@@ -653,10 +699,19 @@ class MultiLayerNetwork:
         self._check_trace_token()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
-        return self._output_fn(self.net_params,
-                               [{k: v for k, v in s.items() if k != "rnn_state"}
-                                for s in self.net_state],
-                               jnp.asarray(x), mask)
+        unpad = bucket = None
+        if self.conf.global_conf.shape_bucketing:
+            x, mask, n, t, bucket = bucketing.bucket_inference_features(
+                x, mask, self.conf.global_conf)
+            unpad = (n, t, bucket[1])
+        self.compile_telemetry.record("output", (x, mask), bucket=bucket)
+        out = self._output_fn(self.net_params,
+                              [{k: v for k, v in s.items() if k != "rnn_state"}
+                               for s in self.net_state],
+                              jnp.asarray(x), mask)
+        if unpad is not None:
+            out = bucketing.unpad_outputs(out, *unpad)
+        return out
 
     def predict(self, x) -> np.ndarray:
         """Argmax class predictions (ref: MultiLayerNetwork.predict :1456)."""
@@ -681,9 +736,13 @@ class MultiLayerNetwork:
         self._check_trace_token()
         if self._score_fn is None:
             self._score_fn = self._build_score_fn()
+        ds, bucket = self._maybe_bucket_train(dataset)
+        self.compile_telemetry.record(
+            "score", (ds.features, ds.labels, ds.features_mask,
+                      ds.labels_mask), bucket=bucket)
         return float(self._score_fn(self.net_params, self.net_state,
-                                    dataset.features, dataset.labels,
-                                    dataset.features_mask, dataset.labels_mask))
+                                    ds.features, ds.labels,
+                                    ds.features_mask, ds.labels_mask))
 
     def score_examples(self, data, add_regularization_terms: bool = False):
         """Per-example scores WITHOUT minibatch averaging — the anomaly-
@@ -719,12 +778,27 @@ class MultiLayerNetwork:
 
             self._score_ex_fn = jax.jit(score_ex)
         batches = [data] if isinstance(data, DataSet) else data
+        g = self.conf.global_conf
+        # per-example scoring needs no minibatch mean, so the bucket gate
+        # drops the mean-reduction requirement; padded rows are sliced
+        # back off (masks stay UNSCALED so real rows keep exact values)
+        bucket_ok = (g.shape_bucketing
+                     and bucketing.pad_supported(self, require_mean=False))
         out = []
         for ds in batches:
-            out.append(np.asarray(self._score_ex_fn(
+            n = ds.num_examples()
+            bucket = None
+            if bucket_ok:
+                ds, bucket = bucketing.bucket_train_dataset(
+                    ds, g, scale_loss=False)
+            self.compile_telemetry.record(
+                "score_examples", (ds.features, ds.labels, ds.features_mask,
+                                   ds.labels_mask), bucket=bucket)
+            per = np.asarray(self._score_ex_fn(
                 self.net_params, self.net_state, ds.features, ds.labels,
                 ds.features_mask, ds.labels_mask,
-                jnp.asarray(add_regularization_terms))))
+                jnp.asarray(add_regularization_terms)))
+            out.append(per[:n] if bucket is not None else per)
         return np.concatenate(out)
 
     def _merge_rnn_state(self, new_states) -> None:

@@ -25,7 +25,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map_compat as shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 PIPELINE_AXIS = "model"  # default: reuse the mesh's 'model' axis for stages

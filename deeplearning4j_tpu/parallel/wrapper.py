@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.parallel import mesh as mesh_util
 
 
@@ -102,70 +103,16 @@ class ParallelWrapper:
             return self._fit_allreduce(iterator, epochs)
         return self._fit_param_averaging(iterator, epochs)
 
-    # Losses where the labels mask does not scale the per-example loss
-    # linearly (ops/losses.py: cosine_proximity normalizes the masked
-    # vectors) — exact pad-and-mask is impossible there, so those nets
-    # fall back to trimming with a warning.
-    _MASK_NONLINEAR_LOSSES = frozenset({"cosine_proximity"})
+    # Pad/mask primitives now live in ops/bucketing.py (shared with the
+    # engines' shape-bucketing paths); kept as aliases for callers/tests.
+    _MASK_NONLINEAR_LOSSES = bucketing.MASK_NONLINEAR_LOSSES
+    _cycle_rows = staticmethod(bucketing.cycle_rows)
+    _scaled_mask = staticmethod(bucketing.scaled_mask)
 
     def _pad_supported(self):
-        """Exact remainder padding needs (a) mean loss reduction — the
-        target/n mask rescale assumes division by the padded row count,
-        so mini_batch=False sum-reduced nets are excluded — (b) every
-        output loss linear in the labels mask (CenterLoss adds an
-        unmasked center term) and (c) no batch-coupled aux losses (MoE
-        load balancing sees the padded rows).  BatchNorm IS allowed:
-        cycled real rows keep the batch statistics well-conditioned, a
-        documented approximation preferred over dropping examples."""
-        m = self.model
-        if not m.conf.global_conf.mini_batch:
-            return False
-        if type(m).__name__ == "ComputationGraph":
-            outs = list(m._output_layer_confs().values())
-            all_layers = [v.layer_conf() for v in m.conf.vertices.values()
-                          if hasattr(v, "layer_conf")]
-        else:
-            outs = [m.layers[-1]]
-            all_layers = m.layers
-        for lc in outs:
-            if getattr(lc, "requires_features_for_score", False):
-                return False
-            if (getattr(lc, "loss", None) or "") in \
-                    self._MASK_NONLINEAR_LOSSES:
-                return False
-        for lc in all_layers:
-            if "MixtureOfExperts" in type(lc).__name__:
-                return False
-        return True
-
-    @staticmethod
-    def _cycle_rows(a, target):
-        """Pad rows up to ``target`` by cycling REAL examples (not zeros:
-        replicated real rows keep batch statistics — e.g. BatchNorm —
-        well-conditioned; their loss contribution is removed by the
-        mask)."""
-        a = np.asarray(a)
-        if len(a) >= target:
-            return a[:target]
-        reps = -(-target // len(a))
-        return np.concatenate([a] * reps)[:target]
-
-    @staticmethod
-    def _scaled_mask(lm, y, n, target):
-        """Labels mask over the PADDED batch making the step's
-        ``mean(per_ex)`` over ``target`` rows equal the unpadded mean
-        over ``n`` rows: valid rows carry ``target/n`` (losses are linear
-        in the mask — see _MASK_NONLINEAR_LOSSES), padded rows carry 0."""
-        scale = np.float32(target / n)
-        if lm is None:
-            m = np.zeros((target,) + (1,) * (np.asarray(y).ndim - 1),
-                         np.float32)
-            m[:n] = scale
-        else:
-            lm = np.asarray(lm, np.float32)
-            m = np.zeros((target,) + lm.shape[1:], np.float32)
-            m[:n] = lm * scale
-        return m
+        """See ops/bucketing.pad_supported — mean reduction, mask-linear
+        losses, no batch-coupled aux losses."""
+        return bucketing.pad_supported(self.model)
 
     def _normalize_batch(self, ds, is_graph):
         """(x, y, fm, lm) host pytrees at a data-degree multiple.  A
@@ -183,6 +130,23 @@ class ParallelWrapper:
             ds = MultiDataSet([ds.features], [ds.labels],
                               [ds.features_mask], [ds.labels_mask])
         n = ds.num_examples()
+        g = self.model.conf.global_conf
+        if getattr(g, "shape_bucketing", False) and self._pad_supported():
+            # shape bucketing subsumes the remainder policy: the batch
+            # bucket is lifted to a data-degree multiple, rows are
+            # cycled and the labels mask rescaled exactly as below —
+            # every sharded launch is then bucket-shaped, so the jitted
+            # sharded step (and the fused scan) compiles once per bucket
+            fn = (bucketing.bucket_train_multidataset
+                  if isinstance(ds, MultiDataSet)
+                  else bucketing.bucket_train_dataset)
+            ds_b, bucket = fn(ds, g, min_multiple=self.n_data)
+            if bucket is not None:
+                batch = self._host_batch(ds_b)
+                tel = getattr(self.model, "compile_telemetry", None)
+                if tel is not None:
+                    tel.record("sharded_step", batch, bucket=bucket)
+                return batch, n
         rem = n % self.n_data
         pad_ok = bool(rem) and self._pad_supported()
         lm_base = None
@@ -248,6 +212,24 @@ class ParallelWrapper:
                  else np.asarray(ds.features_mask)[:n],
                  None if ds.labels_mask is None
                  else np.asarray(ds.labels_mask)[:n])), n
+
+    @staticmethod
+    def _host_batch(ds):
+        """DataSet/MultiDataSet → the (x, y, fm, lm) host-pytree the
+        sharded step consumes."""
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            tup = lambda arrs: (  # noqa: E731
+                None if arrs is None else tuple(
+                    None if a is None else np.asarray(a) for a in arrs))
+            return (tuple(np.asarray(a) for a in ds.features),
+                    tuple(np.asarray(a) for a in ds.labels),
+                    tup(ds.features_masks), tup(ds.labels_masks))
+        return (np.asarray(ds.features), np.asarray(ds.labels),
+                None if ds.features_mask is None
+                else np.asarray(ds.features_mask),
+                None if ds.labels_mask is None
+                else np.asarray(ds.labels_mask))
 
     def _run_sharded_step(self, batch, n):
         m = self.model

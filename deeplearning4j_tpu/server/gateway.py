@@ -55,12 +55,21 @@ class DeepLearning4jEntryPoint:
         return PathDataSetIterator.from_dir(data_dir)
 
     def fit(self, model_path: str, data_dir: str, epochs: int = 1,
-            save_path: Optional[str] = None) -> dict:
+            save_path: Optional[str] = None,
+            shape_bucketing: Optional[bool] = None) -> dict:
         """Train ``model_path`` on the minibatches in ``data_dir``
         (HDF5 ``batch_%d.h5`` layouts or .npz exports —
-        :meth:`_data_iterator`)."""
+        :meth:`_data_iterator`).  Exported minibatch directories are the
+        canonical ragged stream (the last shard is short), so
+        ``shape_bucketing=True`` pads every batch up to its bucket and
+        the step compiles once per bucket (ops/bucketing.py); retrace
+        telemetry is returned alongside the score."""
         from deeplearning4j_tpu.nn.serialization import write_model
+        from deeplearning4j_tpu.ops import bucketing
+        bucketing.maybe_enable_persistent_cache()
         model = self._load_model(model_path)
+        if shape_bucketing is not None:
+            model.conf.global_conf.shape_bucketing = bool(shape_bucketing)
         it = self._data_iterator(data_dir)
         for _ in range(int(epochs)):
             it.reset()
@@ -70,7 +79,11 @@ class DeepLearning4jEntryPoint:
         if not out.endswith(".zip"):
             out = str(Path(out).with_suffix(".zip"))
         write_model(model, out)
-        return {"score": float(model.score()), "model_path": out}
+        result = {"score": float(model.score()), "model_path": out}
+        tel = getattr(model, "compile_telemetry", None)
+        if tel is not None:
+            result["compile_telemetry"] = tel.snapshot()
+        return result
 
     def evaluate(self, model_path: str, data_dir: str) -> dict:
         model = self._load_model(model_path)

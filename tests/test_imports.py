@@ -1,0 +1,35 @@
+"""Tier-1 smoke: every ``deeplearning4j_tpu.*`` module imports.
+
+Catches syntax errors, bad imports, and version-compat rot (e.g. a jax
+API moving between releases) in modules no other test happens to touch
+— for the cost of an import, not a training run.
+"""
+
+import importlib
+import pkgutil
+
+import pytest
+
+import deeplearning4j_tpu
+
+# Compiled extension modules are built for one interpreter ABI; when the
+# test interpreter differs the import legitimately fails and the python
+# wrappers (deeplearning4j_tpu.native) fall back — exempt, not broken.
+BINARY_ONLY = {"deeplearning4j_tpu.native.libdl4j_io"}
+
+MODULES = sorted(
+    m.name for m in pkgutil.walk_packages(deeplearning4j_tpu.__path__,
+                                          prefix="deeplearning4j_tpu.")
+    if m.name not in BINARY_ONLY)
+
+
+@pytest.mark.parametrize("name", MODULES)
+def test_module_imports(name):
+    importlib.import_module(name)
+
+
+def test_walk_found_the_tree():
+    # guard against the walk silently finding nothing (bad __path__)
+    assert len(MODULES) > 50
+    assert "deeplearning4j_tpu.ops.bucketing" in MODULES
+    assert "deeplearning4j_tpu.nn.multilayer" in MODULES
