@@ -664,6 +664,29 @@ class ComputationGraph:
                          for o in outs)
         return outs
 
+    def warmup_inference(self, feature_dims, max_batch: int = 32,
+                         batch_sizes=None, dtype=np.float32) -> dict:
+        """ComputationGraph analog of
+        ``MultiLayerNetwork.warmup_inference``: pre-compile the jitted
+        multi-input ``output`` path for every batch bucket on the
+        serving ladder.  ``feature_dims`` is one per-example shape tail
+        per network input (a single tail is broadcast to all inputs)."""
+        if self.net_params is None:
+            self.init()
+        dims = list(feature_dims)
+        if not dims or not isinstance(dims[0], (tuple, list)):
+            dims = [tuple(dims)] * len(self.conf.network_inputs)
+        dims = [tuple(int(d) for d in t) for t in dims]
+        g = self.conf.global_conf
+        ladder = bucketing.warmup_ladder(
+            batch_sizes or g.bucket_batch_sizes, max_batch)
+        t0 = time.perf_counter()
+        for nb in ladder:
+            outs = self.output(*[np.zeros((nb,) + t, dtype) for t in dims])
+            jax.block_until_ready(outs)
+        return {"buckets": ladder,
+                "warmup_sec": round(time.perf_counter() - t0, 3)}
+
     @staticmethod
     def _unpad_graph_output(out, n, time_pairs):
         """Slice one padded graph output back to the real extent: rows

@@ -165,6 +165,64 @@ class CompileTelemetryListener(IterationListener):
         return self.history[-1] if self.history else None
 
 
+class LatencyHistogram:
+    """Thread-safe latency recorder with percentile snapshots — the
+    shared telemetry surface for serving metrics (``server/batcher.py``
+    records per-request queue/compute/total latency through it) and for
+    any listener that needs p50/p95/p99 instead of raw means.
+
+    A bounded reservoir keeps memory constant under serving traffic
+    (millions of requests must not grow an unbounded list): the first
+    ``capacity`` samples are kept verbatim, later ones replace a random
+    slot with probability ``capacity/count`` (Vitter's Algorithm R), so
+    the percentile snapshot stays an unbiased estimate of the full
+    stream.  Counters (count/mean/max) are exact."""
+
+    def __init__(self, capacity: int = 4096):
+        import threading
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._samples: List[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+    def record(self, seconds: float) -> None:
+        import random
+        s = float(seconds)
+        with self._lock:
+            self.count += 1
+            self.total += s
+            if s > self.max:
+                self.max = s
+            if len(self._samples) < self.capacity:
+                self._samples.append(s)
+            else:
+                i = random.randrange(self.count)
+                if i < self.capacity:
+                    self._samples[i] = s
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1]; 0.0 when nothing was recorded."""
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            xs = sorted(self._samples)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total, mx = self.count, self.total, self.max
+        return {
+            "count": count,
+            "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
+            "p50_ms": round(self.percentile(0.50) * 1e3, 3),
+            "p95_ms": round(self.percentile(0.95) * 1e3, 3),
+            "p99_ms": round(self.percentile(0.99) * 1e3, 3),
+            "max_ms": round(mx * 1e3, 3),
+        }
+
+
 class ParamAndGradientIterationListener(IterationListener):
     """Per-iteration parameter/update magnitude stats, optionally written
     as TSV (ref: optimize/listeners/ParamAndGradientIterationListener.java

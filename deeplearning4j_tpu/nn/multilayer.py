@@ -718,6 +718,30 @@ class MultiLayerNetwork:
         out = self.output(x)
         return np.asarray(jnp.argmax(out, axis=-1))
 
+    def warmup_inference(self, feature_dims, max_batch: int = 32,
+                         batch_sizes=None, dtype=np.float32) -> dict:
+        """Pre-compile the jitted inference path for every batch bucket
+        a serving frontend can hand it, so first requests never pay a
+        cold XLA compile.  ``feature_dims`` is the per-example feature
+        shape (``(F,)``, ``(C, H, W)``, ``(T, C)`` …); the ladder is
+        ``batch_sizes`` / the configured bucket ladder / powers of two
+        up to ``max_batch`` (ops/bucketing.warmup_ladder).  Reuses the
+        same jitted ``output`` entry point real requests hit — with
+        shape bucketing enabled each warmed bucket is exactly the
+        program a padded request executes.  Returns the warmed ladder
+        and wall time."""
+        if self.net_params is None:
+            self.init()
+        g = self.conf.global_conf
+        ladder = bucketing.warmup_ladder(
+            batch_sizes or g.bucket_batch_sizes, max_batch)
+        dims = tuple(int(d) for d in feature_dims)
+        t0 = time.perf_counter()
+        for nb in ladder:
+            jax.block_until_ready(self.output(np.zeros((nb,) + dims, dtype)))
+        return {"buckets": ladder,
+                "warmup_sec": round(time.perf_counter() - t0, 3)}
+
     def feed_forward(self, x, train: bool = False, mask=None):
         """All layer activations (ref: feedForward :696-788)."""
         if self.net_params is None:

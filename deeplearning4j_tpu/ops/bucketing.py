@@ -72,6 +72,36 @@ def bucket_size(n: int, sizes: Optional[Sequence[int]] = None) -> int:
     return next_pow2(n)
 
 
+def pow2_ladder(max_n: int) -> List[int]:
+    """Power-of-two bucket ladder covering batch sizes ``1..max_n``:
+    ``[1, 2, 4, ..., next_pow2(max_n)]`` — the default bucket set when
+    no explicit ladder is configured."""
+    top = next_pow2(max(1, int(max_n)))
+    out, n = [], 1
+    while n <= top:
+        out.append(n)
+        n <<= 1
+    return out
+
+
+def warmup_ladder(sizes: Optional[Sequence[int]] = None,
+                  max_batch: int = 32) -> List[int]:
+    """The bucket ladder a serving path should pre-compile so first
+    requests never eat a cold XLA compile: the configured ladder when
+    one exists — truncated at the rung a ``max_batch``-row batch lands
+    on (the micro-batcher never builds a bigger batch, so higher rungs
+    would be compiled for nothing) — else the power-of-two ladder up to
+    ``max_batch``."""
+    max_batch = max(1, int(max_batch))
+    if sizes:
+        ladder = sorted({int(s) for s in sizes})
+        top = bucket_size(max_batch, ladder)
+        out = [s for s in ladder if s < top]
+        out.append(top)
+        return out
+    return pow2_ladder(max_batch)
+
+
 def bucket_key(bucket) -> str:
     """Human/JSON key for a bucket tuple: ``b64``, ``b64t32``,
     ``b64t32/16`` (multi-input graphs)."""

@@ -1,9 +1,13 @@
 """Serving edges (SURVEY.md §2.9): nearest-neighbor HTTP server and the
-Python gateway entry point."""
+Python gateway entry point with its serving subsystem (model cache,
+dynamic micro-batcher, bucket-warmed predict path)."""
 
 from deeplearning4j_tpu.server.nearestneighbors import (
     NearestNeighbor, NearestNeighborsServer)
+from deeplearning4j_tpu.server.model_cache import ModelCache
+from deeplearning4j_tpu.server.batcher import MicroBatcher, ServingMetrics
 from deeplearning4j_tpu.server.gateway import DeepLearning4jEntryPoint, Server
 
-__all__ = ["NearestNeighbor", "NearestNeighborsServer",
-           "DeepLearning4jEntryPoint", "Server"]
+__all__ = ["NearestNeighbor", "NearestNeighborsServer", "ModelCache",
+           "MicroBatcher", "ServingMetrics", "DeepLearning4jEntryPoint",
+           "Server"]

@@ -1,0 +1,246 @@
+"""Dynamic micro-batching for the serving path.
+
+Concurrent ``predict`` requests arrive one or a few rows at a time; a
+jitted XLA ``output`` call costs nearly the same to dispatch for 1 row
+as for 32 — so answering requests one-at-a-time leaves most of the
+hardware idle ("Array Languages Make Neural Networks Fast": batched,
+compile-cached execution is where array frameworks win).  The
+:class:`MicroBatcher` coalesces: requests enqueue rows with a future, a
+batcher thread gathers up to ``max_batch`` rows (waiting at most
+``max_wait_ms`` after the batch's first request), pads the gathered
+batch up to the bucket ladder (``ops/bucketing.py``) so the jitted
+callable compiles once per bucket instead of once per row-count, runs
+ONE ``output`` call, and scatters per-request slices back.
+
+Correctness: rows are independent at inference (no batch statistics —
+BatchNorm uses running stats), so zero-row padding and slicing back is
+exact, and a request's rows produce the same values whether they ran
+alone or co-batched (the concurrent-vs-serial parity test pins this).
+Requests whose row shape/dtype differs from their batch-mates are
+grouped and run separately rather than failing the whole batch.
+
+Telemetry: per-request queue/compute/total latency
+(``nn/listeners.LatencyHistogram`` percentile snapshots) and a
+batch-size histogram, surfaced through the gateway's ``stats`` RPC and
+``bench.py``'s ``bench_serving`` A/B.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.listeners import LatencyHistogram
+from deeplearning4j_tpu.ops import bucketing
+
+
+class _Pending:
+    __slots__ = ("x", "future", "t_enqueue")
+
+    def __init__(self, x, future, t_enqueue):
+        self.x = x
+        self.future = future
+        self.t_enqueue = t_enqueue
+
+
+class ServingMetrics:
+    """Per-batcher serving telemetry: request latency split into queue
+    (enqueue → batch dispatch), compute (the jitted call), and total
+    (enqueue → result), plus how well coalescing is working (batch-size
+    histogram, rows per batch)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue = LatencyHistogram()
+        self.compute = LatencyHistogram()
+        self.total = LatencyHistogram()
+        self.requests = 0
+        self.rows = 0
+        self.batches = 0
+        self.batch_size_hist = {}
+
+    def record_batch(self, n_requests: int, n_rows: int) -> None:
+        with self._lock:
+            self.requests += n_requests
+            self.rows += n_rows
+            self.batches += 1
+            self.batch_size_hist[n_rows] = \
+                self.batch_size_hist.get(n_rows, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            requests, rows, batches = self.requests, self.rows, self.batches
+            hist = {str(k): v for k, v in
+                    sorted(self.batch_size_hist.items())}
+        return {
+            "requests": requests,
+            "rows": rows,
+            "batches": batches,
+            "rows_per_batch_mean": round(rows / batches, 2) if batches else 0.0,
+            "requests_per_batch_mean":
+                round(requests / batches, 2) if batches else 0.0,
+            "batch_size_hist": hist,
+            "queue_ms": self.queue.snapshot(),
+            "compute_ms": self.compute.snapshot(),
+            "total_ms": self.total.snapshot(),
+        }
+
+
+class MicroBatcher:
+    """Coalesce concurrent few-row ``predict`` calls into one jitted
+    ``output`` call.
+
+    ``infer_fn(x: np.ndarray[B, ...]) -> np.ndarray[B, ...]`` must be
+    row-aligned (row i of the output belongs to row i of the input).
+    ``max_batch`` bounds gathered rows per dispatch (a single oversized
+    request still runs, alone).  Dispatch is backpressure-driven: the
+    batcher takes everything queued and runs it immediately — while the
+    jitted call executes, new requests pile up and form the next batch,
+    so coalescing emerges from load without adding idle wait to the
+    request path.  ``min_batch > 1`` opts into explicit coalescing
+    windows: the batch is held until it has ``min_batch`` rows or
+    ``max_wait_ms`` has passed since its first request — ``max_wait_ms``
+    bounds how long a lone request can wait for company, it is never
+    stuck waiting for a full batch.  ``pad_to_bucket`` zero-pads the
+    gathered batch up to the ``bucket_sizes`` ladder (powers of two when
+    None) and slices the padding back off; turn it off when the model
+    already buckets internally (``conf.shape_bucketing``)."""
+
+    def __init__(self, infer_fn: Callable[[np.ndarray], np.ndarray],
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 min_batch: int = 1,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 pad_to_bucket: bool = True, name: str = ""):
+        self._infer_fn = infer_fn
+        self.max_batch = max(1, int(max_batch))
+        self.min_batch = max(1, min(int(min_batch), self.max_batch))
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self._bucket_sizes = (list(bucket_sizes) if bucket_sizes else None)
+        self._pad = bool(pad_to_bucket)
+        self.metrics = ServingMetrics()
+        self._queue: List[_Pending] = []
+        self._cond = threading.Condition()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"micro-batcher:{name or hex(id(self))}")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # Client side
+    # ------------------------------------------------------------------
+    def submit(self, features) -> Future:
+        """Enqueue a ``[k, ...]`` row batch; the future resolves to the
+        ``[k, ...]`` output slice for exactly those rows."""
+        x = np.asarray(features)
+        if x.ndim < 1 or x.shape[0] == 0:
+            raise ValueError("submit() needs a non-empty [k, ...] row batch")
+        fut = Future()
+        p = _Pending(x, fut, time.perf_counter())
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("MicroBatcher is stopped")
+            self._queue.append(p)
+            self._cond.notify_all()
+        return fut
+
+    def predict(self, features, timeout: Optional[float] = None):
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(features).result(timeout)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Drain in-flight work, stop the batcher thread, and fail any
+        requests that could not be drained."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+        for p in leftovers:
+            if not p.future.done():
+                p.future.set_exception(RuntimeError("MicroBatcher stopped"))
+
+    # ------------------------------------------------------------------
+    # Batcher thread
+    # ------------------------------------------------------------------
+    def _take_batch(self) -> List[_Pending]:
+        """Block until work exists, then drain everything queued up to
+        ``max_batch`` rows.  A request that would overflow the batch is
+        left for the next one (keeps dispatched row counts — and
+        therefore compiled bucket shapes — bounded by ``max_batch``),
+        unless it would be alone anyway.  With ``min_batch > 1`` the
+        drain keeps waiting for more rows until ``min_batch`` is reached
+        or ``max_wait_s`` has passed since the batch's first request."""
+        with self._cond:
+            while self._running and not self._queue:
+                self._cond.wait(0.1)
+            if not self._queue:
+                return []
+            deadline = time.perf_counter() + self.max_wait_s
+            taken: List[_Pending] = []
+            rows = 0
+            while True:
+                while self._queue:
+                    nxt = len(self._queue[0].x)
+                    if taken and rows + nxt > self.max_batch:
+                        break
+                    p = self._queue.pop(0)
+                    taken.append(p)
+                    rows += nxt
+                if rows >= self.min_batch or not self._running:
+                    break
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return taken
+
+    def _run_group(self, group: List[_Pending]) -> None:
+        t_dispatch = time.perf_counter()
+        try:
+            xs = [p.x for p in group]
+            x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+            n = len(x)
+            if self._pad:
+                nb = bucketing.bucket_size(n, self._bucket_sizes)
+                if nb != n:
+                    x = np.concatenate(
+                        [x, np.zeros((nb - n,) + x.shape[1:], x.dtype)])
+            t0 = time.perf_counter()
+            out = np.asarray(self._infer_fn(x))[:n]
+            t1 = time.perf_counter()
+            i = 0
+            for p in group:
+                k = len(p.x)
+                p.future.set_result(out[i:i + k])
+                i += k
+            for p in group:
+                self.metrics.queue.record(t_dispatch - p.t_enqueue)
+                self.metrics.compute.record(t1 - t0)
+                self.metrics.total.record(t1 - p.t_enqueue)
+            self.metrics.record_batch(len(group), n)
+        except Exception as e:
+            for p in group:
+                if not p.future.done():
+                    p.future.set_exception(e)
+
+    def _loop(self) -> None:
+        while True:
+            taken = self._take_batch()
+            if not taken:
+                if not self._running:
+                    return
+                continue
+            # one dispatch per (row-shape, dtype) group: a client sending
+            # mismatched rows must not fail its batch-mates
+            groups: dict = {}
+            for p in taken:
+                groups.setdefault(
+                    (p.x.shape[1:], str(p.x.dtype)), []).append(p)
+            for group in groups.values():
+                self._run_group(group)

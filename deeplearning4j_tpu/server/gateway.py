@@ -6,32 +6,61 @@ batches streamed from disk; HDF5MiniBatchDataSetIterator reads them).
 
 The reference's wire tech (py4j JVM gateway) is replaced by a JSON-RPC
 HTTP endpoint — the natural cross-process seam for a Python-hosted
-runtime.  The entry-point surface is preserved: ``fit`` takes a saved
-model (Keras .h5 via keras_import, or a framework .zip checkpoint) plus
-a directory of exported minibatches, trains, and writes the result
-checkpoint."""
+runtime.  The entry-point surface is preserved (``fit`` takes a saved
+model plus a directory of exported minibatches, trains, and writes the
+result checkpoint) and extended into a real inference server:
+
+* **model cache** (``server/model_cache.py``): models load and jit-warm
+  once, keyed by ``(path, mtime)``, with LRU eviction and an
+  ``invalidate`` RPC;
+* **dynamic micro-batching** (``server/batcher.py``): concurrent
+  ``predict`` requests with inline ``features`` coalesce into one
+  jitted ``output`` call, padded to the bucket ladder;
+* **bucket warmup**: the first predict for a model pre-compiles the
+  serving ladder (``warmup_inference``), so cold compiles happen once
+  at load, not on the request path;
+* **serving metrics** (``stats`` RPC): latency percentiles, batch-size
+  histogram, model-cache counters, and each model's
+  ``CompileTelemetry`` snapshot.
+"""
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
 
+import numpy as np
+
+from deeplearning4j_tpu.server.batcher import MicroBatcher
+from deeplearning4j_tpu.server.model_cache import ModelCache
+
 
 class DeepLearning4jEntryPoint:
     """(ref: keras/DeepLearning4jEntryPoint.java:21-33 — the object the
-    gateway exposes; one method per remote operation)."""
+    gateway exposes; one method per remote operation).
+
+    ``max_batch``/``max_wait_ms`` configure the per-model micro-batcher;
+    ``coalesce`` is the default for ``predict(features=...)`` requests
+    (overridable per request)."""
+
+    def __init__(self, model_cache: Optional[ModelCache] = None,
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 min_batch: int = 1, coalesce: bool = True):
+        self.model_cache = model_cache or ModelCache()
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_ms = float(max_wait_ms)
+        self.min_batch = max(1, int(min_batch))
+        self.coalesce = bool(coalesce)
+        self._batchers: dict = {}
+        self._batcher_lock = threading.Lock()
 
     def _load_model(self, model_path: str):
-        p = Path(model_path)
-        if p.suffix in (".h5", ".hdf5"):
-            from deeplearning4j_tpu.keras_import import KerasModelImport
-            return KerasModelImport.import_keras_model_and_weights(str(p))
-        from deeplearning4j_tpu.nn.serialization import load_model
-        return load_model(str(p))
+        return self.model_cache.get(model_path)
 
     @staticmethod
     def _data_iterator(data_dir: str):
@@ -67,7 +96,7 @@ class DeepLearning4jEntryPoint:
         from deeplearning4j_tpu.nn.serialization import write_model
         from deeplearning4j_tpu.ops import bucketing
         bucketing.maybe_enable_persistent_cache()
-        model = self._load_model(model_path)
+        model = self.model_cache.get(model_path)
         if shape_bucketing is not None:
             model.conf.global_conf.shape_bucketing = bool(shape_bucketing)
         it = self._data_iterator(data_dir)
@@ -79,6 +108,10 @@ class DeepLearning4jEntryPoint:
         if not out.endswith(".zip"):
             out = str(Path(out).with_suffix(".zip"))
         write_model(model, out)
+        # training mutated the in-memory instance away from the on-disk
+        # file its cache key names — drop it (the written checkpoint
+        # re-caches on next use; same-path saves also changed the mtime)
+        self.invalidate(model_path)
         result = {"score": float(model.score()), "model_path": out}
         tel = getattr(model, "compile_telemetry", None)
         if tel is not None:
@@ -86,19 +119,169 @@ class DeepLearning4jEntryPoint:
         return result
 
     def evaluate(self, model_path: str, data_dir: str) -> dict:
-        model = self._load_model(model_path)
+        model = self.model_cache.get(model_path)
         ev = model.evaluate(self._data_iterator(data_dir))
         return {"accuracy": ev.accuracy(), "f1": ev.f1()}
 
-    def predict(self, model_path: str, data_dir: str) -> dict:
-        import numpy as np
-        model = self._load_model(model_path)
+    # ------------------------------------------------------------------
+    # Inference
+    # ------------------------------------------------------------------
+    def predict(self, model_path: str, data_dir: Optional[str] = None,
+                features=None, top_k: Optional[int] = None,
+                argmax_only: bool = False,
+                coalesce: Optional[bool] = None) -> dict:
+        """Run inference with the cached, bucket-warmed model.
+
+        Exactly one input source: ``data_dir`` (exported minibatch
+        directory — already batched, runs batch-at-a-time) or
+        ``features`` (an inline ``[k, ...]`` row batch — the serving
+        path; concurrent requests coalesce through the micro-batcher
+        unless ``coalesce=False``).
+
+        Response shaping for classification clients: ``argmax_only``
+        returns class ids; ``top_k=K`` returns the K best class ids +
+        probabilities per row — both avoid serializing the full
+        ``[n, n_classes]`` probability matrix to JSON."""
+        if (data_dir is None) == (features is None):
+            raise ValueError(
+                "predict needs exactly one of data_dir= or features=")
+        if features is not None:
+            x = np.asarray(features, dtype=np.float32)
+            if x.ndim < 1 or x.shape[0] == 0:
+                raise ValueError("features must be a non-empty [k, ...] "
+                                 "row batch")
+            model = self.model_cache.get(
+                model_path, warmup_dims=tuple(x.shape[1:]),
+                max_batch=self.max_batch)
+            use_batcher = self.coalesce if coalesce is None else bool(coalesce)
+            if use_batcher:
+                out = self._batcher_for(model_path, model).predict(x)
+            else:
+                out = self._infer_fn(model)(x)
+            return self._format_predictions(out, top_k, argmax_only)
+
+        model = self.model_cache.get(model_path)
         it = self._data_iterator(data_dir)
+        infer = self._infer_fn(model)
         outs = []
         while it.has_next():
-            outs.append(np.asarray(model.output(it.next().features)))
-        stacked = np.concatenate(outs) if outs else np.zeros((0,))
-        return {"predictions": stacked.tolist()}
+            outs.append(infer(it.next().features))
+        if outs:
+            stacked = np.concatenate(outs)
+        else:
+            # keep output rank even with zero minibatches: (0, *out_dims)
+            stacked = np.zeros((0,) + self._output_dims(model), np.float32)
+        return self._format_predictions(stacked, top_k, argmax_only)
+
+    def warmup(self, model_path: str, feature_dims,
+               max_batch: Optional[int] = None) -> dict:
+        """Explicitly pre-compile the serving bucket ladder for
+        ``model_path`` (``feature_dims`` is the per-example feature
+        shape) — what the first ``features=`` predict does implicitly."""
+        model = self.model_cache.get(model_path)
+        return model.warmup_inference(
+            feature_dims, max_batch=int(max_batch or self.max_batch))
+
+    def invalidate(self, model_path: Optional[str] = None) -> dict:
+        """Drop cached model(s) — and their batchers — so the next
+        request reloads from disk (explicit cache-invalidation RPC; a
+        changed file mtime invalidates implicitly)."""
+        n = self.model_cache.invalidate(model_path)
+        with self._batcher_lock:
+            keys = ([os.path.abspath(str(model_path))]
+                    if model_path is not None else list(self._batchers))
+            dropped = [self._batchers.pop(k) for k in keys
+                       if k in self._batchers]
+        for _, batcher in dropped:
+            batcher.stop()
+        return {"invalidated": n}
+
+    def stats(self) -> dict:
+        """Serving observability: model-cache counters, per-model
+        batcher metrics (queue/compute/total latency percentiles,
+        batch-size histogram), and each resident model's
+        ``CompileTelemetry`` snapshot."""
+        out = {"model_cache": self.model_cache.stats(), "serving": {}}
+        with self._batcher_lock:
+            items = list(self._batchers.items())
+        for key, (model, batcher) in items:
+            s = batcher.metrics.snapshot()
+            tel = getattr(model, "compile_telemetry", None)
+            if tel is not None:
+                s["compile_telemetry"] = tel.snapshot()
+            out["serving"][key] = s
+        return out
+
+    def close(self) -> None:
+        """Stop all batcher threads (server shutdown)."""
+        with self._batcher_lock:
+            dropped = list(self._batchers.values())
+            self._batchers.clear()
+        for _, batcher in dropped:
+            batcher.stop()
+
+    # ------------------------------------------------------------------
+    # Internals
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _infer_fn(model):
+        """Row-aligned numpy inference callable over a model's jitted
+        ``output`` (first output for multi-output graphs)."""
+        def infer(x):
+            out = model.output(x)
+            if isinstance(out, tuple):
+                out = out[0]
+            return np.asarray(out)
+        return infer
+
+    def _batcher_for(self, model_path: str, model) -> MicroBatcher:
+        """The micro-batcher bound to this model instance; a reloaded
+        model (stale mtime / invalidate) gets a fresh batcher."""
+        key = os.path.abspath(str(model_path))
+        with self._batcher_lock:
+            entry = self._batchers.get(key)
+            if entry is not None and entry[0] is model:
+                return entry[1]
+            old = entry[1] if entry is not None else None
+            g = model.conf.global_conf
+            batcher = MicroBatcher(
+                self._infer_fn(model),
+                max_batch=self.max_batch, max_wait_ms=self.max_wait_ms,
+                min_batch=self.min_batch,
+                bucket_sizes=g.bucket_batch_sizes,
+                # the model pads internally when bucketing is on — don't
+                # pad twice (idempotent, but wasted host work)
+                pad_to_bucket=not g.shape_bucketing,
+                name=os.path.basename(key))
+            self._batchers[key] = (model, batcher)
+        if old is not None:
+            old.stop()
+        return batcher
+
+    @staticmethod
+    def _output_dims(model):
+        """Per-example output shape when there is no data to infer it
+        from (the zero-minibatch fallback must keep output rank)."""
+        if hasattr(model, "_output_layer_confs"):  # ComputationGraph
+            confs = list(model._output_layer_confs().values())
+            n_out = int(getattr(confs[0], "n_out", 0) or 0) if confs else 0
+        else:
+            n_out = int(getattr(model.layers[-1], "n_out", 0) or 0)
+        return (n_out,) if n_out else ()
+
+    @staticmethod
+    def _format_predictions(out, top_k=None, argmax_only=False) -> dict:
+        out = np.asarray(out)
+        if argmax_only:
+            cls = np.argmax(out, axis=-1)
+            return {"classes": cls.tolist(), "shape": list(cls.shape)}
+        if top_k:
+            k = max(1, min(int(top_k), out.shape[-1]))
+            idx = np.argsort(out, axis=-1)[..., ::-1][..., :k]
+            vals = np.take_along_axis(out, idx, axis=-1)
+            return {"top_k": k, "classes": idx.tolist(),
+                    "probabilities": vals.tolist(), "shape": list(idx.shape)}
+        return {"predictions": out.tolist(), "shape": list(out.shape)}
 
 
 class Server:
@@ -107,12 +290,19 @@ class Server:
 
     POST / {"method": "fit", "params": {...}} →
         {"result": {...}} or {"error": "..."}
+
+    ``debug=True`` includes the full traceback in error payloads;
+    by default clients only see the exception type and message
+    (tracebacks leak host paths and internals).
     """
 
     def __init__(self, entry_point: Optional[DeepLearning4jEntryPoint] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 debug: bool = False):
         ep = entry_point or DeepLearning4jEntryPoint()
         self.entry_point = ep
+        self.debug = bool(debug)
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -129,9 +319,10 @@ class Server:
                     payload = json.dumps({"result": result}).encode()
                     code = 200
                 except Exception as e:
-                    payload = json.dumps(
-                        {"error": f"{type(e).__name__}: {e}",
-                         "traceback": traceback.format_exc()}).encode()
+                    err = {"error": f"{type(e).__name__}: {e}"}
+                    if server.debug:
+                        err["traceback"] = traceback.format_exc()
+                    payload = json.dumps(err).encode()
                     code = 500
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -152,3 +343,6 @@ class Server:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        close = getattr(self.entry_point, "close", None)
+        if close is not None:
+            close()

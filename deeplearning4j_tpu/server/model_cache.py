@@ -1,0 +1,142 @@
+"""Serving model cache — load and jit-warm a model once, not per request.
+
+The gateway's original entry point re-read the checkpoint from disk (and
+re-traced every jitted entry point) on EVERY ``fit``/``evaluate``/
+``predict`` call, so the shape-bucketing compile cache never survived a
+request.  This cache keys loaded models by ``(abspath, mtime_ns)``:
+
+* a **hit** returns the in-memory model with its jit trace cache (and
+  the persistent ``CompileTelemetry``) intact;
+* a changed file mtime is a **stale reload** — the checkpoint on disk
+  wins, the old instance is dropped;
+* **LRU eviction** bounds resident models (``capacity``);
+* ``warmup_dims`` triggers **bucket warmup** on load (or lazily on the
+  first hit that knows the request's feature shape):
+  ``model.warmup_inference`` pre-compiles the configured bucket ladder
+  through the real jitted ``output`` path, so first requests never pay
+  a cold XLA compile.
+
+Explicit ``invalidate`` mirrors the reference's model-server reload
+semantics (a republished checkpoint must take effect without bouncing
+the server); the gateway exposes it as an RPC.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+def default_loader(path: str):
+    """Checkpoint sniffing shared with the gateway: Keras ``.h5`` via
+    keras_import, anything else through the framework's load_model."""
+    p = str(path)
+    if p.endswith((".h5", ".hdf5")):
+        from deeplearning4j_tpu.keras_import import KerasModelImport
+        return KerasModelImport.import_keras_model_and_weights(p)
+    from deeplearning4j_tpu.nn.serialization import load_model
+    return load_model(p)
+
+
+class ModelCache:
+    """LRU cache of loaded (and optionally jit-warmed) models keyed by
+    ``(abspath, mtime_ns)``.  Thread-safe: concurrent requests for the
+    same path load the checkpoint once."""
+
+    def __init__(self, capacity: int = 4,
+                 loader: Optional[Callable] = None):
+        self.capacity = max(1, int(capacity))
+        self._loader = loader or default_loader
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.stale_reloads = 0
+        self.evictions = 0
+
+    def get(self, path, shape_bucketing: Optional[bool] = None,
+            warmup_dims=None, max_batch: int = 32):
+        """The cached model for ``path``, loading (and bucket-warming)
+        on first use or when the file changed on disk.
+
+        ``shape_bucketing`` overrides the checkpoint's flag at load time
+        (serving wants it on even for models trained without it).
+        ``warmup_dims`` — the per-example feature shape — pre-compiles
+        the inference bucket ladder up to ``max_batch`` rows; passing it
+        on a hit warms lazily if the entry was loaded by a path (fit /
+        evaluate) that didn't know the serving shape yet."""
+        key = os.path.abspath(str(path))
+        mtime = os.stat(key).st_mtime_ns
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e["mtime"] != mtime:
+                self.stale_reloads += 1
+                del self._entries[key]
+                e = None
+            if e is not None:
+                self.hits += 1
+                self._entries.move_to_end(key)
+            else:
+                self.misses += 1
+                model = self._loader(key)
+                if shape_bucketing is not None:
+                    model.conf.global_conf.shape_bucketing = \
+                        bool(shape_bucketing)
+                e = {"mtime": mtime, "model": model, "warmup": None,
+                     "loaded_at": time.time()}
+                self._entries[key] = e
+                while len(self._entries) > self.capacity:
+                    self._entries.popitem(last=False)
+                    self.evictions += 1
+            if warmup_dims is not None and e["warmup"] is None \
+                    and hasattr(e["model"], "warmup_inference"):
+                e["warmup"] = e["model"].warmup_inference(
+                    warmup_dims, max_batch=max_batch)
+            return e["model"]
+
+    def peek(self, path):
+        """The cached model if (and only if) it is resident and fresh —
+        no load, no counter changes (stats/telemetry introspection)."""
+        key = os.path.abspath(str(path))
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            try:
+                if os.stat(key).st_mtime_ns != e["mtime"]:
+                    return None
+            except OSError:
+                return None
+            return e["model"]
+
+    def invalidate(self, path=None) -> int:
+        """Drop one cached model (``path``) or all of them (None).
+        Returns how many entries were dropped."""
+        with self._lock:
+            if path is None:
+                n = len(self._entries)
+                self._entries.clear()
+                return n
+            key = os.path.abspath(str(path))
+            return 1 if self._entries.pop(key, None) is not None else 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            models = {
+                k: {"mtime_ns": e["mtime"],
+                    "loaded_at": e["loaded_at"],
+                    "warmup": e["warmup"]}
+                for k, e in self._entries.items()
+            }
+            return {
+                "capacity": self.capacity,
+                "size": len(models),
+                "hits": self.hits,
+                "misses": self.misses,
+                "stale_reloads": self.stale_reloads,
+                "evictions": self.evictions,
+                "models": models,
+            }

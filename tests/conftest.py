@@ -20,3 +20,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; register the marker so the serving
+    # load-generator test (and future slow cases) don't warn
+    config.addinivalue_line(
+        "markers", "slow: long-running case excluded from tier-1 runs")
