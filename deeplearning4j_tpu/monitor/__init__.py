@@ -1,0 +1,62 @@
+"""Unified observability backbone: metrics registry + step-phase
+tracing + Prometheus/JSON exposition.
+
+The reproduction's telemetry surfaces — ``CompileTelemetry`` retrace
+counts (ops/bucketing.py), serving latency reservoirs
+(server/batcher.py), model-cache counters (server/model_cache.py), the
+UI's per-iteration stats (ui/stats_listener.py) — all meter into ONE
+process-wide :class:`~deeplearning4j_tpu.monitor.registry.MetricsRegistry`,
+and the training/serving hot paths are phase-annotated with
+:func:`~deeplearning4j_tpu.monitor.tracing.span`, so a single scrape
+(the gateway's ``metrics`` RPC / ``GET /metrics``) answers both "what is
+the system doing" and "where does a step spend its time".
+
+    from deeplearning4j_tpu import monitor
+
+    with monitor.span("fit/step", phase="h2d"):
+        x = jax.device_put(x)
+
+    text = monitor.render_prometheus(monitor.get_registry().snapshot())
+
+Env knobs: ``DL4J_PROFILE=<dir>`` wraps every fit in
+``jax.profiler.start_trace``; ``DL4J_TRACE_ANNOTATIONS=1`` mirrors
+spans into XLA profiler dumps; ``DL4J_SPANS=0`` disables span timing.
+Full metric catalog: docs/OBSERVABILITY.md.
+"""
+
+from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry)
+from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401
+    Span, current, enable_jax_annotations, profile_if_configured, span)
+from deeplearning4j_tpu.monitor.exposition import (  # noqa: F401
+    CONTENT_TYPE, parse_prometheus, render_json, render_prometheus,
+    summarize)
+from deeplearning4j_tpu.monitor.system import (  # noqa: F401
+    memory_collector, memory_snapshot)
+
+# Device/host memory is only knowable at scrape time — refresh it on
+# every snapshot of the process registry.
+get_registry().register_collector(memory_collector)
+
+
+def record_fit_step(batch_size: int, seconds: float,
+                    score=None, registry=None) -> None:
+    """Per-step training gauges shared by MultiLayerNetwork and
+    ComputationGraph (and read back by ui/stats_listener.py, so the UI
+    and ``/metrics`` report the same numbers)."""
+    reg = registry if registry is not None else get_registry()
+    reg.counter("dl4j_fit_iterations_total",
+                "training iterations completed").inc()
+    reg.histogram("dl4j_fit_step_seconds",
+                  "full train-step wall time (seconds)").observe(seconds)
+    if seconds > 0:
+        reg.gauge("dl4j_fit_examples_per_sec",
+                  "training throughput, last step").set(batch_size / seconds)
+    reg.gauge("dl4j_fit_last_step_ms",
+              "last train-step wall time (ms)").set(seconds * 1e3)
+    if score is not None:
+        try:
+            reg.gauge("dl4j_fit_score", "last training score").set(
+                float(score))
+        except (TypeError, ValueError):
+            pass

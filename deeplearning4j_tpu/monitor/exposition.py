@@ -1,0 +1,206 @@
+"""Exposition: render a registry snapshot as Prometheus text-format
+v0.0.4 or JSON, and parse the text format back (the round-trip check
+``tests/test_monitor.py`` pins, and a debugging convenience).
+
+Histogram families render as real Prometheus histograms
+(``_bucket``/``_sum``/``_count``) plus a sibling gauge family
+``<name>_quantile{quantile="0.5|0.95|0.99"}`` carrying the reservoir
+percentiles — scrape-side systems get aggregatable buckets AND the
+exact-ish percentiles the serving stats RPC always reported, without
+bending the text format (a histogram family may not carry quantile
+lines itself).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_QUANTILES = ("0.5", "0.95", "0.99")
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NaN"
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _label_str(labels: Dict[str, str], extra: Optional[Tuple[str, str]] = None
+               ) -> str:
+    items = list(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+def render_prometheus(snapshot: Dict[str, dict]) -> str:
+    """Prometheus text-format v0.0.4 over a
+    ``MetricsRegistry.snapshot()`` dict."""
+    lines: List[str] = []
+    for name, fam in sorted(snapshot.items()):
+        if not _NAME_RE.match(name):
+            continue
+        kind = fam.get("type", "untyped")
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {_escape(fam['help'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        quantile_lines: List[str] = []
+        for s in fam.get("samples", []):
+            labels = s.get("labels", {})
+            if kind == "histogram":
+                for le, c in s.get("buckets", {}).items():
+                    lines.append(
+                        f"{name}_bucket{_label_str(labels, ('le', le))} "
+                        f"{_fmt(c)}")
+                lines.append(f"{name}_sum{_label_str(labels)} "
+                             f"{_fmt(s.get('sum', 0.0))}")
+                lines.append(f"{name}_count{_label_str(labels)} "
+                             f"{_fmt(s.get('count', 0))}")
+                for q, key in zip(_QUANTILES, ("p50", "p95", "p99")):
+                    if s.get(key) is not None:
+                        quantile_lines.append(
+                            f"{name}_quantile"
+                            f"{_label_str(labels, ('quantile', q))} "
+                            f"{_fmt(s[key])}")
+            else:
+                lines.append(f"{name}{_label_str(labels)} "
+                             f"{_fmt(s.get('value', 0.0))}")
+        if quantile_lines:
+            lines.append(f"# TYPE {name}_quantile gauge")
+            lines.extend(quantile_lines)
+    return "\n".join(lines) + "\n"
+
+
+def render_json(snapshot: Dict[str, dict], indent: Optional[int] = None
+                ) -> str:
+    return json.dumps(snapshot, indent=indent, sort_keys=True)
+
+
+def parse_prometheus(text: str) -> Dict[str, dict]:
+    """Parse Prometheus text format back into
+    ``{family: {"type": ..., "samples": [(name, labels, value), ...]}}``.
+    Raises ValueError on malformed lines or samples outside any declared
+    family — the validity check the test suite round-trips through."""
+    families: Dict[str, dict] = {}
+    current: Optional[str] = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {lineno}: bad TYPE line {raw!r}")
+            current = parts[2]
+            families[current] = {"type": parts[3], "samples": []}
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample {raw!r}")
+        name, label_blob, value = m.group(1), m.group(2), m.group(3)
+        fam = None
+        for suffix in ("", "_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if suffix and name.endswith(suffix) \
+                else (name if not suffix else None)
+            if base and base in families:
+                fam = base
+                break
+        if fam is None:
+            raise ValueError(f"line {lineno}: sample {name!r} outside any "
+                             "declared family")
+        labels: Dict[str, str] = {}
+        if label_blob:
+            matched = _LABEL_RE.findall(label_blob)
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in matched)
+            if rebuilt != label_blob:
+                raise ValueError(f"line {lineno}: bad labels {label_blob!r}")
+            labels = {k: _unescape(v) for k, v in matched}
+        try:
+            val = float(value)
+        except ValueError:
+            raise ValueError(f"line {lineno}: bad value {value!r}")
+        families[fam]["samples"].append((name, labels, val))
+    return families
+
+
+# ---------------------------------------------------------------------------
+# Compact summary (bench.py embeds this in every BENCH_*.json record)
+# ---------------------------------------------------------------------------
+def summarize(snapshot: Dict[str, dict]) -> dict:
+    """Perf-trajectory digest of a snapshot: retrace counts by jit entry
+    point, per-(span, phase) time breakdown, throughput/score gauges and
+    serving latency percentiles — enough to attribute a bench regression
+    to a phase without shipping the full registry."""
+    out: dict = {}
+
+    fam = snapshot.get("dl4j_compile_retraces_total")
+    if fam:
+        by_kind = {s["labels"].get("kind", ""): s["value"]
+                   for s in fam["samples"]}
+        out["retraces"] = by_kind
+        out["retraces_total"] = sum(by_kind.values())
+
+    fam = snapshot.get("dl4j_phase_seconds")
+    if fam:
+        phases = {}
+        for s in fam["samples"]:
+            key = "/".join(p for p in (s["labels"].get("span", ""),
+                                       s["labels"].get("phase", "")) if p)
+            phases[key] = {"count": s["count"],
+                           "sum_sec": round(s["sum"], 4),
+                           "p50_ms": None if s["p50"] is None
+                           else round(s["p50"] * 1e3, 3)}
+        out["phase_seconds"] = phases
+
+    for gname, key in (("dl4j_fit_examples_per_sec", "examples_per_sec"),
+                       ("dl4j_fit_score", "score"),
+                       ("dl4j_fit_last_step_ms", "last_step_ms")):
+        fam = snapshot.get(gname)
+        if fam and fam["samples"]:
+            out[key] = fam["samples"][0]["value"]
+
+    fam = snapshot.get("dl4j_serving_total_seconds")
+    if fam:
+        out["serving_total_ms"] = {
+            (s["labels"].get("model") or "default"): {
+                "count": s["count"],
+                "p50": None if s["p50"] is None else round(s["p50"] * 1e3, 3),
+                "p95": None if s["p95"] is None else round(s["p95"] * 1e3, 3),
+            } for s in fam["samples"]}
+
+    cache = {}
+    for cname in ("hits", "misses", "stale_reloads", "evictions"):
+        fam = snapshot.get(f"dl4j_model_cache_{cname}_total")
+        if fam and fam["samples"]:
+            cache[cname] = fam["samples"][0]["value"]
+    if cache:
+        out["model_cache"] = cache
+    return out

@@ -1,0 +1,52 @@
+"""Host/device memory telemetry — the one implementation both the UI's
+StatsListener and the ``/metrics`` scrape read, so their numbers agree
+(the reference reports JVM+off-heap memory per iteration,
+ref: ui/stats/BaseStatsListener.java memory section; here it's host RSS
+plus per-device bytes-in-use from ``jax.local_devices()``
+``memory_stats()`` where the backend exposes them — TPU/GPU do, CPU
+usually doesn't)."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry, get_registry
+
+
+def memory_snapshot(registry: Optional[MetricsRegistry] = None
+                    ) -> Dict[str, float]:
+    """``{"host_rss_mb": ..., "device<N>_mb": ...}`` — also mirrored
+    into the registry gauges ``dl4j_host_rss_mb`` and
+    ``dl4j_device_memory_mb{device=...}``.  Every source is best-effort:
+    a backend without memory_stats just contributes nothing."""
+    reg = registry if registry is not None else get_registry()
+    out: Dict[str, float] = {}
+    try:
+        import resource
+        rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+        out["host_rss_mb"] = rss_mb
+        reg.gauge("dl4j_host_rss_mb", "host max RSS (MB)").set(rss_mb)
+    except Exception:
+        pass
+    try:
+        import jax
+        g = reg.gauge("dl4j_device_memory_mb",
+                      "per-device bytes in use (MB)", labels=("device",))
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if ms and "bytes_in_use" in ms:
+                mb = ms["bytes_in_use"] / (1024.0 * 1024.0)
+                out[f"device{d.id}_mb"] = mb
+                g.labels(device=str(d.id)).set(mb)
+    except Exception:
+        pass
+    return out
+
+
+def memory_collector(registry: MetricsRegistry) -> None:
+    """Scrape-time collector form (``registry.register_collector``):
+    refreshes the memory gauges right before every snapshot."""
+    memory_snapshot(registry)

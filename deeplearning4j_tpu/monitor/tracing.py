@@ -1,0 +1,173 @@
+"""Step-phase tracing — lightweight spans over the hot paths.
+
+``with span("fit/step", phase="h2d"):`` times a phase of work against a
+per-thread span stack and lands the duration in the registry histogram
+``dl4j_phase_seconds{span=...,phase=...}`` — so "where does a training
+step spend its time" (data wait vs bucketing vs host-to-device vs the
+jitted call vs blocking on the device) is a scrape away instead of a
+profiler session ("Array Languages Make Neural Networks Fast":
+whole-framework speedups start from knowing which phase dominates).
+
+Two optional bridges into JAX's own profiler:
+
+* ``DL4J_TRACE_ANNOTATIONS=1`` (or :func:`enable_jax_annotations`)
+  wraps every span in ``jax.profiler.TraceAnnotation`` so spans appear
+  as named regions inside XLA profiler dumps;
+* ``DL4J_PROFILE=<dir>`` makes :func:`profile_if_configured` (which
+  ``MultiLayerNetwork.fit``/``ComputationGraph.fit`` enter) wrap the
+  whole fit call in ``jax.profiler.start_trace(<dir>/fitN)`` — a full
+  XPlane/TensorBoard trace per fit with zero code changes.
+
+``DL4J_SPANS=0`` turns span timing into a no-op (the A/B lever for
+measuring span overhead; see bench.py's serving workload).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator, List, Optional
+
+from deeplearning4j_tpu.monitor.registry import (
+    MetricsRegistry, get_registry)
+
+PHASE_METRIC = "dl4j_phase_seconds"
+
+_local = threading.local()
+_flags = {"jax_annotations": None, "enabled": None}
+_profile = {"active": False, "count": 0, "lock": threading.Lock()}
+
+
+class Span:
+    __slots__ = ("name", "phase", "parent", "wall_start", "duration")
+
+    def __init__(self, name: str, phase: Optional[str],
+                 parent: Optional["Span"]):
+        self.name = name
+        self.phase = phase
+        self.parent = parent
+        self.wall_start = time.time()
+        self.duration: Optional[float] = None
+
+    def __repr__(self):
+        return (f"Span({self.name!r}, phase={self.phase!r}, "
+                f"duration={self.duration})")
+
+
+def _stack() -> List[Span]:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+def current() -> Optional[Span]:
+    """The innermost open span on this thread, or None."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def set_enabled(on: Optional[bool]) -> None:
+    """Force span timing on/off; ``None`` restores the env default
+    (``DL4J_SPANS``)."""
+    _flags["enabled"] = None if on is None else bool(on)
+
+
+def enabled() -> bool:
+    if _flags["enabled"] is not None:
+        return _flags["enabled"]
+    return os.environ.get("DL4J_SPANS", "1") != "0"
+
+
+def enable_jax_annotations(on: bool = True) -> None:
+    _flags["jax_annotations"] = bool(on)
+
+
+def _annotations_enabled() -> bool:
+    if _flags["jax_annotations"] is not None:
+        return _flags["jax_annotations"]
+    return os.environ.get("DL4J_TRACE_ANNOTATIONS") == "1"
+
+
+@contextmanager
+def span(name: str, phase: Optional[str] = None,
+         registry: Optional[MetricsRegistry] = None) -> Iterator[Span]:
+    """Time a phase of work.  Nested spans stack per-thread (``current()``
+    sees the innermost); the duration lands in
+    ``dl4j_phase_seconds{span=name, phase=phase}`` on exit — exceptions
+    included, a failing step still accounts for its time."""
+    if not enabled():
+        yield Span(name, phase, None)
+        return
+    st = _stack()
+    s = Span(name, phase, st[-1] if st else None)
+    st.append(s)
+    ann = None
+    if _annotations_enabled():
+        try:
+            import jax
+            ann = jax.profiler.TraceAnnotation(
+                f"{name}/{phase}" if phase else name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+    t0 = time.perf_counter()
+    try:
+        yield s
+    finally:
+        s.duration = time.perf_counter() - t0
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        if st and st[-1] is s:
+            st.pop()
+        reg = registry if registry is not None else get_registry()
+        reg.histogram(
+            PHASE_METRIC, "span phase wall time (seconds)",
+            labels=("span", "phase"),
+        ).labels(span=name, phase=phase or "").observe(s.duration)
+
+
+@contextmanager
+def profile_if_configured(tag: str = "fit") -> Iterator[None]:
+    """No-op unless ``DL4J_PROFILE=<dir>`` is set; then the body runs
+    under ``jax.profiler.start_trace(<dir>/<tag><N>)``.  Re-entrant
+    calls (fit inside fit, concurrent fits) skip — JAX allows one live
+    trace per process."""
+    d = os.environ.get("DL4J_PROFILE")
+    if not d:
+        yield
+        return
+    with _profile["lock"]:
+        if _profile["active"]:
+            started = False
+        else:
+            _profile["active"] = True
+            path = os.path.join(d, f"{tag}{_profile['count']}")
+            _profile["count"] += 1
+            started = True
+    if not started:
+        yield
+        return
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+    except Exception:
+        with _profile["lock"]:
+            _profile["active"] = False
+        yield
+        return
+    try:
+        yield
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        with _profile["lock"]:
+            _profile["active"] = False

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.graph_conf import (
@@ -340,33 +341,36 @@ class ComputationGraph:
                     and self.conf.global_conf.iterations <= 1) else 1)
         if isinstance(data, MultiDataSet):
             batches = [data]
-            for _ in range(epochs):
-                epoch_hook("on_epoch_start")
-                for mds in batches:
-                    self._fit_batch(mds)
-                epoch_hook("on_epoch_end")
-                self.epoch += 1
+            with monitor.profile_if_configured("fit"):
+                for _ in range(epochs):
+                    epoch_hook("on_epoch_start")
+                    for mds in batches:
+                        self._fit_batch(mds)
+                    epoch_hook("on_epoch_end")
+                    self.epoch += 1
             return self
         # iterator of DataSet or MultiDataSet
-        for _ in range(epochs):
-            epoch_hook("on_epoch_start")
-            data.reset()
-            pending = []
-            for item in data:
-                if isinstance(item, DataSet):
-                    item = MultiDataSet([item.features], [item.labels],
-                                        [item.features_mask], [item.labels_mask])
-                if fuse > 1:
-                    pending.append(item)
-                    if len(pending) == fuse:
-                        self._fit_fused_group(pending)
-                        pending = []
-                else:
+        with monitor.profile_if_configured("fit"):
+            for _ in range(epochs):
+                epoch_hook("on_epoch_start")
+                data.reset()
+                pending = []
+                for item in data:
+                    if isinstance(item, DataSet):
+                        item = MultiDataSet(
+                            [item.features], [item.labels],
+                            [item.features_mask], [item.labels_mask])
+                    if fuse > 1:
+                        pending.append(item)
+                        if len(pending) == fuse:
+                            self._fit_fused_group(pending)
+                            pending = []
+                    else:
+                        self._fit_batch(item)
+                for item in pending:
                     self._fit_batch(item)
-            for item in pending:
-                self._fit_batch(item)
-            epoch_hook("on_epoch_end")
-            self.epoch += 1
+                epoch_hook("on_epoch_end")
+                self.epoch += 1
         return self
 
     def _build_fused_step(self, k: int):
@@ -446,16 +450,24 @@ class ComputationGraph:
         self.compile_telemetry.record(f"fused_step_k{k}",
                                       (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
-        (self.net_params, self.net_state, self.opt_states,
-         score) = self._fused_fns[k](
-            self.net_params, self.net_state, self.opt_states,
-            xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32), sub)
+        t_step = time.perf_counter()
+        with monitor.span("fit/step", phase="jit_call"):
+            (self.net_params, self.net_state, self.opt_states,
+             score) = self._fused_fns[k](
+                self.net_params, self.net_state, self.opt_states,
+                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
+                sub)
+        with monitor.span("fit/step", phase="block_until_ready"):
+            jax.block_until_ready(score)
         self._strip_rnn_state()
         self._score = score
         self.iteration += k
         self.last_batch_size = sum(sizes)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+        monitor.record_fit_step(self.last_batch_size,
+                                time.perf_counter() - t_step, score)
+        with monitor.span("fit/step", phase="listeners"):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
 
     def _check_trace_token(self):
         """See MultiLayerNetwork._check_trace_token — retrace when the
@@ -501,26 +513,36 @@ class ComputationGraph:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         self.last_batch_size = mds.num_examples()
-        mds, bucket = self._maybe_bucket_train(mds)
-        xs = tuple(jnp.asarray(f) for f in mds.features)
-        ys = tuple(jnp.asarray(l) for l in mds.labels)
-        fm = (tuple(None if m is None else jnp.asarray(m)
-                    for m in mds.features_masks)
-              if mds.features_masks is not None else None)
-        lm = (tuple(None if m is None else jnp.asarray(m)
-                    for m in mds.labels_masks)
-              if mds.labels_masks is not None else None)
+        t_step = time.perf_counter()
+        with monitor.span("fit/step", phase="bucket"):
+            mds, bucket = self._maybe_bucket_train(mds)
+        with monitor.span("fit/step", phase="h2d"):
+            xs = tuple(jnp.asarray(f) for f in mds.features)
+            ys = tuple(jnp.asarray(l) for l in mds.labels)
+            fm = (tuple(None if m is None else jnp.asarray(m)
+                        for m in mds.features_masks)
+                  if mds.features_masks is not None else None)
+            lm = (tuple(None if m is None else jnp.asarray(m)
+                        for m in mds.labels_masks)
+                  if mds.labels_masks is not None else None)
         self.compile_telemetry.record("train_step", (xs, ys, fm, lm),
                                       bucket=bucket)
         self._key, sub = jax.random.split(self._key)
-        (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
-            self.net_params, self.net_state, self.opt_states, xs, ys, fm, lm,
-            jnp.asarray(self.iteration, jnp.int32), sub)
+        with monitor.span("fit/step", phase="jit_call"):
+            (self.net_params, self.net_state, self.opt_states,
+             score) = self._step_fn(
+                self.net_params, self.net_state, self.opt_states, xs, ys,
+                fm, lm, jnp.asarray(self.iteration, jnp.int32), sub)
+        with monitor.span("fit/step", phase="block_until_ready"):
+            jax.block_until_ready(score)
         self._strip_rnn_state()
         self._score = score
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+        monitor.record_fit_step(self.last_batch_size,
+                                time.perf_counter() - t_step, score)
+        with monitor.span("fit/step", phase="listeners"):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
 
     def _strip_rnn_state(self):
         if self.net_state is None:

@@ -202,24 +202,30 @@ class LatencyHistogram:
                 if i < self.capacity:
                     self._samples[i] = s
 
-    def percentile(self, q: float) -> float:
-        """q in [0, 1]; 0.0 when nothing was recorded."""
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 1]; None when nothing was recorded (a 0.0 here reads
+        as a real zero-latency sample downstream — callers must handle
+        the empty reservoir explicitly)."""
         with self._lock:
             if not self._samples:
-                return 0.0
+                return None
             xs = sorted(self._samples)
         return xs[min(len(xs) - 1, int(q * len(xs)))]
 
     def snapshot(self) -> dict:
         with self._lock:
             count, total, mx = self.count, self.total, self.max
+
+        def ms(v):
+            return None if v is None else round(v * 1e3, 3)
+
         return {
             "count": count,
-            "mean_ms": round(total / count * 1e3, 3) if count else 0.0,
-            "p50_ms": round(self.percentile(0.50) * 1e3, 3),
-            "p95_ms": round(self.percentile(0.95) * 1e3, 3),
-            "p99_ms": round(self.percentile(0.99) * 1e3, 3),
-            "max_ms": round(mx * 1e3, 3),
+            "mean_ms": round(total / count * 1e3, 3) if count else None,
+            "p50_ms": ms(self.percentile(0.50)),
+            "p95_ms": ms(self.percentile(0.95)),
+            "p99_ms": ms(self.percentile(0.99)),
+            "max_ms": ms(mx) if count else None,
         }
 
 

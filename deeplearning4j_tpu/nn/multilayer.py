@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
@@ -448,30 +449,33 @@ class MultiLayerNetwork:
         fuse = (max(1, int(fused_steps))
                 if (self.conf.backprop_type != "truncatedbptt"
                     and self.conf.global_conf.iterations <= 1) else 1)
-        for _ in range(epochs):
-            for lst in self.listeners:
-                if isinstance(lst, TrainingListener):
-                    lst.on_epoch_start(self)
-            it.reset()
-            t_etl = time.perf_counter()
-            pending = []
-            while it.has_next():
-                ds = it.next()
-                self.last_etl_time_ms = (time.perf_counter() - t_etl) * 1e3
-                if fuse > 1:
-                    pending.append(ds)
-                    if len(pending) == fuse:
-                        self._fit_fused_group(pending)
-                        pending = []
-                else:
-                    self._fit_batch(ds)
+        with monitor.profile_if_configured("fit"):
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    if isinstance(lst, TrainingListener):
+                        lst.on_epoch_start(self)
+                it.reset()
                 t_etl = time.perf_counter()
-            for ds in pending:  # ragged tail: per-step path
-                self._fit_batch(ds)
-            for lst in self.listeners:
-                if isinstance(lst, TrainingListener):
-                    lst.on_epoch_end(self)
-            self.epoch += 1
+                pending = []
+                while it.has_next():
+                    with monitor.span("fit/step", phase="data_wait"):
+                        ds = it.next()
+                    self.last_etl_time_ms = \
+                        (time.perf_counter() - t_etl) * 1e3
+                    if fuse > 1:
+                        pending.append(ds)
+                        if len(pending) == fuse:
+                            self._fit_fused_group(pending)
+                            pending = []
+                    else:
+                        self._fit_batch(ds)
+                    t_etl = time.perf_counter()
+                for ds in pending:  # ragged tail: per-step path
+                    self._fit_batch(ds)
+                for lst in self.listeners:
+                    if isinstance(lst, TrainingListener):
+                        lst.on_epoch_end(self)
+                self.epoch += 1
         return self
 
     def _build_fused_step(self, k: int):
@@ -529,25 +533,34 @@ class MultiLayerNetwork:
                 return
         if k not in self._fused_fns:
             self._fused_fns[k] = self._build_fused_step(k)
-        xs = jnp.stack([jnp.asarray(d.features) for d in group])
-        ys = jnp.stack([jnp.asarray(d.labels) for d in group])
-        fms = (jnp.stack([jnp.asarray(d.features_mask) for d in group])
-               if group[0].features_mask is not None else None)
-        lms = (jnp.stack([jnp.asarray(d.labels_mask) for d in group])
-               if group[0].labels_mask is not None else None)
+        t_step = time.perf_counter()
+        with monitor.span("fit/step", phase="h2d"):
+            xs = jnp.stack([jnp.asarray(d.features) for d in group])
+            ys = jnp.stack([jnp.asarray(d.labels) for d in group])
+            fms = (jnp.stack([jnp.asarray(d.features_mask) for d in group])
+                   if group[0].features_mask is not None else None)
+            lms = (jnp.stack([jnp.asarray(d.labels_mask) for d in group])
+                   if group[0].labels_mask is not None else None)
         self.compile_telemetry.record(f"fused_step_k{k}",
                                       (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
-        (self.net_params, self.net_state, self.opt_states,
-         score) = self._fused_fns[k](
-            self.net_params, self.net_state, self.opt_states,
-            xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32), sub)
+        with monitor.span("fit/step", phase="jit_call"):
+            (self.net_params, self.net_state, self.opt_states,
+             score) = self._fused_fns[k](
+                self.net_params, self.net_state, self.opt_states,
+                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
+                sub)
+        with monitor.span("fit/step", phase="block_until_ready"):
+            jax.block_until_ready(score)
         self._strip_rnn_state()
         self._score = score
         self.iteration += k
         self.last_batch_size = sum(sizes)
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+        monitor.record_fit_step(self.last_batch_size,
+                                time.perf_counter() - t_step, score)
+        with monitor.span("fit/step", phase="listeners"):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
 
     def _fit_batch(self, ds):
         g = self.conf.global_conf
@@ -555,21 +568,41 @@ class MultiLayerNetwork:
         if self.conf.backprop_type == "truncatedbptt" and ds.features.ndim == 3:
             self._fit_tbptt(ds)
             return
-        ds, bucket = self._maybe_bucket_train(ds)
+        t_step = time.perf_counter()
+        with monitor.span("fit/step", phase="bucket"):
+            ds, bucket = self._maybe_bucket_train(ds)
         self.compile_telemetry.record(
             "train_step", (ds.features, ds.labels, ds.features_mask,
                            ds.labels_mask), bucket=bucket)
+        with monitor.span("fit/step", phase="h2d"):
+            # no-op when the async iterator already device_put the batch;
+            # otherwise this is the host→device transfer, timed apart
+            # from the jitted call it used to hide inside
+            feats = jnp.asarray(ds.features)
+            labels = jnp.asarray(ds.labels)
+            fmask = (None if ds.features_mask is None
+                     else jnp.asarray(ds.features_mask))
+            lmask = (None if ds.labels_mask is None
+                     else jnp.asarray(ds.labels_mask))
         for _ in range(max(1, g.iterations)):
             self._key, sub = jax.random.split(self._key)
-            (self.net_params, self.net_state, self.opt_states, score) = self._step_fn(
-                self.net_params, self.net_state, self.opt_states,
-                ds.features, ds.labels, ds.features_mask, ds.labels_mask,
-                jnp.asarray(self.iteration, jnp.int32), sub)
+            with monitor.span("fit/step", phase="jit_call"):
+                (self.net_params, self.net_state, self.opt_states,
+                 score) = self._step_fn(
+                    self.net_params, self.net_state, self.opt_states,
+                    feats, labels, fmask, lmask,
+                    jnp.asarray(self.iteration, jnp.int32), sub)
+            with monitor.span("fit/step", phase="block_until_ready"):
+                jax.block_until_ready(score)
             self._strip_rnn_state()
             self._score = score
             self.iteration += 1
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration)
+            monitor.record_fit_step(self.last_batch_size,
+                                    time.perf_counter() - t_step, score)
+            with monitor.span("fit/step", phase="listeners"):
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration)
+            t_step = time.perf_counter()
 
     def _fit_tbptt(self, ds):
         """Truncated BPTT over time segments, carrying RNN state

@@ -454,6 +454,22 @@ class CompileTelemetry:
         if bucket is not None:
             key = f"{kind}:{bucket_key(bucket)}"
             self.bucket_hits[key] = self.bucket_hits.get(key, 0) + 1
+        # mirror into the process-wide registry (monitor/) so retraces
+        # show up in the same scrape as latencies and memory; aggregated
+        # across networks — per-instance detail stays on this object
+        from deeplearning4j_tpu.monitor import get_registry
+        reg = get_registry()
+        reg.counter("dl4j_compile_calls_total", "jit-entry calls",
+                    labels=("kind",)).labels(kind=kind).inc()
+        if new:
+            reg.counter("dl4j_compile_retraces_total",
+                        "new jit-entry signatures (XLA retraces)",
+                        labels=("kind",)).labels(kind=kind).inc()
+        if bucket is not None:
+            reg.counter("dl4j_bucket_hits_total",
+                        "bucketed batches dispatched",
+                        labels=("kind", "bucket")).labels(
+                kind=kind, bucket=bucket_key(bucket)).inc()
         return new
 
     def invalidate(self) -> None:

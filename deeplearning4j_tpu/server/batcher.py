@@ -34,7 +34,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from deeplearning4j_tpu.nn.listeners import LatencyHistogram
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.ops import bucketing
 
 
@@ -51,13 +51,35 @@ class ServingMetrics:
     """Per-batcher serving telemetry: request latency split into queue
     (enqueue → batch dispatch), compute (the jitted call), and total
     (enqueue → result), plus how well coalescing is working (batch-size
-    histogram, rows per batch)."""
+    histogram, rows per batch).
 
-    def __init__(self):
+    The latency recorders are registry histograms
+    (``dl4j_serving_{queue,compute,total}_seconds{model=...}``, each a
+    ``LatencyHistogram`` reservoir plus Prometheus buckets) so one
+    ``/metrics`` scrape sees every batcher; ``snapshot()`` keeps the
+    stats RPC's legacy ``*_ms`` dict shape on top of the same data."""
+
+    def __init__(self, name: str = ""):
+        reg = monitor.get_registry()
         self._lock = threading.Lock()
-        self.queue = LatencyHistogram()
-        self.compute = LatencyHistogram()
-        self.total = LatencyHistogram()
+        lbl = {"model": name or "default"}
+        self.queue = reg.histogram(
+            "dl4j_serving_queue_seconds",
+            "request enqueue → batch dispatch", ("model",)).labels(**lbl)
+        self.compute = reg.histogram(
+            "dl4j_serving_compute_seconds",
+            "batched jitted inference call", ("model",)).labels(**lbl)
+        self.total = reg.histogram(
+            "dl4j_serving_total_seconds",
+            "request enqueue → result", ("model",)).labels(**lbl)
+        self._c_requests = reg.counter(
+            "dl4j_serving_requests_total", "predict requests served",
+            ("model",)).labels(**lbl)
+        self._c_rows = reg.counter(
+            "dl4j_serving_rows_total", "rows served", ("model",)).labels(**lbl)
+        self._c_batches = reg.counter(
+            "dl4j_serving_batches_total", "coalesced batches dispatched",
+            ("model",)).labels(**lbl)
         self.requests = 0
         self.rows = 0
         self.batches = 0
@@ -70,6 +92,9 @@ class ServingMetrics:
             self.batches += 1
             self.batch_size_hist[n_rows] = \
                 self.batch_size_hist.get(n_rows, 0) + 1
+        self._c_requests.inc(n_requests)
+        self._c_rows.inc(n_rows)
+        self._c_batches.inc()
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -84,9 +109,9 @@ class ServingMetrics:
             "requests_per_batch_mean":
                 round(requests / batches, 2) if batches else 0.0,
             "batch_size_hist": hist,
-            "queue_ms": self.queue.snapshot(),
-            "compute_ms": self.compute.snapshot(),
-            "total_ms": self.total.snapshot(),
+            "queue_ms": self.queue.latency_snapshot(),
+            "compute_ms": self.compute.latency_snapshot(),
+            "total_ms": self.total.latency_snapshot(),
         }
 
 
@@ -121,7 +146,7 @@ class MicroBatcher:
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self._bucket_sizes = (list(bucket_sizes) if bucket_sizes else None)
         self._pad = bool(pad_to_bucket)
-        self.metrics = ServingMetrics()
+        self.metrics = ServingMetrics(name)
         self._queue: List[_Pending] = []
         self._cond = threading.Condition()
         self._running = True
@@ -203,16 +228,18 @@ class MicroBatcher:
     def _run_group(self, group: List[_Pending]) -> None:
         t_dispatch = time.perf_counter()
         try:
-            xs = [p.x for p in group]
-            x = np.concatenate(xs) if len(xs) > 1 else xs[0]
-            n = len(x)
-            if self._pad:
-                nb = bucketing.bucket_size(n, self._bucket_sizes)
-                if nb != n:
-                    x = np.concatenate(
-                        [x, np.zeros((nb - n,) + x.shape[1:], x.dtype)])
+            with monitor.span("serve/batch", phase="concat_pad"):
+                xs = [p.x for p in group]
+                x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+                n = len(x)
+                if self._pad:
+                    nb = bucketing.bucket_size(n, self._bucket_sizes)
+                    if nb != n:
+                        x = np.concatenate(
+                            [x, np.zeros((nb - n,) + x.shape[1:], x.dtype)])
             t0 = time.perf_counter()
-            out = np.asarray(self._infer_fn(x))[:n]
+            with monitor.span("serve/batch", phase="compute"):
+                out = np.asarray(self._infer_fn(x))[:n]
             t1 = time.perf_counter()
             i = 0
             for p in group:

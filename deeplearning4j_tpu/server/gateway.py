@@ -20,8 +20,12 @@ result checkpoint) and extended into a real inference server:
   serving ladder (``warmup_inference``), so cold compiles happen once
   at load, not on the request path;
 * **serving metrics** (``stats`` RPC): latency percentiles, batch-size
-  histogram, model-cache counters, and each model's
-  ``CompileTelemetry`` snapshot.
+  histogram, model-cache counters, each model's ``CompileTelemetry``
+  snapshot, and the process-wide metrics registry;
+* **Prometheus exposition** (``metrics`` RPC / ``GET /metrics``): the
+  unified registry (monitor/) as text-format v0.0.4 or JSON — one
+  scrape sees retraces, step-phase timings, serving latencies, cache
+  hit rates and device memory (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.server.batcher import MicroBatcher
 from deeplearning4j_tpu.server.model_cache import ModelCache
 
@@ -199,8 +204,11 @@ class DeepLearning4jEntryPoint:
     def stats(self) -> dict:
         """Serving observability: model-cache counters, per-model
         batcher metrics (queue/compute/total latency percentiles,
-        batch-size histogram), and each resident model's
-        ``CompileTelemetry`` snapshot."""
+        batch-size histogram), each resident model's
+        ``CompileTelemetry`` snapshot, AND the process-wide metrics
+        registry — one RPC sees retraces, latencies, phase timings and
+        memory together (keys ``model_cache``/``serving`` are unchanged
+        for existing clients; ``registry`` is additive)."""
         out = {"model_cache": self.model_cache.stats(), "serving": {}}
         with self._batcher_lock:
             items = list(self._batchers.items())
@@ -210,7 +218,24 @@ class DeepLearning4jEntryPoint:
             if tel is not None:
                 s["compile_telemetry"] = tel.snapshot()
             out["serving"][key] = s
+        out["registry"] = monitor.get_registry().snapshot()
         return out
+
+    def metrics(self, format: str = "prometheus"):
+        """The scrape endpoint as an RPC.  ``format="prometheus"``
+        (default) returns ``{"content_type", "body"}`` with text-format
+        v0.0.4 (also served raw at ``GET /metrics`` for a stock
+        Prometheus scraper / ``curl``); ``format="json"`` returns the
+        registry snapshot dict itself."""
+        fmt = str(format).lower()
+        snap = monitor.get_registry().snapshot()
+        if fmt == "json":
+            return snap
+        if fmt != "prometheus":
+            raise ValueError(f"format must be prometheus or json, "
+                             f"got {format!r}")
+        return {"content_type": monitor.CONTENT_TYPE,
+                "body": monitor.render_prometheus(snap)}
 
     def close(self) -> None:
         """Stop all batcher threads (server shutdown)."""
@@ -308,7 +333,33 @@ class Server:
             def log_message(self, *args):
                 pass
 
+            def _respond(self, code, payload, content_type):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                """``GET /metrics`` — the raw Prometheus scrape surface
+                (``curl http://host:port/metrics``); everything else 404s."""
+                path = self.path.split("?", 1)[0]
+                if path != "/metrics":
+                    self._respond(404, b'{"error": "not found"}',
+                                  "application/json")
+                    return
+                try:
+                    m = ep.metrics()
+                    server._count_request("GET /metrics", 200)
+                    self._respond(200, m["body"].encode(), m["content_type"])
+                except Exception as e:
+                    server._count_request("GET /metrics", 500)
+                    self._respond(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode(),
+                        "application/json")
+
             def do_POST(self):
+                method = ""
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -324,15 +375,18 @@ class Server:
                         err["traceback"] = traceback.format_exc()
                     payload = json.dumps(err).encode()
                     code = 500
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                server._count_request(method or "?", code)
+                self._respond(code, payload, "application/json")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
+        self._requests_c = monitor.get_registry().counter(
+            "dl4j_gateway_requests_total", "gateway RPC calls",
+            labels=("method", "code"))
+
+    def _count_request(self, method: str, code: int) -> None:
+        self._requests_c.labels(method=method, code=str(code)).inc()
 
     def start(self) -> "Server":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

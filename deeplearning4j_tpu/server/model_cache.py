@@ -29,6 +29,8 @@ import time
 from collections import OrderedDict
 from typing import Callable, Optional
 
+from deeplearning4j_tpu import monitor
+
 
 def default_loader(path: str):
     """Checkpoint sniffing shared with the gateway: Keras ``.h5`` via
@@ -56,6 +58,19 @@ class ModelCache:
         self.misses = 0
         self.stale_reloads = 0
         self.evictions = 0
+        # mirrored into the process registry (aggregated over caches) so
+        # hit rates land in the same /metrics scrape as latencies
+        reg = monitor.get_registry()
+        self._counters = {
+            k: reg.counter(f"dl4j_model_cache_{k}_total",
+                           f"model cache {k.replace('_', ' ')}")
+            for k in ("hits", "misses", "stale_reloads", "evictions")}
+        self._g_resident = reg.gauge("dl4j_model_cache_resident",
+                                     "models resident across caches")
+
+    def _count(self, what: str) -> None:
+        setattr(self, what, getattr(self, what) + 1)
+        self._counters[what].inc()
 
     def get(self, path, shape_bucketing: Optional[bool] = None,
             warmup_dims=None, max_batch: int = 32):
@@ -73,14 +88,14 @@ class ModelCache:
         with self._lock:
             e = self._entries.get(key)
             if e is not None and e["mtime"] != mtime:
-                self.stale_reloads += 1
+                self._count("stale_reloads")
                 del self._entries[key]
                 e = None
             if e is not None:
-                self.hits += 1
+                self._count("hits")
                 self._entries.move_to_end(key)
             else:
-                self.misses += 1
+                self._count("misses")
                 model = self._loader(key)
                 if shape_bucketing is not None:
                     model.conf.global_conf.shape_bucketing = \
@@ -90,7 +105,8 @@ class ModelCache:
                 self._entries[key] = e
                 while len(self._entries) > self.capacity:
                     self._entries.popitem(last=False)
-                    self.evictions += 1
+                    self._count("evictions")
+            self._g_resident.set(len(self._entries))
             if warmup_dims is not None and e["warmup"] is None \
                     and hasattr(e["model"], "warmup_inference"):
                 e["warmup"] = e["model"].warmup_inference(
@@ -119,9 +135,11 @@ class ModelCache:
             if path is None:
                 n = len(self._entries)
                 self._entries.clear()
-                return n
-            key = os.path.abspath(str(path))
-            return 1 if self._entries.pop(key, None) is not None else 0
+            else:
+                key = os.path.abspath(str(path))
+                n = 1 if self._entries.pop(key, None) is not None else 0
+            self._g_resident.set(len(self._entries))
+            return n
 
     def stats(self) -> dict:
         with self._lock:

@@ -12,13 +12,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import resource
 import time
 import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.nn.listeners import IterationListener
 from deeplearning4j_tpu.ui.stats_storage import StatsStorageRouter
 
@@ -117,6 +117,39 @@ class StatsListener(IterationListener):
                 out[f"{name}_{k}"] = np.asarray(v)
         return out
 
+    def _perf_from_registry(self, model, now: float, iteration: int) -> dict:
+        """Per-iteration perf sourced from the registry gauges the fit
+        loop sets (``dl4j_fit_last_step_ms`` / ``_examples_per_sec``);
+        falls back to inter-post wall timing when the model is driven by
+        a loop that doesn't meter (custom training loops)."""
+        reg = monitor.get_registry()
+
+        def gauge(name):
+            fam = reg.get(name)
+            if fam is None:
+                return None
+            try:
+                return fam.value
+            except ValueError:
+                return None
+
+        step_ms = gauge("dl4j_fit_last_step_ms")
+        if step_ms:
+            return {
+                "duration_ms": step_ms,
+                "samples_per_sec": gauge("dl4j_fit_examples_per_sec") or 0.0,
+                "batches_per_sec": 1e3 / step_ms,
+                "total_minibatches": iteration,
+            }
+        dt = (now - self._last_time) if self._last_time else 0.0
+        batch = getattr(model, "last_batch_size", 0)
+        return {
+            "duration_ms": dt * 1000.0,
+            "samples_per_sec": batch / dt if dt > 0 else 0.0,
+            "batches_per_sec": 1.0 / dt if dt > 0 else 0.0,
+            "total_minibatches": iteration,
+        }
+
     def iteration_done(self, model, iteration: int) -> None:
         if not self._static_posted:
             self._post_static(model)
@@ -134,36 +167,19 @@ class StatsListener(IterationListener):
                         s = _summary(delta)
                         updates[k] = s
                         grads[k] = s  # post-LR update ≈ scaled gradient
-            dt = (now - self._last_time) if self._last_time else 0.0
-            batch = getattr(model, "last_batch_size", 0)
-            rss_mb = resource.getrusage(
-                resource.RUSAGE_SELF).ru_maxrss / 1024.0
-            memory = {"host_rss_mb": rss_mb}
-            # device-side HBM stats when the backend exposes them — the
-            # reference reports JVM+off-heap memory per iteration
-            # (BaseStatsListener memory section); here it's host RSS +
-            # per-device bytes-in-use
-            try:
-                import jax
-                for d in jax.local_devices():
-                    ms = d.memory_stats()
-                    if ms and "bytes_in_use" in ms:
-                        memory[f"device{d.id}_mb"] = (
-                            ms["bytes_in_use"] / (1024.0 * 1024.0))
-            except Exception:
-                pass
+            # perf/memory come from the monitor registry — the SAME
+            # numbers a /metrics scrape reports (the fit loop's phase
+            # spans set the gauges, monitor/system.py owns the memory
+            # capture), instead of re-measuring with resource/time
+            # inline and drifting from the exposition endpoint
+            memory = monitor.memory_snapshot()
+            perf = self._perf_from_registry(model, now, iteration)
             report = StatsReport(
                 session_id=self.session_id, worker_id=self.worker_id,
                 timestamp=int(time.time() * 1000), iteration=iteration,
                 score=float(model.score()),
                 params=params, gradients=grads, updates=updates,
-                perf={
-                    "duration_ms": dt * 1000.0,
-                    "samples_per_sec": batch / dt if dt > 0 else 0.0,
-                    "batches_per_sec": 1.0 / dt if dt > 0 else 0.0,
-                    "total_minibatches": iteration,
-                },
-                memory=memory)
+                perf=perf, memory=memory)
             self.router.put_update(report.to_record())
             self._last_params = cur if self.collect_histograms else None
         self._last_time = now

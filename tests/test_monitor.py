@@ -1,0 +1,354 @@
+"""Unified observability backbone (deeplearning4j_tpu/monitor):
+registry thread-safety, histogram bucket/percentile correctness,
+Prometheus text-format round-trip, span nesting/timing, the
+empty-reservoir percentile fix, and a fit + concurrent-predict
+integration test asserting retraces/phase-timings/latencies/cache
+counters all appear in one ``metrics`` RPC scrape."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import exposition, tracing
+from deeplearning4j_tpu.monitor.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+def test_counter_concurrent_increments():
+    reg = MetricsRegistry()
+    c = reg.counter("t_work_total", "work", labels=("worker",))
+    n_threads, per_thread = 8, 2000
+
+    def work(i):
+        child = c.labels(worker=str(i % 3))
+        for _ in range(per_thread):
+            child.inc()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    samples = reg.snapshot()["t_work_total"]["samples"]
+    assert sum(s["value"] for s in samples) == n_threads * per_thread
+    assert {s["labels"]["worker"] for s in samples} == {"0", "1", "2"}
+
+
+def test_registry_get_or_create_and_type_clash():
+    reg = MetricsRegistry()
+    assert reg.counter("x_total") is reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    g = reg.gauge("g")
+    g.set(4.0)
+    g.inc(1.5)
+    assert reg.get("g").value == 5.5
+    assert reg.get("missing") is None
+
+
+def test_gauge_collector_runs_at_snapshot():
+    reg = MetricsRegistry()
+    calls = []
+
+    def collect(r):
+        calls.append(1)
+        r.gauge("scrape_time_g").set(len(calls))
+
+    reg.register_collector(collect)
+    reg.register_collector(collect)  # dedup
+    snap = reg.snapshot()
+    assert len(calls) == 1
+    assert snap["scrape_time_g"]["samples"][0]["value"] == 1
+
+
+def test_histogram_buckets_and_percentiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "x", buckets=(0.01, 0.1, 1.0))
+    for v in [0.005] * 10 + [0.05] * 10 + [0.5] * 10:
+        h.observe(v)
+    s = reg.snapshot()["lat_seconds"]["samples"][0]
+    assert s["count"] == 30
+    assert s["sum"] == pytest.approx(0.05 * 10 + 0.5 * 10 + 0.005 * 10)
+    assert s["buckets"] == {"0.01": 10, "0.1": 20, "1.0": 30, "+Inf": 30}
+    assert 0.005 <= s["p50"] <= 0.5
+    assert s["p99"] == 0.5
+    assert s["max"] == 0.5
+
+
+def test_histogram_boundary_lands_in_le_bucket():
+    reg = MetricsRegistry()
+    h = reg.histogram("b_seconds", buckets=(1.0, 2.0))
+    h.observe(1.0)  # le="1.0" means <= 1.0
+    h.observe(3.0)  # past the ladder → +Inf only
+    s = reg.snapshot()["b_seconds"]["samples"][0]
+    assert s["buckets"] == {"1.0": 1, "2.0": 1, "+Inf": 2}
+
+
+def test_empty_latency_histogram_percentile_is_none():
+    from deeplearning4j_tpu.nn.listeners import LatencyHistogram
+    lh = LatencyHistogram()
+    assert lh.percentile(0.5) is None
+    snap = lh.snapshot()
+    assert snap["count"] == 0
+    assert snap["p50_ms"] is None and snap["p99_ms"] is None
+    assert snap["mean_ms"] is None and snap["max_ms"] is None
+    lh.record(0.25)
+    assert lh.percentile(0.5) == 0.25
+    assert lh.snapshot()["p95_ms"] == 250.0
+
+
+def test_empty_serving_metrics_snapshot_tolerated():
+    from deeplearning4j_tpu.server.batcher import ServingMetrics
+    s = ServingMetrics("empty-model").snapshot()
+    assert s["requests"] == 0
+    assert s["total_ms"]["p50_ms"] is None  # no index error, no fake 0.0
+    json.dumps(s)  # and it still serializes for the stats RPC
+
+
+# ---------------------------------------------------------------------------
+# Exposition
+# ---------------------------------------------------------------------------
+def _sample_map(fam):
+    return {(name, tuple(sorted(labels.items()))): v
+            for name, labels, v in fam["samples"]}
+
+
+def test_prometheus_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("rt_total", "a counter", labels=("k",)).labels(k="x").inc(3)
+    reg.counter("rt_total", labels=("k",)).labels(k='we"ird\nlabel').inc()
+    reg.gauge("rt_gauge", "a gauge").set(2.5)
+    h = reg.histogram("rt_seconds", "a histogram", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = exposition.render_prometheus(reg.snapshot())
+    fams = exposition.parse_prometheus(text)
+
+    assert fams["rt_total"]["type"] == "counter"
+    m = _sample_map(fams["rt_total"])
+    assert m[("rt_total", (("k", "x"),))] == 3
+    assert m[("rt_total", (("k", 'we"ird\nlabel'),))] == 1
+
+    assert _sample_map(fams["rt_gauge"])[("rt_gauge", ())] == 2.5
+
+    hm = _sample_map(fams["rt_seconds"])
+    assert hm[("rt_seconds_bucket", (("le", "0.1"),))] == 1
+    assert hm[("rt_seconds_bucket", (("le", "+Inf"),))] == 2
+    assert hm[("rt_seconds_count", ())] == 2
+    assert hm[("rt_seconds_sum", ())] == pytest.approx(0.55)
+    # reservoir percentiles exposed as the sibling _quantile gauge family
+    qm = _sample_map(fams["rt_seconds_quantile"])
+    assert qm[("rt_seconds_quantile", (("quantile", "0.5"),))] in (0.05, 0.5)
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError):
+        exposition.parse_prometheus("# TYPE x counter\nnot a sample line !")
+    with pytest.raises(ValueError):
+        exposition.parse_prometheus("orphan_metric 1\n")
+
+
+def test_render_json_is_valid_json():
+    reg = MetricsRegistry()
+    reg.counter("j_total").inc()
+    parsed = json.loads(exposition.render_json(reg.snapshot()))
+    assert parsed["j_total"]["samples"][0]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+def test_span_nesting_and_timing():
+    reg = MetricsRegistry()
+    assert tracing.current() is None
+    with tracing.span("outer", registry=reg) as s_out:
+        assert tracing.current() is s_out
+        with tracing.span("outer", phase="inner", registry=reg) as s_in:
+            assert tracing.current() is s_in
+            assert s_in.parent is s_out
+            time.sleep(0.01)
+        assert tracing.current() is s_out
+    assert tracing.current() is None
+    assert s_in.duration >= 0.01
+    assert s_out.duration >= s_in.duration
+    samples = reg.snapshot()[tracing.PHASE_METRIC]["samples"]
+    by_phase = {s["labels"]["phase"]: s for s in samples
+                if s["labels"]["span"] == "outer"}
+    assert by_phase["inner"]["count"] == 1
+    assert by_phase[""]["sum"] >= by_phase["inner"]["sum"]
+
+
+def test_span_records_on_exception_and_disabled():
+    reg = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom", registry=reg):
+            raise RuntimeError("x")
+    assert tracing.current() is None
+    assert reg.snapshot()[tracing.PHASE_METRIC]["samples"][0]["count"] == 1
+
+    tracing.set_enabled(False)
+    try:
+        with tracing.span("off", registry=reg) as s:
+            pass
+        assert s.duration is None  # no timing, no registry write
+    finally:
+        tracing.set_enabled(None)
+    phases = {p["labels"]["span"]
+              for p in reg.snapshot()[tracing.PHASE_METRIC]["samples"]}
+    assert "off" not in phases
+
+
+# ---------------------------------------------------------------------------
+# Integration: fit + concurrent predict burst → one scrape sees it all
+# ---------------------------------------------------------------------------
+F, C = 6, 3
+
+
+def _mlp_model(tmp_path):
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.serialization import write_model
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .updater("adam").shape_bucketing(True).list()
+            .layer(L.DenseLayer(n_in=F, n_out=12, activation="relu"))
+            .layer(L.OutputLayer(n_in=12, n_out=C, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, 16)]
+    net.fit(x, y)
+    net.fit(x, y)
+    path = str(tmp_path / "m.zip")
+    write_model(net, path)
+    return path
+
+
+def test_fit_predict_metrics_rpc_scrape(tmp_path):
+    from deeplearning4j_tpu.server.gateway import DeepLearning4jEntryPoint
+    path = _mlp_model(tmp_path)
+    ep = DeepLearning4jEntryPoint(max_batch=16, max_wait_ms=2.0)
+    try:
+        rng = np.random.default_rng(1)
+
+        def client():
+            for _ in range(10):
+                ep.predict(path, features=rng.normal(
+                    size=(1, F)).astype(np.float32))
+
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        m = ep.metrics()
+        assert m["content_type"].startswith("text/plain; version=0.0.4")
+        fams = exposition.parse_prometheus(m["body"])
+
+        # retrace counts (CompileTelemetry mirror)
+        retraces = _sample_map(fams["dl4j_compile_retraces_total"])
+        assert sum(retraces.values()) >= 1
+        assert any(k == "output" for (_, lbls) in retraces
+                   for (_, k) in lbls)
+        # per-phase step timings from the fit loop
+        phase_counts = {
+            lbls: v for (name, lbls), v
+            in _sample_map(fams["dl4j_phase_seconds"]).items()
+            if name == "dl4j_phase_seconds_count"}
+        fit_phases = {dict(lbls)["phase"] for lbls in phase_counts
+                      if dict(lbls).get("span") == "fit/step"}
+        assert {"jit_call", "block_until_ready", "h2d"} <= fit_phases
+        # batcher latency percentiles (quantile gauge family)
+        q = _sample_map(fams["dl4j_serving_total_seconds_quantile"])
+        assert any(dict(lbls).get("quantile") == "0.95" and v > 0
+                   for (_, lbls), v in q.items())
+        # cache hit/miss counters
+        hits = _sample_map(fams["dl4j_model_cache_hits_total"])
+        assert sum(hits.values()) >= 1
+        assert sum(_sample_map(
+            fams["dl4j_model_cache_misses_total"]).values()) >= 1
+        # serving request counters carry the model label
+        reqs = _sample_map(fams["dl4j_serving_requests_total"])
+        assert any(v >= 40 for v in reqs.values())
+
+        # JSON format returns the raw snapshot
+        snap = ep.metrics(format="json")
+        assert "dl4j_serving_total_seconds" in snap
+        json.dumps(snap)
+        with pytest.raises(ValueError):
+            ep.metrics(format="xml")
+
+        # stats RPC merges cache + batcher + registry (back-compat keys)
+        st = ep.stats()
+        assert {"model_cache", "serving", "registry"} <= set(st)
+        serving = next(iter(st["serving"].values()))
+        assert {"p50_ms", "p95_ms", "p99_ms"} <= set(serving["total_ms"])
+    finally:
+        ep.close()
+
+
+def test_http_get_metrics_scrape(tmp_path):
+    from deeplearning4j_tpu.server.gateway import Server
+    srv = Server().start()
+    try:
+        url = f"http://{srv.host}:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        fams = exposition.parse_prometheus(text)
+        assert "dl4j_gateway_requests_total" in fams
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/nope", timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_stats_listener_perf_memory_from_registry():
+    """UI reports and /metrics agree: StatsListener's perf/memory come
+    from the registry gauges the fit loop set, not a private re-measure."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ui.stats_listener import StatsListener
+    from deeplearning4j_tpu.ui.stats_storage import InMemoryStatsStorage
+
+    st = InMemoryStatsStorage()
+    conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+            .updater("sgd").list()
+            .layer(L.DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(L.OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(st, session_id="mon-sess"))
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(x, y)
+    net.fit(x, y)
+
+    sid = "mon-sess"
+    wid = st.list_worker_ids_for_session(sid)[0]
+    upd = st.get_latest_update(sid, "StatsListener", wid)
+    reg = monitor.get_registry()
+    perf = upd["perf"]
+    assert perf["duration_ms"] == reg.get("dl4j_fit_last_step_ms").value
+    assert perf["samples_per_sec"] == \
+        reg.get("dl4j_fit_examples_per_sec").value
+    assert "host_rss_mb" in upd["memory"]
+    # and the same gauge is visible in a scrape
+    snap = reg.snapshot()
+    assert snap["dl4j_host_rss_mb"]["samples"][0]["value"] > 0
