@@ -263,6 +263,126 @@ def bench_lenet_etl():
     }
 
 
+def bench_pipeline():
+    """Input-pipeline A/B on an ETL-bound workload: the same fit() run
+    sync (pipeline_workers=0), async-1 and async-N.  Each batch's ETL is
+    a simulated storage fetch (latency the workers overlap) plus a
+    GIL-releasing numpy decode — the shape of any real disk/network
+    ingest path.  Reports batches/sec per leg and the registry-measured
+    ``data_wait`` share of wall time, which is the tentpole's claim: the
+    parallel pipeline shrinks the device's wait on ETL."""
+    import jax
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    BATCH, FEAT, BATCHES = 256, 784, 40
+    FETCH_MS = 5.0      # simulated storage latency per batch
+    DECODE_ROUNDS = 3   # numpy elementwise decode passes per batch
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(BATCH, FEAT)).astype(np.float32)
+    labels = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+
+    class EtlBoundIterator(DataSetIterator):
+        """next_raw = shard index (cheap, serial); collate = fetch +
+        decode (expensive, runs on pipeline workers)."""
+
+        def __init__(self):
+            self._i = 0
+
+        def has_next(self):
+            return self._i < BATCHES
+
+        def next_raw(self):
+            i = self._i
+            self._i += 1
+            return i
+
+        def collate(self, i):
+            time.sleep(FETCH_MS / 1e3)          # storage fetch
+            x = base + np.float32(i)
+            for _ in range(DECODE_ROUNDS):      # decode/augment
+                x = np.tanh(x * np.float32(1.0001))
+            return DataSet(x, labels)
+
+        def next(self):
+            return self.collate(self.next_raw())
+
+        def reset(self):
+            self._i = 0
+
+        def batch_size(self):
+            return BATCH
+
+    def make_net(workers):
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("adam").learning_rate(1e-3)
+                .input_pipeline(workers=workers, prefetch=8,
+                                staging_depth=4)
+                .list()
+                .layer(L.DenseLayer(n_in=FEAT, n_out=32,
+                                    activation="relu"))
+                .layer(L.OutputLayer(n_in=32, n_out=10,
+                                     activation="softmax",
+                                     loss="negativeloglikelihood"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def phase_sum(phase):
+        snap = monitor.get_registry().snapshot()
+        fam = snap.get("dl4j_phase_seconds") or {"samples": []}
+        return sum(s.get("sum") or 0.0 for s in fam["samples"]
+                   if s["labels"].get("span") == "fit/step"
+                   and s["labels"].get("phase") == phase)
+
+    n_workers = max(2, min(4, os.cpu_count() or 1))
+    legs = {}
+    for name, workers in (("sync", 0), ("async_1", 1),
+                          (f"async_{n_workers}", n_workers)):
+        net = make_net(workers)
+        warm = EtlBoundIterator()
+        warm._i = BATCHES - 4   # compile off the clock, 4 batches
+        net.fit(warm)
+        it = EtlBoundIterator()
+        walls, shares = [], []
+        for _ in range(3):
+            it.reset()
+            w0 = phase_sum("data_wait")
+            t0 = time.perf_counter()
+            net.fit(it)
+            wall = time.perf_counter() - t0
+            walls.append(wall)
+            shares.append((phase_sum("data_wait") - w0) / max(wall, 1e-9))
+        wall = statistics.median(walls)
+        legs[name] = {
+            "batches_per_sec": round(BATCHES / wall, 2),
+            "wall_sec_median": round(wall, 4),
+            "data_wait_share": round(statistics.median(shares), 4),
+        }
+    sync_rate = legs["sync"]["batches_per_sec"]
+    async_n = legs[f"async_{n_workers}"]
+    speedup_n = async_n["batches_per_sec"] / max(sync_rate, 1e-9)
+    return {
+        "metric": "ETL-bound fit() batches/sec, sync vs async input "
+                  "pipeline",
+        "value": round(speedup_n, 2),
+        "unit": "x (async-N vs sync)",
+        "n_workers": n_workers,
+        "etl_ms_simulated_fetch": FETCH_MS,
+        "speedup_async_1": round(
+            legs["async_1"]["batches_per_sec"] / max(sync_rate, 1e-9), 2),
+        f"speedup_async_{n_workers}": round(speedup_n, 2),
+        "meets_1_5x_target": speedup_n >= 1.5,
+        "data_wait_share_sync": legs["sync"]["data_wait_share"],
+        "data_wait_share_async":
+            async_n["data_wait_share"],
+        **legs,
+    }
+
+
 def bench_lenet_scan(precision="bf16", k_steps=50):
     """Device-bound ceiling through the PRODUCT path:
     ``fit(it, fused_steps=K)`` fuses K train steps into one compiled
@@ -846,7 +966,10 @@ def _start_watchdog(result, deadline_s):
 
     def _watch():
         while True:
-            remaining = _WATCHDOG["deadline"] - time.time()
+            deadline = _WATCHDOG["deadline"]
+            if deadline is None:  # run finished — stand down
+                return
+            remaining = deadline - time.time()
             if remaining <= 0:
                 break
             time.sleep(min(remaining, 15))
@@ -909,6 +1032,7 @@ def main():
         _WATCHDOG["alarm_time"] = time.time() + budget * 2 + 300
         _run_configs(result)
         signal.alarm(0)
+        _WATCHDOG["deadline"] = None  # completed: cancel the force-exit
     except BaseException as e:  # incl. KeyboardInterrupt from a driver kill
         result["fatal_error"] = f"{type(e).__name__}: {e}"[:500]
         log(traceback.format_exc())
@@ -938,16 +1062,24 @@ def _run_configs(result):
     log(f"devices={n_chips} kind={kind!r} is_tpu={platform.is_tpu()} "
         f"bf16_peak={peak}")
 
+    # DL4J_BENCH_DRY_RUN=1: exercise every piece of record/registry
+    # plumbing (backend acquisition, config registration, the final JSON
+    # record with its metrics_registry digest) WITHOUT running a single
+    # bench — the tier-1 smoke test that catches a main()-path crash
+    # (like r03's backend-init death) in pytest instead of the nightly.
+    dry_run = os.environ.get("DL4J_BENCH_DRY_RUN") == "1"
+
     # Compile-check both Pallas kernels BEFORE any config touches them:
     # a Mosaic rejection here downgrades to the dense path (and is
     # recorded) instead of sinking the first config that calls attention
     # or the fused xent (round-3 weak #3: the compiled path had never
     # run on a real chip).
-    from deeplearning4j_tpu.ops import pallas_kernels as pk
-    t0 = time.perf_counter()
-    result["pallas_kernels"] = pk.kernel_self_test()
-    log(f"pallas self-test ({time.perf_counter() - t0:.1f}s): "
-        f"{result['pallas_kernels']}")
+    if not dry_run:
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+        t0 = time.perf_counter()
+        result["pallas_kernels"] = pk.kernel_self_test()
+        log(f"pallas self-test ({time.perf_counter() - t0:.1f}s): "
+            f"{result['pallas_kernels']}")
 
     # Per-run wall-clock budget: the headline (lenet) runs first; if a
     # later config's compile drags past the budget the remaining ones
@@ -963,6 +1095,7 @@ def _run_configs(result):
         ("lenet_etl", bench_lenet_etl),
         ("lenet_f32", lambda: bench_lenet("f32")),
         ("bench_ragged", bench_ragged),
+        ("bench_pipeline", bench_pipeline),
         ("bench_serving", bench_serving),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
@@ -990,12 +1123,16 @@ def _run_configs(result):
         # whole wall-clock budget — run the cheap configs first so a
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
-                 "bench_serving", "charrnn", "word2vec", "vgg16", "resnet50"]
+                 "bench_pipeline", "bench_serving", "charrnn", "word2vec",
+                 "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":
             config_list.insert(2, ("lenet_scan", bench_lenet_scan))
     for name, fn in config_list:
+        if dry_run:
+            configs[name] = {"skipped": "dry-run"}
+            continue
         elapsed = time.perf_counter() - t_start
         if name != "lenet" and elapsed > budget:
             configs[name] = {"skipped": f"time budget ({elapsed:.0f}s "

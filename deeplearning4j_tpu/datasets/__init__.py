@@ -6,7 +6,10 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     DataSetIterator,
     ListDataSetIterator,
     AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
     ExistingDataSetIterator,
+    ListMultiDataSetIterator,
+    MultiDataSetIterator,
     MultipleEpochsIterator,
     SamplingDataSetIterator,
 )

@@ -1,21 +1,40 @@
-"""DataSet iterators, including async device prefetch.
+"""DataSet iterators, including the parallel async input pipeline.
 
 The reference wraps every training iterator in an
 ``AsyncDataSetIterator`` — a background thread filling a BlockingQueue
-(ref: datasets/iterator/AsyncDataSetIterator.java:39-127).  Here the
-async iterator additionally stages host→device transfer so the TPU never
-waits on ETL (the reference's device-affinity prefetch, :108-109).
+(ref: datasets/iterator/AsyncDataSetIterator.java:39-127).  Here that
+design is generalized into a multi-worker ETL pipeline:
+
+    feeder ──▶ task queue ──▶ N workers ──▶ reorder buffer ──▶ consumer
+    (serial raw pull,          (collate → normalize →          (ordered,
+     order = sync iterator)     transform → device_put)         bounded)
+
+The feeder pulls *raw* batches serially (readers are stateful, so this
+is what keeps batch order deterministic and identical to the sync
+iterator); workers run the ETL chain in parallel and stage finished,
+already-``device_put`` batches into an order-preserving reorder buffer
+bounded by ``staging_depth``, so H2D transfer overlaps the jitted step
+and the device never waits on ETL.  Iterators that can split "pull raw
+records" from "assemble arrays" expose ``next_raw()``/``collate()``
+(records/iterators.py does) so the expensive vectorized assembly also
+runs on the workers.
+
+Everything meters into the ``dl4j_pipeline_*`` registry families
+(docs/OBSERVABILITY.md); the consumer-side wait is the fit loops'
+``data_wait`` phase.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
+import weakref
 from typing import Iterator, List, Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
 
 class DataSetIterator:
@@ -155,57 +174,326 @@ class SamplingDataSetIterator(DataSetIterator):
         return self.batch
 
 
+def _pipeline_metrics():
+    """dl4j_pipeline_* instruments (lazy import: datasets must stay
+    importable before the monitor package finishes initializing)."""
+    global _METRICS
+    if _METRICS is None:
+        from deeplearning4j_tpu import monitor
+        reg = monitor.get_registry()
+        _METRICS = {
+            "batches": reg.counter(
+                "dl4j_pipeline_batches_total",
+                "input-pipeline batches by stage "
+                "(produced=raw pull, transformed=ETL done, consumed=handed"
+                " to the training loop)", labels=("stage",)),
+            "queue_depth": reg.gauge(
+                "dl4j_pipeline_queue_depth",
+                "current depth of the pipeline queues "
+                "(task=raw batches awaiting ETL, ready=staged batches "
+                "awaiting the consumer)", labels=("queue",)),
+            "busy": reg.counter(
+                "dl4j_pipeline_worker_busy_seconds_total",
+                "cumulative wall time ETL workers spent transforming"),
+            "staged_bytes": reg.counter(
+                "dl4j_pipeline_staged_bytes_total",
+                "bytes of batches staged through the reorder buffer"),
+            "workers": reg.gauge(
+                "dl4j_pipeline_workers",
+                "worker threads of the most recently started pipeline"),
+        }
+    return _METRICS
+
+
+_METRICS = None
+
+
+def _batch_nbytes(d) -> int:
+    if isinstance(d, MultiDataSet):
+        arrs = list(d.features) + list(d.labels)
+        for ms in (d.features_masks, d.labels_masks):
+            if ms is not None:
+                arrs.extend(ms)
+    elif isinstance(d, DataSet):
+        arrs = [d.features, d.labels, d.features_mask, d.labels_mask]
+    else:
+        arrs = [d]
+    return sum(int(getattr(a, "nbytes", 0) or 0) for a in arrs
+               if a is not None)
+
+
+def _device_put_batch(d):
+    """Stage a DataSet or MultiDataSet onto the default device."""
+    import jax
+    if isinstance(d, MultiDataSet):
+        def put_list(arrs):
+            if arrs is None:
+                return None
+            return [None if a is None else jax.device_put(a) for a in arrs]
+        return MultiDataSet(put_list(d.features), put_list(d.labels),
+                            put_list(d.features_masks),
+                            put_list(d.labels_masks))
+    if isinstance(d, DataSet):
+        return DataSet(jax.device_put(d.features), jax.device_put(d.labels),
+                       None if d.features_mask is None
+                       else jax.device_put(d.features_mask),
+                       None if d.labels_mask is None
+                       else jax.device_put(d.labels_mask))
+    return jax.device_put(d)
+
+
+def _make_etl(collate, normalizer, transform, device_put):
+    """The worker-side ETL chain as a closure over plain values — it
+    must NOT capture the iterator (running threads would pin it and the
+    GC-finalizer shutdown path could never fire)."""
+    def etl(raw):
+        d = collate(raw) if collate is not None else raw
+        if normalizer is not None:
+            d = normalizer.transform(d)
+        if transform is not None:
+            d = transform(d)
+        if device_put:
+            d = _device_put_batch(d)
+        return d
+    return etl
+
+
+class _PipelineRun:
+    """One started generation of the pipeline: feeder + worker threads,
+    the bounded task queue and the order-preserving reorder buffer.
+
+    Holds no reference to the owning iterator: thread targets are bound
+    methods of THIS object, so when the iterator is dropped without
+    close(), its ``weakref.finalize`` can still fire and ``request_stop``
+    unwinds the threads (a producer blocked on a full queue checks the
+    stop event instead of leaking)."""
+
+    def __init__(self, underlying, etl, workers: int, queue_size: int,
+                 staging_depth: int):
+        self.underlying = underlying
+        self.next_raw, _ = _etl_split(underlying)
+        self.etl = etl
+        self.workers = workers
+        self.staging_depth = staging_depth
+        self.stop = threading.Event()
+        self.task_q: queue.Queue = queue.Queue(maxsize=queue_size)
+        self.cond = threading.Condition()
+        self.ready: dict = {}
+        self.ready_high_water = 0
+        self.next_seq = 0
+        self.total: Optional[int] = None
+        self.errors: List[tuple] = []
+        self.live_workers = workers
+        self.threads = [threading.Thread(target=self._feed, daemon=True,
+                                         name="dl4j-pipe-feeder")]
+        self.threads += [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"dl4j-pipe-worker-{i}")
+            for i in range(workers)]
+
+    def start(self):
+        _pipeline_metrics()["workers"].set(self.workers)
+        for t in self.threads:
+            t.start()
+
+    # -- bounded-queue helpers that never block past a stop ------------
+    def _q_put(self, item) -> bool:
+        while not self.stop.is_set():
+            try:
+                self.task_q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _q_get(self):
+        while not self.stop.is_set():
+            try:
+                return self.task_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+        return None
+
+    def _feed(self):
+        m = _pipeline_metrics()
+        seq = 0
+        try:
+            while not self.stop.is_set() and self.underlying.has_next():
+                raw = self.next_raw()
+                if not self._q_put((seq, raw)):
+                    return
+                seq += 1
+                m["batches"].labels(stage="produced").inc()
+                m["queue_depth"].labels(queue="task").set(
+                    self.task_q.qsize())
+        except BaseException as e:  # surfaced on the consumer thread at
+            with self.cond:         # this batch position — a dead feeder
+                self.errors.append((seq, e))  # must not look like EOF
+                self.cond.notify_all()
+        finally:
+            with self.cond:
+                self.total = seq
+                self.cond.notify_all()
+            for _ in range(self.workers):
+                self._q_put(AsyncDataSetIterator._SENTINEL)
+
+    def _work(self):
+        m = _pipeline_metrics()
+        try:
+            while not self.stop.is_set():
+                task = self._q_get()
+                if task is None or task is AsyncDataSetIterator._SENTINEL:
+                    return
+                seq, raw = task
+                m["queue_depth"].labels(queue="task").set(
+                    self.task_q.qsize())
+                t0 = time.perf_counter()
+                try:
+                    item = self.etl(raw)
+                except BaseException as e:
+                    with self.cond:
+                        self.errors.append((seq, e))
+                        self.cond.notify_all()
+                    continue
+                m["busy"].inc(time.perf_counter() - t0)
+                m["batches"].labels(stage="transformed").inc()
+                m["staged_bytes"].inc(_batch_nbytes(item))
+                with self.cond:
+                    # staging bound: at most staging_depth finished
+                    # batches resident ahead of the consumer
+                    while (not self.stop.is_set()
+                           and seq >= self.next_seq + self.staging_depth):
+                        self.cond.wait(0.05)
+                    if self.stop.is_set():
+                        return
+                    self.ready[seq] = item
+                    self.ready_high_water = max(self.ready_high_water,
+                                                len(self.ready))
+                    m["queue_depth"].labels(queue="ready").set(
+                        len(self.ready))
+                    self.cond.notify_all()
+        finally:
+            with self.cond:
+                self.live_workers -= 1
+                self.cond.notify_all()
+
+    def get_next(self):
+        """Block until the next in-order batch is staged.  Returns
+        ``(item, True)`` or ``(None, False)`` at EOF; re-raises a
+        feeder/worker exception at the failed batch's position."""
+        m = _pipeline_metrics()
+        with self.cond:
+            while True:
+                if self.next_seq in self.ready:
+                    item = self.ready.pop(self.next_seq)
+                    self.next_seq += 1
+                    m["queue_depth"].labels(queue="ready").set(
+                        len(self.ready))
+                    m["batches"].labels(stage="consumed").inc()
+                    self.cond.notify_all()
+                    return item, True
+                if self.errors:
+                    err_seq = min(s for s, _ in self.errors)
+                    if err_seq <= self.next_seq:
+                        exc = next(e for s, e in self.errors
+                                   if s == err_seq)
+                        self.stop.set()
+                        self.cond.notify_all()
+                        raise exc
+                if (self.total is not None
+                        and self.next_seq >= self.total
+                        and self.live_workers == 0):
+                    return None, False
+                if self.stop.is_set():  # close() raced us
+                    return None, False
+                self.cond.wait(0.05)
+
+    def request_stop(self):
+        """Signal-only shutdown — safe from a GC finalizer."""
+        self.stop.set()
+        with self.cond:
+            self.cond.notify_all()
+
+    def shutdown(self):
+        self.request_stop()
+        for t in self.threads:
+            t.join(timeout=5)
+        self.threads = []
+
+
+def _etl_split(underlying):
+    """(next_raw, collate) when the underlying iterator supports the
+    raw-pull/assembly split, else (next, None) — the two must pair: raw
+    records without the matching collate are not a batch."""
+    raw = getattr(underlying, "next_raw", None)
+    collate = getattr(underlying, "collate", None)
+    if raw is not None and collate is not None:
+        return raw, collate
+    return underlying.next, None
+
+
 class AsyncDataSetIterator(DataSetIterator):
-    """Background-thread prefetch with a bounded queue
-    (ref: AsyncDataSetIterator.java:39-127 — thread + BlockingQueue + poison
-    sentinel).  `device_put` stages arrays onto the accelerator so the
-    training loop overlaps ETL with compute."""
+    """Multi-worker, order-preserving prefetch pipeline
+    (ref: AsyncDataSetIterator.java:39-127 — generalized from one
+    thread + BlockingQueue to a feeder + N ETL workers + a bounded
+    reorder buffer).
+
+    The feeder pulls raw batches from ``underlying`` serially — batch
+    order out of this iterator is therefore deterministic and exactly
+    matches the sync iterator.  Workers run collate → normalize →
+    transform → ``device_put`` concurrently; finished batches wait in a
+    reorder buffer holding at most ``staging_depth`` device-resident
+    batches ahead of the consumer.  A worker exception surfaces on the
+    consumer thread at the failed batch's position (batches before it
+    are still delivered, in order)."""
 
     _SENTINEL = object()
 
     def __init__(self, underlying: DataSetIterator, queue_size: int = 4,
-                 device_put: bool = False, transform=None):
-        """``transform`` runs on the prefetch thread BEFORE device_put —
+                 device_put: bool = False, transform=None,
+                 workers: int = 1, staging_depth: Optional[int] = None,
+                 normalizer=None):
+        """``transform`` runs on a worker thread BEFORE device_put —
         the shape-bucketing hook (ops/bucketing.py): batches are padded
         up to their bucket off the critical path, so the H2D transfer
-        is already bucket-shaped."""
+        is already bucket-shaped.  ``normalizer`` (datasets/normalizers)
+        is applied before ``transform``.  ``staging_depth`` bounds how
+        many finished (device-resident) batches may sit ahead of the
+        consumer; default = ``queue_size``."""
         self.underlying = underlying
-        self.queue_size = queue_size
+        self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
         self.transform_fn = transform
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
-        self._thread: Optional[threading.Thread] = None
+        self.normalizer = normalizer
+        self.workers = max(1, int(workers))
+        self.staging_depth = (self.queue_size if staging_depth is None
+                              else max(1, int(staging_depth)))
         self._peek = None
         self._exhausted = False
-        self._started = False  # worker starts lazily on first use, so a
+        self._pending_exc: Optional[BaseException] = None
+        self._run: Optional[_PipelineRun] = None
+        self._finalizer = None
+        self._started = False  # threads start lazily on first use, so a
         # reset() right after construction doesn't drain a prefetch pass
 
-    def _transform(self, d):
-        if self.transform_fn is not None:
-            d = self.transform_fn(d)
-        if self.device_put:
-            import jax
-            d = DataSet(jax.device_put(d.features), jax.device_put(d.labels),
-                        None if d.features_mask is None else jax.device_put(d.features_mask),
-                        None if d.labels_mask is None else jax.device_put(d.labels_mask))
-        return d
-
-    def _worker(self):
-        try:
-            while self.underlying.has_next():
-                self._queue.put(self._transform(self.underlying.next()))
-        except BaseException as e:  # re-raised on the consumer thread —
-            self._worker_exc = e    # a dead worker must not look like EOF
-        finally:
-            self._queue.put(self._SENTINEL)
-
+    # -- consumer side ---------------------------------------------------
     def _start(self):
         self._exhausted = False
         self._peek = None
+        self._pending_exc = None
         self._started = True
-        self._queue = queue.Queue(maxsize=self.queue_size)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        etl = _make_etl(_etl_split(self.underlying)[1],
+                        self.normalizer, self.transform_fn,
+                        self.device_put)
+        self._run = _PipelineRun(self.underlying, etl, self.workers,
+                                 self.queue_size, self.staging_depth)
+        # GC safety net: a dropped-without-close() iterator must not
+        # leak its threads.  The run holds no reference back to self,
+        # so collection of self is possible while threads still spin —
+        # the finalizer stops them.
+        self._finalizer = weakref.finalize(self, _PipelineRun.request_stop,
+                                           self._run)
+        self._run.start()
         self._advance()
 
     def _ensure_started(self):
@@ -216,37 +504,72 @@ class AsyncDataSetIterator(DataSetIterator):
         if self._exhausted:
             self._peek = None
             return
-        item = self._queue.get()
-        if item is self._SENTINEL:
+        try:
+            self._peek, ok = self._run.get_next()
+        except BaseException as e:
+            # deferred: every batch staged BEFORE the failure is still
+            # delivered in order; the exception surfaces on the consumer
+            # right after the last good batch
             self._exhausted = True
             self._peek = None
-            exc = getattr(self, "_worker_exc", None)
-            if exc is not None:
-                self._worker_exc = None
-                raise exc
-        else:
-            self._peek = item
+            self._pending_exc = e
+            return
+        if not ok:
+            self._exhausted = True
+
+    def _raise_pending(self):
+        e = self._pending_exc
+        if e is not None:
+            self._pending_exc = None
+            raise e
 
     def next(self):
         self._ensure_started()
+        if self._peek is None:
+            self._raise_pending()
         d = self._peek
         self._advance()
         return d
 
     def has_next(self):
         self._ensure_started()
-        return self._peek is not None
+        if self._peek is not None:
+            return True
+        self._raise_pending()
+        return False
+
+    @property
+    def staging_high_water(self) -> int:
+        """Max finished batches ever resident in the reorder buffer
+        (bounded by ``staging_depth``); survives close()."""
+        if self._run is not None:
+            return self._run.ready_high_water
+        return getattr(self, "_last_high_water", 0)
+
+    def close(self):
+        """Stop feeder + workers and release the queues.  Idempotent;
+        safe to call mid-stream (a producer blocked on a full queue sees
+        the stop event instead of leaking).  The iterator restarts
+        lazily on next use from wherever ``underlying`` stands."""
+        if not self._started:
+            return
+        if self._finalizer is not None:
+            self._finalizer.detach()
+            self._finalizer = None
+        if self._run is not None:
+            self._last_high_water = self._run.ready_high_water
+            self._run.shutdown()
+            self._run = None
+        self._started = False
+        self._peek = None
+        self._exhausted = False
+        self._pending_exc = None
 
     def reset(self):
         if not self._started:
             return
-        if self._thread is not None and self._thread.is_alive():
-            # Drain so the worker can exit.
-            while not self._exhausted:
-                self._advance()
-            self._thread.join(timeout=5)
+        self.close()
         self.underlying.reset()
-        self._started = False
 
     def batch_size(self):
         return self.underlying.batch_size()
@@ -291,18 +614,19 @@ class ListMultiDataSetIterator(MultiDataSetIterator):
 
 
 class AsyncMultiDataSetIterator(AsyncDataSetIterator):
-    """Background-prefetch wrapper for MultiDataSet iterators
+    """Multi-worker prefetch wrapper for MultiDataSet iterators
     (ref: datasets/iterator/AsyncMultiDataSetIterator.java).  Shares the
-    whole thread/queue/sentinel machinery with AsyncDataSetIterator —
-    only the item transform differs (MultiDataSets pass through)."""
+    whole feeder/worker/reorder machinery with AsyncDataSetIterator —
+    only the device staging differs (every array in the features/labels
+    lists moves, None masks pass through)."""
 
     def __init__(self, underlying: MultiDataSetIterator,
-                 queue_size: int = 4, transform=None):
+                 queue_size: int = 4, transform=None,
+                 device_put: bool = False, workers: int = 1,
+                 staging_depth: Optional[int] = None):
         super().__init__(underlying, queue_size=queue_size,
-                         device_put=False, transform=transform)
-
-    def _transform(self, d):
-        return d if self.transform_fn is None else self.transform_fn(d)
+                         device_put=device_put, transform=transform,
+                         workers=workers, staging_depth=staging_depth)
 
     def batch_size(self):  # MultiDataSet iterators need not expose this
         fn = getattr(self.underlying, "batch_size", None)

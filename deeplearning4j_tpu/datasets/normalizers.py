@@ -19,8 +19,22 @@ def register_normalizer(cls):
     return cls
 
 
+def _iter_batches(data):
+    """Yield flattened-2D float feature matrices from a DataSet or any
+    DataSetIterator-shaped object, without materializing the epoch."""
+    if isinstance(data, DataSet):
+        yield data.features.reshape(data.features.shape[0], -1)
+        return
+    data.reset()
+    while data.has_next():
+        d = data.next()
+        yield np.asarray(d.features).reshape(d.features.shape[0], -1)
+
+
 class Normalizer:
-    def fit(self, dataset: DataSet) -> "Normalizer":
+    def fit(self, dataset) -> "Normalizer":
+        """Accepts a DataSet or a DataSetIterator; iterator fitting is
+        single-pass whole-batch accumulation (no per-row work)."""
         raise NotImplementedError
 
     def transform(self, dataset: DataSet) -> DataSet:
@@ -48,9 +62,35 @@ class NormalizerStandardize(Normalizer):
         self.std: Optional[np.ndarray] = None
 
     def fit(self, dataset):
-        f = dataset.features.reshape(dataset.features.shape[0], -1)
-        self.mean = f.mean(axis=0)
-        self.std = f.std(axis=0) + 1e-8
+        if isinstance(dataset, DataSet):
+            f = dataset.features.reshape(dataset.features.shape[0], -1)
+            self.mean = f.mean(axis=0)
+            self.std = f.std(axis=0) + 1e-8
+            return self
+        # Iterator: single-pass parallel-variance merge (Chan et al.) —
+        # per batch one vectorized mean/M2, merged into running stats;
+        # same population mean/std as concatenating the whole epoch.
+        n = 0
+        mean = m2 = None
+        for f in _iter_batches(dataset):
+            f = f.astype(np.float64, copy=False)
+            bn = f.shape[0]
+            if bn == 0:
+                continue
+            bmean = f.mean(axis=0)
+            bm2 = ((f - bmean) ** 2).sum(axis=0)
+            if mean is None:
+                n, mean, m2 = bn, bmean, bm2
+            else:
+                delta = bmean - mean
+                tot = n + bn
+                mean = mean + delta * (bn / tot)
+                m2 = m2 + bm2 + delta * delta * (n * bn / tot)
+                n = tot
+        if mean is None:
+            raise ValueError("fit on an empty iterator")
+        self.mean = mean.astype(np.float32)
+        self.std = (np.sqrt(m2 / n) + 1e-8).astype(np.float32)
         return self
 
     def transform_features(self, x):
@@ -85,9 +125,21 @@ class NormalizerMinMaxScaler(Normalizer):
         self.max: Optional[np.ndarray] = None
 
     def fit(self, dataset):
-        f = dataset.features.reshape(dataset.features.shape[0], -1)
-        self.min = f.min(axis=0)
-        self.max = f.max(axis=0)
+        if isinstance(dataset, DataSet):
+            f = dataset.features.reshape(dataset.features.shape[0], -1)
+            self.min = f.min(axis=0)
+            self.max = f.max(axis=0)
+            return self
+        lo = hi = None  # iterator: running elementwise min/max per batch
+        for f in _iter_batches(dataset):
+            if f.shape[0] == 0:
+                continue
+            bmin, bmax = f.min(axis=0), f.max(axis=0)
+            lo = bmin if lo is None else np.minimum(lo, bmin)
+            hi = bmax if hi is None else np.maximum(hi, bmax)
+        if lo is None:
+            raise ValueError("fit on an empty iterator")
+        self.min, self.max = lo, hi
         return self
 
     def transform_features(self, x):

@@ -71,6 +71,14 @@ class GlobalConf:
     shape_bucketing: bool = False
     bucket_batch_sizes: Optional[List[int]] = None
     bucket_time_sizes: Optional[List[int]] = None
+    # Input pipeline (datasets/iterators.AsyncDataSetIterator): number of
+    # parallel ETL worker threads the fit loops wrap iterators with
+    # (0 = synchronous, no wrapper), raw-batch prefetch queue depth, and
+    # how many already-device_put batches may be staged ahead of the
+    # consumer (None = prefetch depth).  See docs/PERFORMANCE.md.
+    pipeline_workers: int = 1
+    pipeline_prefetch: int = 4
+    pipeline_staging_depth: Optional[int] = None
 
 
 _MERGE_FIELDS = [
@@ -273,6 +281,21 @@ class Builder:
             self._g.bucket_batch_sizes = [int(s) for s in batch_sizes]
         if time_sizes is not None:
             self._g.bucket_time_sizes = [int(s) for s in time_sizes]
+        return self
+
+    def input_pipeline(self, workers: Optional[int] = None,
+                       prefetch: Optional[int] = None,
+                       staging_depth: Optional[int] = None):
+        """Tune the async input pipeline the fit loops wrap iterators
+        with: ``workers`` parallel ETL threads (0 disables the wrapper),
+        ``prefetch`` raw batches queued ahead, ``staging_depth`` device-
+        resident batches staged ahead of the consumer."""
+        if workers is not None:
+            self._g.pipeline_workers = int(workers)
+        if prefetch is not None:
+            self._g.pipeline_prefetch = int(prefetch)
+        if staging_depth is not None:
+            self._g.pipeline_staging_depth = int(staging_depth)
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

@@ -349,28 +349,59 @@ class ComputationGraph:
                     epoch_hook("on_epoch_end")
                     self.epoch += 1
             return self
-        # iterator of DataSet or MultiDataSet
-        with monitor.profile_if_configured("fit"):
-            for _ in range(epochs):
-                epoch_hook("on_epoch_start")
-                data.reset()
-                pending = []
-                for item in data:
-                    if isinstance(item, DataSet):
-                        item = MultiDataSet(
-                            [item.features], [item.labels],
-                            [item.features_mask], [item.labels_mask])
-                    if fuse > 1:
-                        pending.append(item)
-                        if len(pending) == fuse:
-                            self._fit_fused_group(pending)
-                            pending = []
-                    else:
+        # iterator of DataSet or MultiDataSet — wrapped in the parallel
+        # input pipeline so ETL + H2D overlap the jitted step (the MLN
+        # fit path's AsyncDataSetIterator, multi-head flavored)
+        from deeplearning4j_tpu.datasets.iterators import (
+            AsyncDataSetIterator, AsyncMultiDataSetIterator)
+        it = data
+        g = self.conf.global_conf
+        if (g.pipeline_workers > 0
+                and not isinstance(it, AsyncDataSetIterator)
+                and getattr(it, "async_supported", lambda: True)()):
+            bucket_on = self._bucket_train_enabled()
+            gg = self.conf.global_conf
+
+            def to_mds(item):
+                if isinstance(item, DataSet):
+                    item = MultiDataSet(
+                        [item.features], [item.labels],
+                        [item.features_mask], [item.labels_mask])
+                if bucket_on:  # pad on the worker, off the critical path
+                    item = bucketing.bucket_train_multidataset(item, gg)[0]
+                return item
+            it = AsyncMultiDataSetIterator(
+                it, queue_size=g.pipeline_prefetch,
+                workers=g.pipeline_workers,
+                staging_depth=g.pipeline_staging_depth,
+                device_put=True, transform=to_mds)
+        try:
+            with monitor.profile_if_configured("fit"):
+                for _ in range(epochs):
+                    epoch_hook("on_epoch_start")
+                    it.reset()
+                    pending = []
+                    while it.has_next():
+                        with monitor.span("fit/step", phase="data_wait"):
+                            item = it.next()
+                        if isinstance(item, DataSet):
+                            item = MultiDataSet(
+                                [item.features], [item.labels],
+                                [item.features_mask], [item.labels_mask])
+                        if fuse > 1:
+                            pending.append(item)
+                            if len(pending) == fuse:
+                                self._fit_fused_group(pending)
+                                pending = []
+                        else:
+                            self._fit_batch(item)
+                    for item in pending:
                         self._fit_batch(item)
-                for item in pending:
-                    self._fit_batch(item)
-                epoch_hook("on_epoch_end")
-                self.epoch += 1
+                    epoch_hook("on_epoch_end")
+                    self.epoch += 1
+        finally:
+            if isinstance(it, AsyncDataSetIterator):
+                it.close()
         return self
 
     def _build_fused_step(self, k: int):

@@ -431,17 +431,22 @@ class MultiLayerNetwork:
             self._step_fn = self._build_step()
 
         it = data
-        if it.async_supported() and not isinstance(it, AsyncDataSetIterator):
+        g = self.conf.global_conf
+        if (g.pipeline_workers > 0 and it.async_supported()
+                and not isinstance(it, AsyncDataSetIterator)):
             transform = None
             if self._bucket_train_enabled():
                 gg = self.conf.global_conf
-                # bucket on the prefetch thread, BEFORE device_put: the
+                # bucket on a worker thread, BEFORE device_put: the
                 # H2D transfer is then already bucket-shaped and the
                 # engine's own bucketing hits its no-op fast path
                 transform = lambda d: bucketing.bucket_train_dataset(  # noqa: E731
                     d, gg)[0]
-            it = AsyncDataSetIterator(it, device_put=True,
-                                      transform=transform)
+            it = AsyncDataSetIterator(
+                it, queue_size=g.pipeline_prefetch,
+                workers=g.pipeline_workers,
+                staging_depth=g.pipeline_staging_depth,
+                device_put=True, transform=transform)
 
         # fused path steps the updater once per batch; a conf with
         # iterations>1 (multiple updates per batch) keeps exact
@@ -449,33 +454,40 @@ class MultiLayerNetwork:
         fuse = (max(1, int(fused_steps))
                 if (self.conf.backprop_type != "truncatedbptt"
                     and self.conf.global_conf.iterations <= 1) else 1)
-        with monitor.profile_if_configured("fit"):
-            for _ in range(epochs):
-                for lst in self.listeners:
-                    if isinstance(lst, TrainingListener):
-                        lst.on_epoch_start(self)
-                it.reset()
-                t_etl = time.perf_counter()
-                pending = []
-                while it.has_next():
-                    with monitor.span("fit/step", phase="data_wait"):
-                        ds = it.next()
-                    self.last_etl_time_ms = \
-                        (time.perf_counter() - t_etl) * 1e3
-                    if fuse > 1:
-                        pending.append(ds)
-                        if len(pending) == fuse:
-                            self._fit_fused_group(pending)
-                            pending = []
-                    else:
-                        self._fit_batch(ds)
+        try:
+            with monitor.profile_if_configured("fit"):
+                for _ in range(epochs):
+                    for lst in self.listeners:
+                        if isinstance(lst, TrainingListener):
+                            lst.on_epoch_start(self)
+                    it.reset()
                     t_etl = time.perf_counter()
-                for ds in pending:  # ragged tail: per-step path
-                    self._fit_batch(ds)
-                for lst in self.listeners:
-                    if isinstance(lst, TrainingListener):
-                        lst.on_epoch_end(self)
-                self.epoch += 1
+                    pending = []
+                    while it.has_next():
+                        with monitor.span("fit/step", phase="data_wait"):
+                            ds = it.next()
+                        self.last_etl_time_ms = \
+                            (time.perf_counter() - t_etl) * 1e3
+                        if fuse > 1:
+                            pending.append(ds)
+                            if len(pending) == fuse:
+                                self._fit_fused_group(pending)
+                                pending = []
+                        else:
+                            self._fit_batch(ds)
+                        t_etl = time.perf_counter()
+                    for ds in pending:  # ragged tail: per-step path
+                        self._fit_batch(ds)
+                    for lst in self.listeners:
+                        if isinstance(lst, TrainingListener):
+                            lst.on_epoch_end(self)
+                    self.epoch += 1
+        finally:
+            # release pipeline threads — a producer blocked on a full
+            # queue mid-exception would otherwise leak (close() is
+            # idempotent and the iterator restarts lazily if reused)
+            if isinstance(it, AsyncDataSetIterator):
+                it.close()
         return self
 
     def _build_fused_step(self, k: int):

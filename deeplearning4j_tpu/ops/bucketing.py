@@ -305,15 +305,33 @@ def bucket_train_multidataset(mds, g, min_multiple: int = 1,
         nb = -(-nb // min_multiple) * min_multiple
 
     def t_of(a):
-        return int(a.shape[1]) if a.ndim == 3 else None
+        shape = getattr(a, "shape", None)
+        if shape is None:
+            shape = np.asarray(a).shape
+        return int(shape[1]) if len(shape) == 3 else None
 
-    f_ts = [t_of(np.asarray(f)) for f in mds.features]
+    f_ts = [t_of(f) for f in mds.features]
     f_tbs = [None if t is None else bucket_size(t, g.bucket_time_sizes)
              for t in f_ts]
     bucket = (nb, tuple(f_tbs))
 
     fm_list = list(fms) if fms is not None else [None] * len(mds.features)
     lm_list = list(lms) if lms is not None else [None] * len(mds.labels)
+
+    # Idempotence fast path (mirrors bucket_train_dataset): a batch that
+    # is already bucket-shaped with all masks in place passes through
+    # untouched — the async pipeline pre-buckets on a worker BEFORE
+    # device_put, and the engine's re-bucket must not pull the staged
+    # arrays back to host.
+    if nb == n and all(tb is None or tb == t
+                       for t, tb in zip(f_ts, f_tbs)) \
+            and all(m is not None for m in lm_list) \
+            and all(t is None or m is not None
+                    for t, m in zip(f_ts, fm_list)) \
+            and all(t_of(y) is None
+                    or bucket_size(t_of(y), g.bucket_time_sizes) == t_of(y)
+                    for y in mds.labels):
+        return mds, bucket
 
     def pad_entry(a, tb):
         a_p = cycle_rows(a, nb)

@@ -302,27 +302,30 @@ class ParallelWrapper:
             self._place()
         it = AsyncDataSetIterator(iterator, queue_size=self.prefetch_buffer)
         fuse = self.fused_steps
-        for _ in range(epochs):
-            it.reset()
-            pending = []
-            while it.has_next():
-                norm = self._normalize_batch(it.next(), is_graph)
-                if norm is None:
-                    continue
-                if fuse > 1:
-                    if pending and self._batch_sig(pending[0][0]) != \
-                            self._batch_sig(norm[0]):
-                        for b, n in pending:   # mixed shapes: per-step
-                            self._run_sharded_step(b, n)
-                        pending = []
-                    pending.append(norm)
-                    if len(pending) == fuse:
-                        self._run_fused_group(pending)
-                        pending = []
-                else:
-                    self._run_sharded_step(*norm)
-            for b, n in pending:
-                self._run_sharded_step(b, n)
+        try:
+            for _ in range(epochs):
+                it.reset()
+                pending = []
+                while it.has_next():
+                    norm = self._normalize_batch(it.next(), is_graph)
+                    if norm is None:
+                        continue
+                    if fuse > 1:
+                        if pending and self._batch_sig(pending[0][0]) != \
+                                self._batch_sig(norm[0]):
+                            for b, n in pending:   # mixed shapes: per-step
+                                self._run_sharded_step(b, n)
+                            pending = []
+                        pending.append(norm)
+                        if len(pending) == fuse:
+                            self._run_fused_group(pending)
+                            pending = []
+                    else:
+                        self._run_sharded_step(*norm)
+                for b, n in pending:
+                    self._run_sharded_step(b, n)
+        finally:
+            it.close()  # a producer blocked on a full queue must not leak
         return m
 
     # ------------------------------------------------------------------

@@ -16,10 +16,22 @@ from deeplearning4j_tpu.records.readers import (
     RecordReader, SequenceRecordReader)
 
 
+def _one_hot(indices: np.ndarray, n_classes: int) -> np.ndarray:
+    """Whole-batch one-hot: one fancy-indexed assignment, no per-row
+    Python (arxiv 1912.05234's point: batch-level array code is where
+    framework throughput lives)."""
+    idx = np.asarray(indices, np.float32).astype(np.int64).reshape(-1)
+    y = np.zeros((idx.shape[0], n_classes), np.float32)
+    y[np.arange(idx.shape[0]), idx] = 1.0
+    return y
+
+
 def _record_to_arrays(rec, label_index: Optional[int], n_labels: int,
                       regression: bool) -> Tuple[np.ndarray, np.ndarray]:
     """Split one record into (features, labels) following the reference's
-    labelIndex semantics; image records carry ndarray features."""
+    labelIndex semantics; image records carry ndarray features.  Kept as
+    the per-row fallback for object records — the steady-state batch
+    path is the vectorized ``collate``."""
     if label_index is None:
         feats = rec
         label = None
@@ -43,7 +55,11 @@ def _record_to_arrays(rec, label_index: Optional[int], n_labels: int,
 
 class RecordReaderDataSetIterator(DataSetIterator):
     """(ref: RecordReaderDataSetIterator.java:54 — batchSize,
-    labelIndex, numPossibleLabels, regression)"""
+    labelIndex, numPossibleLabels, regression)
+
+    ``next_raw()``/``collate()`` split the serial record pull from the
+    vectorized batch assembly so AsyncDataSetIterator's workers can run
+    the assembly in parallel while order stays deterministic."""
 
     def __init__(self, reader: RecordReader, batch_size: int,
                  label_index: Optional[int] = -1,
@@ -58,16 +74,52 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def has_next(self) -> bool:
         return self.reader.has_next()
 
+    def next_raw(self) -> List[list]:
+        recs = []
+        while self.reader.has_next() and len(recs) < self.batch_size:
+            recs.append(self.reader.next_record())
+        return recs
+
+    def collate(self, recs: List[list]) -> DataSet:
+        n = len(recs)
+        li = self.label_index
+        li_n = None if li is None else \
+            (li if li >= 0 else len(recs[0]) + li)
+        feats0 = recs[0] if li is None else \
+            recs[0][:li_n] + recs[0][li_n + 1:]
+        if len(feats0) == 1 and isinstance(feats0[0], np.ndarray):
+            # image records: ndarray features + scalar label column
+            x = np.stack([(r[:li_n] + r[li_n + 1:])[0] if li is not None
+                          else r[0] for r in recs]).astype(np.float32)
+            labels = None if li is None else \
+                np.asarray([float(r[li_n]) for r in recs], np.float32)
+        else:
+            try:
+                # whole-batch parse: numpy converts a list of number- or
+                # string-valued rows in one C-loop pass
+                arr = np.asarray(recs, dtype=np.float32)
+                if arr.ndim != 2:
+                    raise ValueError("ragged records")
+            except (TypeError, ValueError):
+                fs, ys = zip(*(_record_to_arrays(
+                    r, li, self.num_possible_labels, self.regression)
+                    for r in recs))
+                return DataSet(np.stack(fs), np.stack(ys))
+            if li is None:
+                x, labels = arr, None
+            else:
+                x = np.delete(arr, li_n, axis=1)
+                labels = arr[:, li_n]
+        if labels is None:
+            y = np.zeros((n, 0), np.float32)
+        elif self.regression:
+            y = np.asarray(labels, np.float32).reshape(n, 1)
+        else:
+            y = _one_hot(labels, self.num_possible_labels)
+        return DataSet(x, y)
+
     def next(self) -> DataSet:
-        fs, ys = [], []
-        while self.reader.has_next() and len(fs) < self.batch_size:
-            f, y = _record_to_arrays(self.reader.next_record(),
-                                     self.label_index,
-                                     self.num_possible_labels,
-                                     self.regression)
-            fs.append(f)
-            ys.append(y)
-        return DataSet(np.stack(fs), np.stack(ys))
+        return self.collate(self.next_raw())
 
     def reset(self) -> None:
         self.reader.reset()
@@ -99,40 +151,45 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
     def has_next(self) -> bool:
         return self.freader.has_next()
 
-    def _one(self):
-        fseq = self.freader.next_sequence()
-        if self.lreader is not None:
-            lseq = self.lreader.next_sequence()
-            f = np.asarray([[float(v) for v in r] for r in fseq], np.float32)
+    def _one(self, fseq, lseq):
+        """One (features, labels) sequence pair as arrays — whole-sequence
+        numpy parse + batched one-hot, no per-timestep Python."""
+        if lseq is not None:
+            f = np.asarray(fseq, np.float32)
             if self.regression:
-                y = np.asarray([[float(v) for v in r] for r in lseq],
-                               np.float32)
+                y = np.asarray(lseq, np.float32)
             else:
-                y = np.zeros((len(lseq), self.num_possible_labels),
+                lab = np.asarray(lseq, np.float32).astype(np.int64)[:, 0]
+                y = np.zeros((lab.shape[0], self.num_possible_labels),
                              np.float32)
-                for t, r in enumerate(lseq):
-                    y[t, int(r[0])] = 1.0
+                y[np.arange(lab.shape[0]), lab] = 1.0
             return f, y
         # same reader carries features + per-step label column
-        feats, labels = [], []
-        for r in fseq:
-            li = (self.label_index if self.label_index >= 0
-                  else len(r) + self.label_index)
-            feats.append([float(v) for i, v in enumerate(r) if i != li])
-            labels.append(r[li])
-        f = np.asarray(feats, np.float32)
+        arr = np.asarray(fseq, np.float32)
+        li = (self.label_index if self.label_index >= 0
+              else arr.shape[1] + self.label_index)
+        f = np.delete(arr, li, axis=1)
+        labels = arr[:, li]
         if self.regression:
-            y = np.asarray(labels, np.float32)[:, None]
+            y = labels[:, None]
         else:
-            y = np.zeros((len(labels), self.num_possible_labels), np.float32)
-            for t, lab in enumerate(labels):
-                y[t, int(lab)] = 1.0
+            lab = labels.astype(np.int64)
+            y = np.zeros((lab.shape[0], self.num_possible_labels),
+                         np.float32)
+            y[np.arange(lab.shape[0]), lab] = 1.0
         return f, y
 
-    def next(self) -> DataSet:
-        seqs = []
-        while self.freader.has_next() and len(seqs) < self.batch_size:
-            seqs.append(self._one())
+    def next_raw(self) -> List[tuple]:
+        raw = []
+        while self.freader.has_next() and len(raw) < self.batch_size:
+            fseq = self.freader.next_sequence()
+            lseq = (self.lreader.next_sequence()
+                    if self.lreader is not None else None)
+            raw.append((fseq, lseq))
+        return raw
+
+    def collate(self, raw: List[tuple]) -> DataSet:
+        seqs = [self._one(fs, ls) for fs, ls in raw]
         T = max(f.shape[0] for f, _ in seqs)
         align_end = self.alignment == self.ALIGN_END
         Tl = T if align_end else max(y.shape[0] for _, y in seqs)
@@ -158,6 +215,9 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         pad_free = fm.all() and lm.all()
         return DataSet(x, y, None if pad_free else fm,
                        None if pad_free else lm)
+
+    def next(self) -> DataSet:
+        return self.collate(self.next_raw())
 
     def reset(self) -> None:
         self.freader.reset()
@@ -206,30 +266,38 @@ class RecordReaderMultiDataSetIterator:
     def has_next(self) -> bool:
         return all(r.has_next() for r in self.b.readers.values())
 
-    def next(self) -> MultiDataSet:
+    def next_raw(self) -> List[Dict[str, list]]:
         rows: List[Dict[str, list]] = []
         while self.has_next() and len(rows) < self.b.batch_size:
             rows.append({n: r.next_record()
                          for n, r in self.b.readers.items()})
-        ins = []
-        for name, c0, c1 in self.b.inputs:
-            vals = [[float(v) for v in
-                     (row[name][c0:c1] if c0 is not None else row[name])]
-                    for row in rows]
-            ins.append(np.asarray(vals, np.float32))
+        return rows
+
+    def collate(self, rows: List[Dict[str, list]]) -> MultiDataSet:
+        n = len(rows)
+        mats: Dict[str, np.ndarray] = {}
+
+        def mat(name):  # each reader's batch parses once, then slices
+            if name not in mats:
+                mats[name] = np.asarray([row[name] for row in rows],
+                                        np.float32)
+            return mats[name]
+
+        ins = [np.ascontiguousarray(mat(name)[:, c0:c1]) if c0 is not None
+               else mat(name) for name, c0, c1 in self.b.inputs]
         outs = []
         for name, a, b, is_range in self.b.outputs:
             if is_range:
-                vals = [[float(v) for v in
-                         (row[name][a:b] if a is not None else row[name])]
-                        for row in rows]
-                outs.append(np.asarray(vals, np.float32))
+                outs.append(np.ascontiguousarray(mat(name)[:, a:b])
+                            if a is not None else mat(name))
             else:
-                y = np.zeros((len(rows), b), np.float32)
-                for i, row in enumerate(rows):
-                    y[i, int(row[name][a])] = 1.0
+                y = np.zeros((n, b), np.float32)
+                y[np.arange(n), mat(name)[:, a].astype(np.int64)] = 1.0
                 outs.append(y)
         return MultiDataSet(ins, outs)
+
+    def next(self) -> MultiDataSet:
+        return self.collate(self.next_raw())
 
     def reset(self) -> None:
         for r in self.b.readers.values():
