@@ -26,6 +26,7 @@ Everything meters into the ``dl4j_pipeline_*`` registry families
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -33,6 +34,8 @@ import weakref
 from typing import Iterator, List, Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 
@@ -418,6 +421,18 @@ class _PipelineRun:
         self.request_stop()
         for t in self.threads:
             t.join(timeout=5)
+        # A thread still alive here is mid-flight in user ETL or
+        # next_raw (every queue wait checks `stop`).  Block until it
+        # drains: callers touch the shared stateful reader right after
+        # shutdown(), and a feeder still inside next_raw would mutate
+        # it concurrently.
+        stuck = [t for t in self.threads if t.is_alive()]
+        if stuck:
+            log.warning(
+                "pipeline shutdown: %d thread(s) still in ETL after 5s; "
+                "waiting for in-flight work to finish", len(stuck))
+            for t in stuck:
+                t.join()
         self.threads = []
 
 
@@ -566,9 +581,12 @@ class AsyncDataSetIterator(DataSetIterator):
         self._pending_exc = None
 
     def reset(self):
-        if not self._started:
-            return
-        self.close()
+        # Rewind the underlying iterator even when the pipeline never
+        # started: threads haven't spun up, but the caller may hand us a
+        # partially-consumed iterator (e.g. one a Normalizer.fit just
+        # drained) and expects reset() to mean "epoch starts from 0".
+        if self._started:
+            self.close()
         self.underlying.reset()
 
     def batch_size(self):

@@ -29,6 +29,9 @@ def _iter_batches(data):
     while data.has_next():
         d = data.next()
         yield np.asarray(d.features).reshape(d.features.shape[0], -1)
+    # leave the iterator rewound: fit(iterator) then fit-the-model on
+    # the same iterator must not silently see an exhausted epoch
+    data.reset()
 
 
 class Normalizer:

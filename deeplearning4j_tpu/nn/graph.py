@@ -375,15 +375,28 @@ class ComputationGraph:
                 workers=g.pipeline_workers,
                 staging_depth=g.pipeline_staging_depth,
                 device_put=True, transform=to_mds)
+        # MultiDataSetIterator protocol when available; plain
+        # __iter__-only iterables (duck-typed inputs) still work
+        has_protocol = (callable(getattr(it, "has_next", None))
+                        and callable(getattr(it, "next", None)))
+
+        def batches():
+            if has_protocol:
+                while it.has_next():
+                    with monitor.span("fit/step", phase="data_wait"):
+                        item = it.next()
+                    yield item
+            else:
+                yield from it
+
         try:
             with monitor.profile_if_configured("fit"):
                 for _ in range(epochs):
                     epoch_hook("on_epoch_start")
-                    it.reset()
+                    if callable(getattr(it, "reset", None)):
+                        it.reset()
                     pending = []
-                    while it.has_next():
-                        with monitor.span("fit/step", phase="data_wait"):
-                            item = it.next()
+                    for item in batches():
                         if isinstance(item, DataSet):
                             item = MultiDataSet(
                                 [item.features], [item.labels],

@@ -392,6 +392,70 @@ def test_normalizer_runs_on_pipeline_worker():
         rtol=1e-6)
 
 
+def test_normalizer_fit_leaves_iterator_rewound():
+    batches = _batches(n=5)
+    it = ListDataSetIterator(list(batches))
+    NormalizerStandardize().fit(it)
+    assert it.has_next()
+    assert len(_drain(it)) == len(batches)
+
+
+def test_unstarted_reset_rewinds_underlying():
+    # reset() before the pipeline ever starts must still rewind a
+    # partially-consumed underlying iterator (epoch 1 would otherwise
+    # silently train 0 batches)
+    batches = _batches(n=5)
+    inner = ListDataSetIterator(list(batches))
+    _drain(inner)  # exhaust, e.g. by a prior Normalizer.fit
+    it = AsyncDataSetIterator(inner, workers=2)
+    it.reset()
+    got = _drain(it)
+    it.close()
+    assert [int(d.features[0, 0]) for d in got] == list(range(len(batches)))
+
+
+def test_fit_trains_epoch1_after_normalizer_fit_on_same_iterator():
+    batches = _batches(n=4)
+    it = ListDataSetIterator(list(batches))
+    NormalizerStandardize().fit(it)
+    net = _net(workers=2)
+    before = float(net.score(batches[0]))
+    net.fit(it, epochs=1)
+    assert float(net.score(batches[0])) != before
+
+
+def test_cg_fit_accepts_plain_iterable():
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.conf.network import GlobalConf
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    class PlainIterable:  # only __iter__/reset, no has_next/next
+        def __init__(self, items):
+            self._items = items
+
+        def __iter__(self):
+            return iter(self._items)
+
+        def reset(self):
+            pass
+
+    batches = _batches(n=3)
+    mds = [MultiDataSet([d.features], [d.labels], [None], [None])
+           for d in batches]
+    g = GlobalConf(seed=7, learning_rate=0.05, updater="adam",
+                   pipeline_workers=0)
+    conf = (GraphBuilder(g).add_inputs("in")
+            .add_layer("d", L.DenseLayer(n_in=4, n_out=8,
+                                         activation="relu"), "in")
+            .add_layer("out", L.OutputLayer(n_in=8, n_out=3,
+                                            activation="softmax",
+                                            loss="mcxent"), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    net.fit(PlainIterable(mds), epochs=2)
+    assert np.isfinite(float(np.asarray(net._score)))
+
+
 # ---------------------------------------------------------------------------
 # Conf plumbing + bench smoke
 # ---------------------------------------------------------------------------
