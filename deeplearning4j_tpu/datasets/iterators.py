@@ -272,10 +272,11 @@ class _PipelineRun:
     stop event instead of leaking)."""
 
     def __init__(self, underlying, etl, workers: int, queue_size: int,
-                 staging_depth: int):
+                 staging_depth: int, reader_retry=None):
         self.underlying = underlying
         self.next_raw, _ = _etl_split(underlying)
         self.etl = etl
+        self.reader_retry = reader_retry
         self.workers = workers
         self.staging_depth = staging_depth
         self.stop = threading.Event()
@@ -317,12 +318,28 @@ class _PipelineRun:
                 continue
         return None
 
+    def _pull_raw(self):
+        """One raw pull through the resilience stack: the
+        ``reader.next_raw`` fault site, then the optional retry policy
+        — a transient reader flake (or injected chaos) is retried with
+        backoff on THIS thread instead of surfacing on the consumer.
+        The fault check fires before the stateful reader advances, so a
+        retried pull re-reads nothing and batch order is unchanged."""
+        from deeplearning4j_tpu.resilience import faults
+
+        def pull():
+            faults.check("reader.next_raw")
+            return self.next_raw()
+        if self.reader_retry is None:
+            return pull()
+        return self.reader_retry.call(pull)
+
     def _feed(self):
         m = _pipeline_metrics()
         seq = 0
         try:
             while not self.stop.is_set() and self.underlying.has_next():
-                raw = self.next_raw()
+                raw = self._pull_raw()
                 if not self._q_put((seq, raw)):
                     return
                 seq += 1
@@ -436,6 +453,18 @@ class _PipelineRun:
         self.threads = []
 
 
+def reader_retry_from_conf(g):
+    """The feeder-side RetryPolicy for ``conf.fault_tolerance(
+    reader_retries=N)``, or None when retries are off.  Seeded from the
+    conf seed so the backoff schedule is reproducible run-to-run."""
+    if getattr(g, "ft_reader_retries", 0) <= 0:
+        return None
+    from deeplearning4j_tpu.resilience import RetryPolicy
+    return RetryPolicy(max_attempts=int(g.ft_reader_retries) + 1,
+                       base_delay_ms=25, max_delay_ms=1000,
+                       seed=g.seed, name="reader.next_raw")
+
+
 def _etl_split(underlying):
     """(next_raw, collate) when the underlying iterator supports the
     raw-pull/assembly split, else (next, None) — the two must pair: raw
@@ -467,15 +496,19 @@ class AsyncDataSetIterator(DataSetIterator):
     def __init__(self, underlying: DataSetIterator, queue_size: int = 4,
                  device_put: bool = False, transform=None,
                  workers: int = 1, staging_depth: Optional[int] = None,
-                 normalizer=None):
+                 normalizer=None, reader_retry=None):
         """``transform`` runs on a worker thread BEFORE device_put —
         the shape-bucketing hook (ops/bucketing.py): batches are padded
         up to their bucket off the critical path, so the H2D transfer
         is already bucket-shaped.  ``normalizer`` (datasets/normalizers)
         is applied before ``transform``.  ``staging_depth`` bounds how
         many finished (device-resident) batches may sit ahead of the
-        consumer; default = ``queue_size``."""
+        consumer; default = ``queue_size``.  ``reader_retry`` (a
+        ``resilience.RetryPolicy``) retries transient raw-pull failures
+        on the feeder thread — ``conf.fault_tolerance(reader_retries=N)``
+        plumbs it in."""
         self.underlying = underlying
+        self.reader_retry = reader_retry
         self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
         self.transform_fn = transform
@@ -501,7 +534,8 @@ class AsyncDataSetIterator(DataSetIterator):
                         self.normalizer, self.transform_fn,
                         self.device_put)
         self._run = _PipelineRun(self.underlying, etl, self.workers,
-                                 self.queue_size, self.staging_depth)
+                                 self.queue_size, self.staging_depth,
+                                 reader_retry=self.reader_retry)
         # GC safety net: a dropped-without-close() iterator must not
         # leak its threads.  The run holds no reference back to self,
         # so collection of self is possible while threads still spin —
@@ -641,10 +675,11 @@ class AsyncMultiDataSetIterator(AsyncDataSetIterator):
     def __init__(self, underlying: MultiDataSetIterator,
                  queue_size: int = 4, transform=None,
                  device_put: bool = False, workers: int = 1,
-                 staging_depth: Optional[int] = None):
+                 staging_depth: Optional[int] = None, reader_retry=None):
         super().__init__(underlying, queue_size=queue_size,
                          device_put=device_put, transform=transform,
-                         workers=workers, staging_depth=staging_depth)
+                         workers=workers, staging_depth=staging_depth,
+                         reader_retry=reader_retry)
 
     def batch_size(self):  # MultiDataSet iterators need not expose this
         fn = getattr(self.underlying, "batch_size", None)

@@ -1,25 +1,124 @@
-"""Periodic checkpointing + resume — the failure-recovery mechanism
-(SURVEY §5: "Recovery story is checkpoint-based: save via
+"""Periodic checkpointing + crash-safe resume — the failure-recovery
+mechanism (SURVEY §5: "Recovery story is checkpoint-based: save via
 ModelSerializer, resume by reloading"; ref: util/ModelSerializer.java +
 the early-stopping savers' persist pattern,
 earlystopping/saver/LocalFileModelSaver.java).
 
 ``CheckpointListener`` saves the full training state (config, params,
-updater state) every N iterations/epochs and prunes old checkpoints;
-``resume_from_checkpoint`` restores the newest one, so a crashed run
-continues from the last save with its optimizer moments intact."""
+updater state) every N iterations/epochs and prunes old checkpoints.
+Writes are atomic AND durable: the zip lands in a temp file that is
+fsync'd before an ``os.replace`` publish (plus a directory fsync), so a
+crash mid-save — the exact window this module exists for — never leaves
+a half-written "latest" checkpoint.  Each save also updates
+``checkpoint_manifest.json`` (same atomic protocol) recording, per
+checkpoint, the global iteration, completed epochs, and how many
+batches into the current epoch the save landed — what
+``fit(resume=True)`` needs to skip exactly the already-trained prefix
+of the stream and match an uninterrupted run.
+
+``resume_from_checkpoint`` restores the newest VALID checkpoint:
+candidates are validated (zip CRC, parsable config, non-empty
+coefficients) and a truncated/corrupt file from a crashed writer is
+skipped with a warning — falling back to the previous checkpoint —
+instead of raising.  ``restore_into`` is the in-place flavor the fit
+loops use for ``conf.fault_tolerance(resume=True)``."""
 
 from __future__ import annotations
 
 import json
+import logging
+import os
 import re
 import time
+import zipfile
 from pathlib import Path
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from deeplearning4j_tpu.nn.listeners import TrainingListener
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import CorruptCheckpointError
+
+log = logging.getLogger(__name__)
 
 _CKPT_RE = re.compile(r"checkpoint_it(\d+)\.zip$")
+MANIFEST_NAME = "checkpoint_manifest.json"
+
+
+def _fsync_dir(directory: Path) -> None:
+    try:
+        fd = os.open(str(directory), os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds — rename is still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_file(path: Path) -> None:
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _publish(tmp: Path, final: Path) -> None:
+    """fsync(tmp) → rename → fsync(dir): after this returns, the
+    checkpoint is on disk under its final name or not at all."""
+    _fsync_file(tmp)
+    os.replace(tmp, final)
+    _fsync_dir(final.parent)
+
+
+def _atomic_write_text(path: Path, text: str) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(text)
+    _publish(tmp, path)
+
+
+def validate_checkpoint(path) -> dict:
+    """Cheap integrity check of a checkpoint zip: archive readable, every
+    member's CRC intact, ``configuration.json`` parses, coefficients
+    present and a whole number of float32s.  Returns the parsed config
+    dict; raises :class:`CorruptCheckpointError` on any violation —
+    exactly what a crash mid-write (truncation) or torn storage
+    produces."""
+    from deeplearning4j_tpu.nn.serialization import (
+        COEFFICIENTS_NAME, CONFIG_NAME)
+    p = Path(path)
+    try:
+        with zipfile.ZipFile(p, "r") as zf:
+            bad = zf.testzip()
+            if bad is not None:
+                raise CorruptCheckpointError(
+                    f"{p.name}: CRC mismatch in member {bad!r}")
+            names = zf.namelist()
+            if CONFIG_NAME not in names:
+                raise CorruptCheckpointError(f"{p.name}: no {CONFIG_NAME}")
+            conf = json.loads(zf.read(CONFIG_NAME))
+            if COEFFICIENTS_NAME not in names:
+                raise CorruptCheckpointError(
+                    f"{p.name}: no {COEFFICIENTS_NAME}")
+            info = zf.getinfo(COEFFICIENTS_NAME)
+            if info.file_size == 0 or info.file_size % 4 != 0:
+                raise CorruptCheckpointError(
+                    f"{p.name}: coefficients size {info.file_size} is not "
+                    f"a non-empty float32 array")
+    except CorruptCheckpointError:
+        raise
+    except Exception as e:
+        # BadZipFile, OSError, json/ValueError, zlib.error, ... — any
+        # failure READING the archive means the archive is not readable
+        raise CorruptCheckpointError(f"{p.name}: {type(e).__name__}: {e}")
+    return conf
+
+
+def _count_fallback() -> None:
+    try:
+        from deeplearning4j_tpu import monitor
+        monitor.get_registry().counter(
+            "dl4j_resilience_checkpoint_fallbacks_total",
+            "corrupt/unloadable checkpoints skipped during resume").inc()
+    except Exception:
+        pass
 
 
 class CheckpointListener(TrainingListener):
@@ -39,39 +138,79 @@ class CheckpointListener(TrainingListener):
         self.every_epoch = save_every_epoch
         self.keep_last = max(1, keep_last)
         self.save_updater = save_updater
+        self._epoch_start_iter: Optional[int] = None
 
     # -- listener hooks ----------------------------------------------------
+    def on_epoch_start(self, model):
+        # fallback epoch-start marker for models driven without the fit
+        # loops' own ``_epoch_start_iter`` bookkeeping
+        self._epoch_start_iter = getattr(model, "iteration", 0)
+
     def iteration_done(self, model, iteration):
         if self.every_n and iteration % self.every_n == 0:
             # mid-epoch save: model.epoch COMPLETED epochs so far
-            self._save(model, iteration, getattr(model, "epoch", 0))
+            self._save(model, iteration, getattr(model, "epoch", 0),
+                       self._iteration_in_epoch(model, iteration))
 
     def on_epoch_end(self, model):
         if self.every_epoch:
             # on_epoch_end fires before the engine increments model.epoch,
-            # so the just-finished epoch counts as completed here
+            # so the just-finished epoch counts as completed here — and
+            # the NEXT epoch starts from its first batch
             self._save(model, model.iteration,
-                       getattr(model, "epoch", 0) + 1)
+                       getattr(model, "epoch", 0) + 1, 0)
+
+    def _iteration_in_epoch(self, model, iteration: int) -> Optional[int]:
+        # the fit loops publish the epoch's starting iteration (resume-
+        # aware); the on_epoch_start hook is the fallback marker
+        start = getattr(model, "_epoch_start_iter", self._epoch_start_iter)
+        if start is None:
+            return None
+        return max(0, int(iteration) - int(start))
 
     # -- internals ---------------------------------------------------------
-    def _save(self, model, iteration: int, epochs_completed: int) -> Path:
+    def _save(self, model, iteration: int, epochs_completed: int,
+              iteration_in_epoch: Optional[int] = None) -> Path:
         from deeplearning4j_tpu.nn.serialization import write_model
+        faults.check("checkpoint.write")
         path = self.dir / f"checkpoint_it{iteration}.zip"
         tmp = path.with_suffix(".tmp")
         write_model(model, tmp, save_updater=self.save_updater)
-        tmp.replace(path)  # atomic publish — a crash never leaves a
-        # half-written "latest" checkpoint
-        meta = {"iteration": iteration, "epoch": epochs_completed,
+        _publish(tmp, path)  # fsync + atomic rename: a crash never
+        # leaves a half-written "latest" checkpoint
+        meta = {"file": path.name, "iteration": iteration,
+                "epoch": epochs_completed,
+                "iteration_in_epoch": iteration_in_epoch,
                 "timestamp": int(time.time() * 1000),
                 "model_class": type(model).__name__}
-        (self.dir / "checkpoint_index.json").write_text(json.dumps(meta))
+        self._update_manifest(meta)
+        # legacy single-entry index, kept for older readers
+        _atomic_write_text(self.dir / "checkpoint_index.json",
+                           json.dumps(meta))
         self._prune()
         return path
 
+    def _update_manifest(self, meta: dict) -> None:
+        entries = read_manifest(self.dir)
+        entries = [e for e in entries if e.get("file") != meta["file"]]
+        entries.append(meta)
+        entries.sort(key=lambda e: e.get("iteration", 0))
+        _atomic_write_text(self.dir / MANIFEST_NAME,
+                           json.dumps({"version": 1, "checkpoints": entries},
+                                      indent=2))
+
     def _prune(self) -> None:
         ckpts = self.checkpoints(self.dir)
+        dropped = {p.name for p in ckpts[:-self.keep_last]}
         for old in ckpts[:-self.keep_last]:
             old.unlink(missing_ok=True)
+        if dropped:
+            entries = [e for e in read_manifest(self.dir)
+                       if e.get("file") not in dropped]
+            _atomic_write_text(self.dir / MANIFEST_NAME,
+                               json.dumps({"version": 1,
+                                           "checkpoints": entries},
+                                          indent=2))
 
     @staticmethod
     def checkpoints(directory) -> List[Path]:
@@ -87,29 +226,180 @@ class CheckpointListener(TrainingListener):
         return ckpts[-1] if ckpts else None
 
 
-def resume_from_checkpoint(directory, load_updater: bool = True):
-    """Restore the newest checkpoint in ``directory`` (model type sniffed
-    from the zip) with its iteration counter, or None when none exists —
-    the crash-recovery entry point.  The zip FILENAME is authoritative
-    for the iteration (a crash between zip publish and index write —
-    exactly the window this module exists for — can leave a stale
-    checkpoint_index.json); the index contributes the epoch only when it
-    describes this very checkpoint."""
-    from deeplearning4j_tpu.nn.serialization import load_model
-    path = CheckpointListener.last_checkpoint(directory)
-    if path is None:
-        return None
-    model = load_model(path, load_updater=load_updater)
+def read_manifest(directory) -> List[dict]:
+    """The manifest's checkpoint entries (oldest→newest), or [] when the
+    manifest is missing/corrupt — resume still works from filenames."""
+    p = Path(directory) / MANIFEST_NAME
+    try:
+        data = json.loads(p.read_text())
+        entries = data.get("checkpoints", [])
+        return entries if isinstance(entries, list) else []
+    except (OSError, ValueError):
+        return []
+
+
+def _checkpoint_meta(directory, path: Path) -> dict:
+    """Best-available metadata for one checkpoint file: manifest entry
+    if it names this file, else the legacy index (only when it describes
+    this very iteration — a crash between zip publish and index write
+    can leave it stale), else just the filename's iteration."""
     m = _CKPT_RE.search(path.name)
-    if m:
-        model.iteration = int(m.group(1))
+    meta = {"file": path.name,
+            "iteration": int(m.group(1)) if m else 0,
+            "epoch": None, "iteration_in_epoch": None}
+    for e in read_manifest(directory):
+        if e.get("file") == path.name:
+            meta.update({k: e.get(k, meta.get(k)) for k in
+                         ("epoch", "iteration_in_epoch", "model_class")})
+            return meta
     idx = Path(directory) / "checkpoint_index.json"
     if idx.exists():
         try:
-            meta = json.loads(idx.read_text())
-            if int(meta.get("iteration", -1)) == model.iteration:
-                model.epoch = int(meta.get("epoch",
-                                           getattr(model, "epoch", 0)))
+            legacy = json.loads(idx.read_text())
+            if int(legacy.get("iteration", -1)) == meta["iteration"]:
+                meta["epoch"] = legacy.get("epoch")
         except (ValueError, OSError):
             pass
-    return model
+    return meta
+
+
+def last_valid_checkpoint(directory) -> Optional[Tuple[Path, dict]]:
+    """Newest checkpoint that passes :func:`validate_checkpoint`,
+    walking backwards past corrupt/truncated ones (each skip logged and
+    counted in ``dl4j_resilience_checkpoint_fallbacks_total``)."""
+    for path in reversed(CheckpointListener.checkpoints(directory)):
+        try:
+            validate_checkpoint(path)
+        except CorruptCheckpointError as e:
+            log.warning("skipping corrupt checkpoint %s (%s); falling back "
+                        "to the previous one", path.name, e)
+            _count_fallback()
+            continue
+        return path, _checkpoint_meta(directory, path)
+    return None
+
+
+def _resume(directory, load_updater: bool = True
+            ) -> Optional[Tuple[object, dict]]:
+    """Walk checkpoints newest→oldest; validate, load, and return the
+    first ``(model, meta)`` that survives both — skipping (and counting)
+    corrupt or unloadable files."""
+    from deeplearning4j_tpu.nn.serialization import load_model
+    for path in reversed(CheckpointListener.checkpoints(directory)):
+        try:
+            validate_checkpoint(path)
+            model = load_model(path, load_updater=load_updater)
+        except Exception as e:
+            # validation is necessary but not sufficient (a config can
+            # parse yet fail to load) — either way, fall back to the
+            # previous checkpoint instead of dying on the newest file
+            log.warning("skipping unloadable checkpoint %s (%s: %s); "
+                        "falling back to the previous one",
+                        path.name, type(e).__name__, e)
+            _count_fallback()
+            continue
+        meta = _checkpoint_meta(directory, path)
+        meta["path"] = str(path)
+        model.iteration = meta["iteration"]
+        if meta.get("epoch") is not None:
+            model.epoch = int(meta["epoch"])
+        return model, meta
+    return None
+
+
+def resume_from_checkpoint(directory, load_updater: bool = True):
+    """Restore the newest VALID checkpoint in ``directory`` (model type
+    sniffed from the zip) with its iteration counter, or None when no
+    loadable checkpoint exists — the crash-recovery entry point.
+
+    Corrupt/truncated checkpoints (a crashed writer, torn storage) are
+    validated against and skipped in favor of the previous one instead
+    of raising.  The zip FILENAME is authoritative for the iteration;
+    the manifest/index contributes the epoch only when it describes this
+    very checkpoint."""
+    found = _resume(directory, load_updater=load_updater)
+    return found[0] if found else None
+
+
+def restore_into(model, directory, load_updater: bool = True
+                 ) -> Optional[dict]:
+    """Load the newest valid checkpoint INTO an existing (already
+    initialized) model — params, updater state, iteration and epoch —
+    and return its metadata ``{path, iteration, epoch,
+    iteration_in_epoch}``, or None when there is nothing to resume
+    from.  The in-place flavor ``fit(resume=True)`` uses: the model
+    keeps its listeners, conf and jit caches.
+
+    A type mismatch (checkpoint of a different model class) raises —
+    resuming a ComputationGraph from a MultiLayerNetwork checkpoint is
+    a config error, not a recoverable fault."""
+    found = _resume(directory, load_updater=load_updater)
+    if found is None:
+        return None
+    loaded, meta = found
+    if type(loaded).__name__ != type(model).__name__:
+        raise ValueError(
+            f"checkpoint in {directory} holds a {type(loaded).__name__}, "
+            f"cannot resume a {type(model).__name__} from it")
+    model.set_params(loaded.params())
+    if load_updater and getattr(loaded, "opt_states", None) is not None:
+        model.set_updater_state_flat(loaded.updater_state_flat())
+    model.iteration = loaded.iteration
+    model.epoch = getattr(loaded, "epoch", 0)
+    _fast_forward_rng(model)
+    if meta.get("epoch") is None:
+        meta["epoch"] = getattr(loaded, "epoch", 0)
+    return meta
+
+
+def maybe_auto_resume(model) -> Tuple[int, int]:
+    """The fit loops' ``conf.fault_tolerance(resume=True)`` hook.
+
+    When resume is enabled and this model is fresh (iteration 0 — i.e.
+    a restarted process, not a continuing in-process fit), restore the
+    newest valid checkpoint into it and return ``(epochs_to_skip,
+    batches_to_skip)``: the number of already-completed epochs fit must
+    not re-run, and how many batches into the following epoch the
+    checkpoint landed.  Returns ``(0, 0)`` when there is nothing to
+    resume — a fresh run trains normally.
+
+    The checkpoint directory comes from ``conf.ft_checkpoint_dir`` or,
+    by default, the attached :class:`CheckpointListener`."""
+    g = model.conf.global_conf
+    if not getattr(g, "ft_resume", False):
+        return 0, 0
+    if int(getattr(model, "iteration", 0) or 0) > 0:
+        return 0, 0
+    directory = getattr(g, "ft_checkpoint_dir", None)
+    if directory is None:
+        for lst in getattr(model, "listeners", []):
+            if isinstance(lst, CheckpointListener):
+                directory = lst.dir
+                break
+    if directory is None or not Path(directory).is_dir():
+        return 0, 0
+    meta = restore_into(model, directory)
+    if meta is None:
+        return 0, 0
+    skip_epochs = int(meta.get("epoch") or 0)
+    skip_batches = int(meta.get("iteration_in_epoch") or 0)
+    log.info("resumed from %s (iteration %d, epoch %d + %d batches); "
+             "skipping the already-trained prefix",
+             meta.get("path"), meta["iteration"], skip_epochs, skip_batches)
+    return skip_epochs, skip_batches
+
+
+def _fast_forward_rng(model) -> None:
+    """Replay the per-batch PRNG splits up to the restored iteration so
+    stochastic layers (dropout/drop-connect) continue the SAME key
+    sequence an uninterrupted run would have used — without this,
+    resume is correct but not bit-reproducible for stochastic nets."""
+    key = getattr(model, "_key", None)
+    it = int(getattr(model, "iteration", 0) or 0)
+    if key is None or it <= 0 or it > 100_000:
+        return  # unknown key shape or absurdly long replay: skip
+    import jax
+    fresh = jax.random.PRNGKey(model.conf.global_conf.seed)
+    for _ in range(it):
+        fresh, _ = jax.random.split(fresh)
+    model._key = fresh

@@ -79,6 +79,17 @@ class GlobalConf:
     pipeline_workers: int = 1
     pipeline_prefetch: int = 4
     pipeline_staging_depth: Optional[int] = None
+    # Fault tolerance (resilience/, nn/checkpoint.py): ``ft_resume``
+    # makes fit() auto-restore the newest valid checkpoint from the
+    # attached CheckpointListener's directory (or ``ft_checkpoint_dir``)
+    # and skip the already-trained prefix of the stream, so a crashed
+    # run restarted with the same script converges like an
+    # uninterrupted one.  ``ft_reader_retries`` retries transient
+    # reader failures inside the input-pipeline feeder with exponential
+    # backoff instead of surfacing them.  See docs/RESILIENCE.md.
+    ft_resume: bool = False
+    ft_reader_retries: int = 0
+    ft_checkpoint_dir: Optional[str] = None
 
 
 _MERGE_FIELDS = [
@@ -296,6 +307,24 @@ class Builder:
             self._g.pipeline_prefetch = int(prefetch)
         if staging_depth is not None:
             self._g.pipeline_staging_depth = int(staging_depth)
+        return self
+
+    def fault_tolerance(self, resume: Optional[bool] = None,
+                        reader_retries: Optional[int] = None,
+                        checkpoint_dir=None):
+        """Crash-safe training (docs/RESILIENCE.md): ``resume=True``
+        auto-restores fit() from the newest valid checkpoint (written
+        by an attached ``CheckpointListener``, or found in
+        ``checkpoint_dir``) and replays the input stream past the
+        already-trained prefix; ``reader_retries=N`` retries transient
+        reader failures in the input-pipeline feeder up to N times with
+        seeded exponential backoff before surfacing them."""
+        if resume is not None:
+            self._g.ft_resume = bool(resume)
+        if reader_retries is not None:
+            self._g.ft_reader_retries = max(0, int(reader_retries))
+        if checkpoint_dir is not None:
+            self._g.ft_checkpoint_dir = str(checkpoint_dir)
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

@@ -339,12 +339,24 @@ class ComputationGraph:
         fuse = (max(1, int(fused_steps))
                 if (self.conf.backprop_type != "truncatedbptt"
                     and self.conf.global_conf.iterations <= 1) else 1)
+        # crash-safe resume (conf.fault_tolerance(resume=True)) — same
+        # contract as MultiLayerNetwork.fit: restore the newest valid
+        # checkpoint, then skip the already-trained epochs/batches
+        from deeplearning4j_tpu.nn import checkpoint as ckpt_mod
+        skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
         if isinstance(data, MultiDataSet):
             batches = [data]
             with monitor.profile_if_configured("fit"):
-                for _ in range(epochs):
+                for ep_i in range(epochs):
+                    if ep_i < skip_epochs:
+                        continue
+                    to_skip = skip_batches if ep_i == skip_epochs else 0
+                    self._epoch_start_iter = self.iteration - to_skip
                     epoch_hook("on_epoch_start")
                     for mds in batches:
+                        if to_skip > 0:
+                            to_skip -= 1
+                            continue
                         self._fit_batch(mds)
                     epoch_hook("on_epoch_end")
                     self.epoch += 1
@@ -353,7 +365,8 @@ class ComputationGraph:
         # input pipeline so ETL + H2D overlap the jitted step (the MLN
         # fit path's AsyncDataSetIterator, multi-head flavored)
         from deeplearning4j_tpu.datasets.iterators import (
-            AsyncDataSetIterator, AsyncMultiDataSetIterator)
+            AsyncDataSetIterator, AsyncMultiDataSetIterator,
+            reader_retry_from_conf)
         it = data
         g = self.conf.global_conf
         if (g.pipeline_workers > 0
@@ -374,7 +387,8 @@ class ComputationGraph:
                 it, queue_size=g.pipeline_prefetch,
                 workers=g.pipeline_workers,
                 staging_depth=g.pipeline_staging_depth,
-                device_put=True, transform=to_mds)
+                device_put=True, transform=to_mds,
+                reader_retry=reader_retry_from_conf(g))
         # MultiDataSetIterator protocol when available; plain
         # __iter__-only iterables (duck-typed inputs) still work
         has_protocol = (callable(getattr(it, "has_next", None))
@@ -391,12 +405,21 @@ class ComputationGraph:
 
         try:
             with monitor.profile_if_configured("fit"):
-                for _ in range(epochs):
+                for ep_i in range(epochs):
+                    if ep_i < skip_epochs:
+                        continue  # resumed past this epoch entirely
+                    to_skip = skip_batches if ep_i == skip_epochs else 0
+                    self._epoch_start_iter = self.iteration - to_skip
                     epoch_hook("on_epoch_start")
                     if callable(getattr(it, "reset", None)):
                         it.reset()
                     pending = []
                     for item in batches():
+                        if to_skip > 0:
+                            # replay-skip the already-trained prefix —
+                            # consume to keep stream position, don't fit
+                            to_skip -= 1
+                            continue
                         if isinstance(item, DataSet):
                             item = MultiDataSet(
                                 [item.features], [item.labels],

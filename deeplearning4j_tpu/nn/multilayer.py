@@ -416,7 +416,8 @@ class MultiLayerNetwork:
         per-step); TBPTT ignores the flag."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
         from deeplearning4j_tpu.datasets.iterators import (
-            AsyncDataSetIterator, DataSetIterator, ListDataSetIterator)
+            AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
+            reader_retry_from_conf)
 
         if labels is not None:
             data = DataSet(np.asarray(data), np.asarray(labels))
@@ -432,6 +433,12 @@ class MultiLayerNetwork:
 
         it = data
         g = self.conf.global_conf
+        # crash-safe resume (conf.fault_tolerance(resume=True)): restore
+        # the newest valid checkpoint into this model and skip the
+        # already-trained epochs/batches so the resumed trajectory
+        # matches an uninterrupted run (nn/checkpoint.py)
+        from deeplearning4j_tpu.nn import checkpoint as ckpt_mod
+        skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
         if (g.pipeline_workers > 0 and it.async_supported()
                 and not isinstance(it, AsyncDataSetIterator)):
             transform = None
@@ -446,7 +453,8 @@ class MultiLayerNetwork:
                 it, queue_size=g.pipeline_prefetch,
                 workers=g.pipeline_workers,
                 staging_depth=g.pipeline_staging_depth,
-                device_put=True, transform=transform)
+                device_put=True, transform=transform,
+                reader_retry=reader_retry_from_conf(g))
 
         # fused path steps the updater once per batch; a conf with
         # iterations>1 (multiple updates per batch) keeps exact
@@ -456,7 +464,14 @@ class MultiLayerNetwork:
                     and self.conf.global_conf.iterations <= 1) else 1)
         try:
             with monitor.profile_if_configured("fit"):
-                for _ in range(epochs):
+                for ep_i in range(epochs):
+                    if ep_i < skip_epochs:
+                        continue  # resumed past this epoch entirely
+                    to_skip = skip_batches if ep_i == skip_epochs else 0
+                    # the epoch's notional starting iteration — what
+                    # CheckpointListener subtracts to record how many
+                    # batches into the epoch a save landed
+                    self._epoch_start_iter = self.iteration - to_skip
                     for lst in self.listeners:
                         if isinstance(lst, TrainingListener):
                             lst.on_epoch_start(self)
@@ -466,6 +481,13 @@ class MultiLayerNetwork:
                     while it.has_next():
                         with monitor.span("fit/step", phase="data_wait"):
                             ds = it.next()
+                        if to_skip > 0:
+                            # replay-skip: consume (keeps the stream
+                            # position identical to the crashed run)
+                            # without training or advancing iteration
+                            to_skip -= 1
+                            t_etl = time.perf_counter()
+                            continue
                         self.last_etl_time_ms = \
                             (time.perf_counter() - t_etl) * 1e3
                         if fuse > 1:

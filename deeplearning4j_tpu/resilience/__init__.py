@@ -1,0 +1,36 @@
+"""Cross-cutting fault-tolerance layer: retry/backoff, circuit
+breaking, load shedding, and deterministic fault injection.
+
+The reference DL4J stack was built for unreliable fleets — its Spark
+``TrainingMaster`` / param-averaging layer (mirrored in ``scaleout/``)
+tolerates executor loss, and production serving assumes overload and
+partial failure.  This package is that posture for the reproduction:
+
+* :mod:`~deeplearning4j_tpu.resilience.policy` —
+  :class:`RetryPolicy` (exponential backoff + seeded jitter, optional
+  per-attempt timeout and total deadline budget) and
+  :class:`CircuitBreaker` (closed/open/half-open with a failure-rate
+  window and cooldown), both usable as decorators or call wrappers and
+  both metered into the monitor registry
+  (``dl4j_resilience_retries_total``, ``dl4j_resilience_breaker_state``).
+* :mod:`~deeplearning4j_tpu.resilience.faults` — a deterministic
+  fault-injection registry: named sites in the serving/input/checkpoint
+  paths where a :class:`FaultPlan` (fail-on-nth-call, injected latency,
+  seeded probability) can be armed via the ``DL4J_FAULT_PLAN`` env var
+  or the API, so chaos tests are reproducible in CI.
+
+Wired in: the serving gateway (admission control + ``/healthz`` /
+``/readyz``), ``MicroBatcher`` (deadline shedding, dead-thread
+recovery), ``ModelCache`` (retry + breaker around loads), the input
+pipeline feeder (reader retries) and ``CheckpointListener`` /
+``resume_from_checkpoint`` (atomic writes, corrupt-checkpoint
+fallback).  Catalog + tuning guide: docs/RESILIENCE.md.
+"""
+
+from deeplearning4j_tpu.resilience.errors import (  # noqa: F401
+    CircuitOpenError, CorruptCheckpointError, DeadlineExceededError,
+    OverloadedError, TransientError)
+from deeplearning4j_tpu.resilience.policy import (  # noqa: F401
+    CircuitBreaker, RetryPolicy)
+from deeplearning4j_tpu.resilience import faults  # noqa: F401
+from deeplearning4j_tpu.resilience.faults import FaultPlan  # noqa: F401

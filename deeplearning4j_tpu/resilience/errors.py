@@ -1,0 +1,49 @@
+"""Exception taxonomy for the resilience layer.
+
+The split matters operationally: ``TransientError`` (and the stdlib
+transients — ``ConnectionError``, ``TimeoutError``, ``OSError``) are
+what :class:`~deeplearning4j_tpu.resilience.policy.RetryPolicy` retries
+by default; ``OverloadedError`` / ``CircuitOpenError`` map to HTTP 503
+with ``Retry-After`` at the gateway (shed, don't queue);
+``DeadlineExceededError`` maps to 504 (the client's budget is gone —
+late work is wasted work)."""
+
+from __future__ import annotations
+
+
+class TransientError(RuntimeError):
+    """A failure worth retrying: the operation may succeed if repeated
+    (flaky reader, hiccuping filesystem, injected chaos)."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline budget expired before (or while) the work
+    ran.  Shed requests see this instead of a silent hang."""
+
+
+class OverloadedError(RuntimeError):
+    """Admission control rejected the request: queue depth is past the
+    limit.  ``retry_after_s`` is the backoff hint the gateway surfaces
+    as an HTTP ``Retry-After`` header."""
+
+    def __init__(self, message: str = "server overloaded",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class CircuitOpenError(RuntimeError):
+    """The circuit breaker is open — the protected dependency has been
+    failing and calls are short-circuited until the cooldown elapses.
+    ``retry_after_s`` is the remaining cooldown."""
+
+    def __init__(self, message: str = "circuit open",
+                 retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint zip failed validation (truncated write, bad CRC,
+    unparsable config) — resume skips it and falls back to the previous
+    one instead of dying on it."""

@@ -1,0 +1,321 @@
+"""Retry/backoff and circuit breaking — the two call-wrapping
+resilience primitives (SURVEY §5: the reference keeps training alive on
+unreliable fleets; serving assumes partial failure).
+
+Both are usable two ways::
+
+    policy = RetryPolicy(max_attempts=4, seed=7, name="reader")
+    value = policy.call(flaky_fn, arg)          # wrapper
+
+    @RetryPolicy(max_attempts=3)
+    def load(path): ...                          # decorator
+
+Determinism: backoff jitter comes from a private ``random.Random(seed)``
+— two policies built with the same seed produce the same delay
+sequence, so chaos tests (and their CI reruns) see identical timing
+decisions.  Both primitives meter into the process registry:
+``dl4j_resilience_retries_total{policy=}``,
+``dl4j_resilience_breaker_state{breaker=}`` (0 closed / 1 half-open /
+2 open) and ``dl4j_resilience_breaker_transitions_total{breaker=,to=}``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional, Sequence, Tuple, Type
+
+from deeplearning4j_tpu.resilience.errors import (
+    CircuitOpenError, TransientError)
+
+# What a RetryPolicy retries unless told otherwise: our own transient
+# marker plus the stdlib's "try again" family.  ConnectionError /
+# TimeoutError / OSError cover flaky readers, sockets and filesystems;
+# everything else (ValueError, a real bug) surfaces immediately.
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (
+    TransientError, ConnectionError, TimeoutError, OSError)
+
+
+def _registry():
+    from deeplearning4j_tpu import monitor
+    return monitor.get_registry()
+
+
+class RetryPolicy:
+    """Exponential backoff with seeded jitter, an optional per-attempt
+    timeout, and a total deadline budget.
+
+    ``max_attempts`` counts the first try (``max_attempts=3`` = 1 try +
+    2 retries).  Delay before retry ``i`` (0-based) is
+    ``min(max_delay_ms, base_delay_ms * multiplier**i)`` scaled by a
+    jitter factor drawn uniformly from ``[1 - jitter, 1]`` — full
+    decorrelation without ever exceeding the deterministic envelope.
+    ``deadline_s`` caps the whole call (attempts + sleeps): a retry that
+    could not finish inside the budget is not started.
+    ``attempt_timeout_s`` runs each attempt on a watchdog thread and
+    treats overrun as a retryable ``TimeoutError`` (the hung attempt is
+    abandoned, not interrupted — use for I/O-bound calls)."""
+
+    def __init__(self, max_attempts: int = 3, base_delay_ms: float = 50.0,
+                 max_delay_ms: float = 2000.0, multiplier: float = 2.0,
+                 jitter: float = 0.5, seed: Optional[int] = None,
+                 attempt_timeout_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 retry_on: Optional[Sequence[Type[BaseException]]] = None,
+                 name: str = "default",
+                 sleep: Callable[[float], None] = time.sleep):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay_s = max(0.0, float(base_delay_ms)) / 1e3
+        self.max_delay_s = max(self.base_delay_s, float(max_delay_ms) / 1e3)
+        self.multiplier = max(1.0, float(multiplier))
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self.attempt_timeout_s = attempt_timeout_s
+        self.deadline_s = deadline_s
+        self.retry_on = tuple(retry_on) if retry_on is not None \
+            else DEFAULT_RETRY_ON
+        self.name = name
+        self._sleep = sleep
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        reg = _registry()
+        self._c_retries = reg.counter(
+            "dl4j_resilience_retries_total",
+            "retry attempts made after a failed first try",
+            labels=("policy",)).labels(policy=name)
+        self._c_exhausted = reg.counter(
+            "dl4j_resilience_retry_exhausted_total",
+            "calls that failed after exhausting every retry",
+            labels=("policy",)).labels(policy=name)
+
+    # ------------------------------------------------------------------
+    def delays(self, n: Optional[int] = None):
+        """The next ``n`` backoff delays (seconds) this policy would
+        sleep, consuming its jitter RNG — seeded policies yield
+        identical sequences (the determinism contract chaos tests pin).
+        Defaults to one delay per possible retry."""
+        n = self.max_attempts - 1 if n is None else int(n)
+        out = []
+        with self._lock:
+            for i in range(n):
+                d = min(self.max_delay_s,
+                        self.base_delay_s * self.multiplier ** i)
+                out.append(d * (1.0 - self.jitter * self._rng.random()))
+        return out
+
+    def _next_delay(self, attempt: int) -> float:
+        d = min(self.max_delay_s,
+                self.base_delay_s * self.multiplier ** attempt)
+        with self._lock:
+            return d * (1.0 - self.jitter * self._rng.random())
+
+    def _run_attempt(self, fn, args, kwargs):
+        if self.attempt_timeout_s is None:
+            return fn(*args, **kwargs)
+        box = {}
+
+        def target():
+            try:
+                box["value"] = fn(*args, **kwargs)
+            except BaseException as e:  # delivered on the caller thread
+                box["error"] = e
+        t = threading.Thread(target=target, daemon=True,
+                             name=f"retry-attempt:{self.name}")
+        t.start()
+        t.join(self.attempt_timeout_s)
+        if t.is_alive():
+            raise TimeoutError(
+                f"attempt exceeded {self.attempt_timeout_s}s "
+                f"(policy {self.name!r})")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def call(self, fn: Callable, *args, on_retry: Optional[Callable] = None,
+             **kwargs):
+        """Run ``fn(*args, **kwargs)`` under this policy.  ``on_retry``
+        (if given) is called with ``(attempt_index, exception)`` before
+        each backoff sleep — the logging/telemetry hook."""
+        t_start = time.monotonic()
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                return self._run_attempt(fn, args, kwargs)
+            except self.retry_on as e:
+                last = e
+                if attempt + 1 >= self.max_attempts:
+                    break
+                delay = self._next_delay(attempt)
+                if (self.deadline_s is not None
+                        and time.monotonic() - t_start + delay
+                        >= self.deadline_s):
+                    break  # a retry that can't fit the budget isn't made
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self._c_retries.inc()
+                if delay > 0:
+                    self._sleep(delay)
+        self._c_exhausted.inc()
+        assert last is not None
+        raise last
+
+    def __call__(self, fn: Callable) -> Callable:
+        """Decorator form: ``@RetryPolicy(...)``."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        wrapper.retry_policy = self
+        return wrapper
+
+
+class CircuitBreaker:
+    """Closed → open → half-open breaker over a rolling failure-rate
+    window.
+
+    *Closed*: calls pass; outcomes land in a window of the last
+    ``window`` calls.  Once ``min_calls`` outcomes exist and the failure
+    rate reaches ``failure_threshold``, the breaker opens.
+    *Open*: calls fail fast with :class:`CircuitOpenError` (carrying the
+    remaining cooldown as ``retry_after_s``) for ``cooldown_s``.
+    *Half-open*: after the cooldown, up to ``half_open_max`` probe
+    calls are let through; a success closes the breaker (window
+    cleared), a failure re-opens it and restarts the cooldown.
+
+    ``clock`` is injectable so tests drive time instead of sleeping."""
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    _STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+    def __init__(self, failure_threshold: float = 0.5, window: int = 20,
+                 min_calls: int = 5, cooldown_s: float = 30.0,
+                 half_open_max: int = 1, name: str = "default",
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = min(1.0, max(0.0, float(failure_threshold)))
+        self.window = max(1, int(window))
+        self.min_calls = max(1, int(min_calls))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.half_open_max = max(1, int(half_open_max))
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._outcomes: deque = deque(maxlen=self.window)
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probes_in_flight = 0
+        reg = _registry()
+        self._g_state = reg.gauge(
+            "dl4j_resilience_breaker_state",
+            "breaker state (0 closed, 1 half-open, 2 open)",
+            labels=("breaker",)).labels(breaker=name)
+        self._c_transitions = reg.counter(
+            "dl4j_resilience_breaker_transitions_total",
+            "breaker state transitions", labels=("breaker", "to"))
+        self._c_short_circuited = reg.counter(
+            "dl4j_resilience_breaker_short_circuited_total",
+            "calls rejected while the breaker was open",
+            labels=("breaker",)).labels(breaker=name)
+        self._g_state.set(0)
+
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open_locked()
+            return self._state
+
+    def _transition_locked(self, to: str) -> None:
+        if self._state == to:
+            return
+        self._state = to
+        self._g_state.set(self._STATE_CODE[to])
+        self._c_transitions.labels(breaker=self.name, to=to).inc()
+        if to == self.OPEN:
+            self._opened_at = self._clock()
+        if to == self.HALF_OPEN:
+            self._probes_in_flight = 0
+        if to == self.CLOSED:
+            self._outcomes.clear()
+
+    def _maybe_half_open_locked(self) -> None:
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            self._transition_locked(self.HALF_OPEN)
+
+    def acquire(self) -> None:
+        """Gate a call: no-op when closed/half-open (with probe budget),
+        raises :class:`CircuitOpenError` when open."""
+        with self._lock:
+            self._maybe_half_open_locked()
+            if self._state == self.CLOSED:
+                return
+            if self._state == self.HALF_OPEN:
+                if self._probes_in_flight < self.half_open_max:
+                    self._probes_in_flight += 1
+                    return
+                remaining = 0.1  # probes saturated: come back shortly
+            else:
+                remaining = max(
+                    0.0, self.cooldown_s - (self._clock() - self._opened_at))
+            self._c_short_circuited.inc()
+            raise CircuitOpenError(
+                f"circuit {self.name!r} open "
+                f"(retry in {remaining:.2f}s)", retry_after_s=remaining)
+
+    def record(self, ok: bool) -> None:
+        """Report a call outcome (for code that gates with
+        :meth:`acquire` manually instead of using :meth:`call`)."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._probes_in_flight = max(0, self._probes_in_flight - 1)
+                self._transition_locked(
+                    self.CLOSED if ok else self.OPEN)
+                return
+            self._outcomes.append(bool(ok))
+            if self._state == self.CLOSED and not ok:
+                n = len(self._outcomes)
+                failures = n - sum(self._outcomes)
+                if (n >= self.min_calls
+                        and failures / n >= self.failure_threshold):
+                    self._transition_locked(self.OPEN)
+
+    def call(self, fn: Callable, *args, **kwargs):
+        """Run ``fn`` through the breaker: fail fast when open, record
+        the outcome otherwise.  ``CircuitOpenError`` raised by a NESTED
+        breaker is not counted against this one's window."""
+        self.acquire()
+        try:
+            result = fn(*args, **kwargs)
+        except CircuitOpenError:
+            raise
+        except Exception:
+            self.record(False)
+            raise
+        self.record(True)
+        return result
+
+    def __call__(self, fn: Callable) -> Callable:
+        """Decorator form: ``@CircuitBreaker(...)``."""
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+        wrapper.circuit_breaker = self
+        return wrapper
+
+    def reset(self) -> None:
+        """Force-close (ops override / test isolation)."""
+        with self._lock:
+            self._transition_locked(self.CLOSED)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open_locked()
+            n = len(self._outcomes)
+            failures = n - sum(self._outcomes)
+            return {"state": self._state, "window_calls": n,
+                    "window_failures": failures,
+                    "failure_rate": round(failures / n, 3) if n else 0.0}

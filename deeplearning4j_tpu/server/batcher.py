@@ -27,6 +27,7 @@ batch-size histogram, surfaced through the gateway's ``stats`` RPC and
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent.futures import Future
@@ -34,17 +35,22 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+log = logging.getLogger(__name__)
+
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.ops import bucketing
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
 
 
 class _Pending:
-    __slots__ = ("x", "future", "t_enqueue")
+    __slots__ = ("x", "future", "t_enqueue", "deadline")
 
-    def __init__(self, x, future, t_enqueue):
+    def __init__(self, x, future, t_enqueue, deadline=None):
         self.x = x
         self.future = future
         self.t_enqueue = t_enqueue
+        self.deadline = deadline  # absolute time.monotonic(), or None
 
 
 class ServingMetrics:
@@ -83,7 +89,12 @@ class ServingMetrics:
         self.requests = 0
         self.rows = 0
         self.batches = 0
+        self.shed = {}
         self.batch_size_hist = {}
+
+    def record_shed(self, reason: str) -> None:
+        with self._lock:
+            self.shed[reason] = self.shed.get(reason, 0) + 1
 
     def record_batch(self, n_requests: int, n_rows: int) -> None:
         with self._lock:
@@ -101,10 +112,13 @@ class ServingMetrics:
             requests, rows, batches = self.requests, self.rows, self.batches
             hist = {str(k): v for k, v in
                     sorted(self.batch_size_hist.items())}
+        with self._lock:
+            shed = dict(self.shed)
         return {
             "requests": requests,
             "rows": rows,
             "batches": batches,
+            "shed": shed,
             "rows_per_batch_mean": round(rows / batches, 2) if batches else 0.0,
             "requests_per_batch_mean":
                 round(requests / batches, 2) if batches else 0.0,
@@ -150,32 +164,80 @@ class MicroBatcher:
         self._queue: List[_Pending] = []
         self._cond = threading.Condition()
         self._running = True
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True,
-            name=f"micro-batcher:{name or hex(id(self))}")
-        self._thread.start()
+        self._name = name
+        self._inflight: List[_Pending] = []
+        self._dead = False  # set by the crash handler BEFORE the dying
+        # thread's is_alive() goes False — submit() keys restarts off it
+        self.deaths = 0
+        self.restarts = 0
+        reg = monitor.get_registry()
+        self._c_shed = reg.counter(
+            "dl4j_resilience_shed_total",
+            "requests shed instead of served", labels=("reason",))
+        self._c_deaths = reg.counter(
+            "dl4j_resilience_batcher_deaths_total",
+            "micro-batcher threads that died unexpectedly")
+        self._c_restarts = reg.counter(
+            "dl4j_resilience_batcher_restarts_total",
+            "micro-batcher threads restarted after a death")
+        self._thread = self._spawn_thread()
+
+    def _spawn_thread(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._loop_guarded, daemon=True,
+            name=f"micro-batcher:{self._name or hex(id(self))}")
+        t.start()
+        return t
 
     # ------------------------------------------------------------------
     # Client side
     # ------------------------------------------------------------------
-    def submit(self, features) -> Future:
+    def submit(self, features, timeout_ms: Optional[float] = None) -> Future:
         """Enqueue a ``[k, ...]`` row batch; the future resolves to the
-        ``[k, ...]`` output slice for exactly those rows."""
+        ``[k, ...]`` output slice for exactly those rows.
+
+        ``timeout_ms`` is the request's deadline budget: if it expires
+        while the request is still queued, the request is SHED before
+        compute (the future fails with :class:`DeadlineExceededError`)
+        instead of burning a jitted call on an answer nobody is waiting
+        for."""
         x = np.asarray(features)
         if x.ndim < 1 or x.shape[0] == 0:
             raise ValueError("submit() needs a non-empty [k, ...] row batch")
         fut = Future()
-        p = _Pending(x, fut, time.perf_counter())
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        p = _Pending(x, fut, time.perf_counter(), deadline)
         with self._cond:
             if not self._running:
                 raise RuntimeError("MicroBatcher is stopped")
+            # dead-thread detection: a batcher thread killed by a crash
+            # must not strand clients — restart it on the next request
+            if self._dead or not self._thread.is_alive():
+                self._dead = False
+                self.restarts += 1
+                self._c_restarts.inc()
+                self._thread = self._spawn_thread()
             self._queue.append(p)
             self._cond.notify_all()
         return fut
 
-    def predict(self, features, timeout: Optional[float] = None):
-        """Blocking convenience wrapper around :meth:`submit`."""
-        return self.submit(features).result(timeout)
+    def predict(self, features, timeout: Optional[float] = None,
+                timeout_ms: Optional[float] = None):
+        """Blocking convenience wrapper around :meth:`submit`.
+        ``timeout`` (seconds) bounds the client-side wait; ``timeout_ms``
+        is the server-side deadline budget (queued past it = shed)."""
+        return self.submit(features, timeout_ms=timeout_ms).result(timeout)
+
+    def queue_rows(self) -> int:
+        """Rows currently waiting for dispatch — the admission-control
+        signal the gateway checks against its queue limit."""
+        with self._cond:
+            return sum(len(p.x) for p in self._queue)
+
+    @property
+    def thread_alive(self) -> bool:
+        return self._thread.is_alive()
 
     def stop(self, timeout: float = 5.0) -> None:
         """Drain in-flight work, stop the batcher thread, and fail any
@@ -225,9 +287,28 @@ class MicroBatcher:
                 self._cond.wait(remaining)
             return taken
 
+    def _shed_expired(self, taken: List[_Pending]) -> List[_Pending]:
+        """Drop requests whose deadline budget expired while queued —
+        BEFORE compute, so the jitted call never runs for a client that
+        has already given up."""
+        now = time.monotonic()
+        keep: List[_Pending] = []
+        for p in taken:
+            if p.deadline is not None and now >= p.deadline:
+                self.metrics.record_shed("deadline")
+                self._c_shed.labels(reason="deadline").inc()
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceededError(
+                        "request deadline expired while queued "
+                        f"({(now - p.deadline) * 1e3:.1f} ms past budget)"))
+            else:
+                keep.append(p)
+        return keep
+
     def _run_group(self, group: List[_Pending]) -> None:
         t_dispatch = time.perf_counter()
         try:
+            faults.check("batcher.compute")
             with monitor.span("serve/batch", phase="concat_pad"):
                 xs = [p.x for p in group]
                 x = np.concatenate(xs) if len(xs) > 1 else xs[0]
@@ -256,12 +337,47 @@ class MicroBatcher:
                 if not p.future.done():
                     p.future.set_exception(e)
 
+    def _loop_guarded(self) -> None:
+        """The batcher thread body plus its crash handler.  A
+        ``BaseException`` escaping the loop (a killed thread — e.g. an
+        armed ``mode="kill"`` fault, or a fatal interpreter error) used
+        to strand every pending future in a forever-block; now the
+        handler fails in-flight and queued requests with an error result
+        and the next :meth:`submit` restarts the thread."""
+        try:
+            self._loop()
+        except BaseException as e:
+            # recorded here (not re-raised): the death is fully handled
+            # below, and a daemon thread's unhandled-exception spew
+            # would just double-report it
+            log.error("micro-batcher %r thread died: %s: %s",
+                      self._name, type(e).__name__, e)
+        finally:
+            with self._cond:
+                died = self._running  # normal stop() exits are not deaths
+                stranded = self._inflight + self._queue
+                self._inflight = []
+                if died:
+                    self._queue = []
+                    self.deaths += 1
+                    self._dead = True
+            if died:
+                self._c_deaths.inc()
+                for p in stranded:
+                    if not p.future.done():
+                        p.future.set_exception(RuntimeError(
+                            "MicroBatcher thread died; request failed "
+                            "(the batcher restarts on the next submit)"))
+
     def _loop(self) -> None:
         while True:
             taken = self._take_batch()
             if not taken:
                 if not self._running:
                     return
+                continue
+            taken = self._shed_expired(taken)
+            if not taken:
                 continue
             # one dispatch per (row-shape, dtype) group: a client sending
             # mismatched rows must not fail its batch-mates
@@ -270,4 +386,8 @@ class MicroBatcher:
                 groups.setdefault(
                     (p.x.shape[1:], str(p.x.dtype)), []).append(p)
             for group in groups.values():
+                with self._cond:
+                    self._inflight = list(group)
                 self._run_group(group)
+                with self._cond:
+                    self._inflight = []

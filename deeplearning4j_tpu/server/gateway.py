@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -41,6 +42,9 @@ from typing import Optional
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.resilience import (
+    CircuitBreaker, CircuitOpenError, OverloadedError, RetryPolicy, faults)
+from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
 from deeplearning4j_tpu.server.batcher import MicroBatcher
 from deeplearning4j_tpu.server.model_cache import ModelCache
 
@@ -51,18 +55,43 @@ class DeepLearning4jEntryPoint:
 
     ``max_batch``/``max_wait_ms`` configure the per-model micro-batcher;
     ``coalesce`` is the default for ``predict(features=...)`` requests
-    (overridable per request)."""
+    (overridable per request).
+
+    Overload posture (docs/RESILIENCE.md): ``max_queue_rows`` bounds the
+    rows queued across batchers — a ``predict`` that would push past it
+    is rejected with :class:`OverloadedError` (HTTP 503 +
+    ``Retry-After: retry_after_s``) instead of queuing without bound;
+    per-request ``deadline_ms`` propagates into the batcher so requests
+    that expire while queued are shed before compute.  When this entry
+    point builds its own :class:`ModelCache`, checkpoint loads get a
+    retry policy and a circuit breaker (``/readyz`` goes unready while
+    that breaker is open)."""
 
     def __init__(self, model_cache: Optional[ModelCache] = None,
                  max_batch: int = 32, max_wait_ms: float = 5.0,
-                 min_batch: int = 1, coalesce: bool = True):
-        self.model_cache = model_cache or ModelCache()
+                 min_batch: int = 1, coalesce: bool = True,
+                 max_queue_rows: int = 1024, retry_after_s: float = 1.0,
+                 min_ready_models: int = 0):
+        if model_cache is None:
+            model_cache = ModelCache(
+                load_retry=RetryPolicy(max_attempts=3, base_delay_ms=25,
+                                       name="cache.load"),
+                load_breaker=CircuitBreaker(cooldown_s=10.0,
+                                            name="cache.load"))
+        self.model_cache = model_cache
         self.max_batch = max(1, int(max_batch))
         self.max_wait_ms = float(max_wait_ms)
         self.min_batch = max(1, int(min_batch))
         self.coalesce = bool(coalesce)
+        self.max_queue_rows = max(1, int(max_queue_rows))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self.min_ready_models = max(0, int(min_ready_models))
+        self._t_start = time.time()
         self._batchers: dict = {}
         self._batcher_lock = threading.Lock()
+        self._c_shed = monitor.get_registry().counter(
+            "dl4j_resilience_shed_total",
+            "requests shed instead of served", labels=("reason",))
 
     def _load_model(self, model_path: str):
         return self.model_cache.get(model_path)
@@ -134,7 +163,8 @@ class DeepLearning4jEntryPoint:
     def predict(self, model_path: str, data_dir: Optional[str] = None,
                 features=None, top_k: Optional[int] = None,
                 argmax_only: bool = False,
-                coalesce: Optional[bool] = None) -> dict:
+                coalesce: Optional[bool] = None,
+                deadline_ms: Optional[float] = None) -> dict:
         """Run inference with the cached, bucket-warmed model.
 
         Exactly one input source: ``data_dir`` (exported minibatch
@@ -143,10 +173,17 @@ class DeepLearning4jEntryPoint:
         path; concurrent requests coalesce through the micro-batcher
         unless ``coalesce=False``).
 
+        ``deadline_ms`` is the request's total budget: a request still
+        queued in the batcher when it expires is shed before compute
+        (``DeadlineExceededError`` → HTTP 504); admission control may
+        reject it up front (``OverloadedError`` → HTTP 503 +
+        ``Retry-After``) when queued rows exceed ``max_queue_rows``.
+
         Response shaping for classification clients: ``argmax_only``
         returns class ids; ``top_k=K`` returns the K best class ids +
         probabilities per row — both avoid serializing the full
         ``[n, n_classes]`` probability matrix to JSON."""
+        faults.check("gateway.predict")
         if (data_dir is None) == (features is None):
             raise ValueError(
                 "predict needs exactly one of data_dir= or features=")
@@ -155,12 +192,17 @@ class DeepLearning4jEntryPoint:
             if x.ndim < 1 or x.shape[0] == 0:
                 raise ValueError("features must be a non-empty [k, ...] "
                                  "row batch")
+            use_batcher = self.coalesce if coalesce is None else bool(coalesce)
+            if use_batcher:
+                # admission BEFORE the (possibly breaker-guarded) model
+                # load: an overloaded server sheds cheap and early
+                self._admit(len(x))
             model = self.model_cache.get(
                 model_path, warmup_dims=tuple(x.shape[1:]),
                 max_batch=self.max_batch)
-            use_batcher = self.coalesce if coalesce is None else bool(coalesce)
             if use_batcher:
-                out = self._batcher_for(model_path, model).predict(x)
+                out = self._batcher_for(model_path, model).predict(
+                    x, timeout_ms=deadline_ms)
             else:
                 out = self._infer_fn(model)(x)
             return self._format_predictions(out, top_k, argmax_only)
@@ -200,6 +242,58 @@ class DeepLearning4jEntryPoint:
         for _, batcher in dropped:
             batcher.stop()
         return {"invalidated": n}
+
+    # ------------------------------------------------------------------
+    # Health / readiness (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _admit(self, n_rows: int) -> None:
+        """Bounded-queue admission control: reject (don't queue) when
+        the rows already waiting across batchers plus this request
+        exceed ``max_queue_rows``."""
+        depth = self._queued_rows()
+        if depth + n_rows > self.max_queue_rows:
+            self._c_shed.labels(reason="queue_full").inc()
+            raise OverloadedError(
+                f"queue full ({depth} rows waiting, limit "
+                f"{self.max_queue_rows})", retry_after_s=self.retry_after_s)
+
+    def _queued_rows(self) -> int:
+        with self._batcher_lock:
+            batchers = [b for _, b in self._batchers.values()]
+        return sum(b.queue_rows() for b in batchers)
+
+    def healthz(self) -> dict:
+        """Liveness: the process is up and the RPC loop answers.  Stays
+        200 even under injected faults or overload — unhealthy-vs-busy
+        is ``readyz``'s distinction, not this one's."""
+        return {"status": "ok", "uptime_s": round(time.time() -
+                                                  self._t_start, 1)}
+
+    def readyz(self) -> dict:
+        """Readiness: should a load balancer send traffic here NOW?
+        Ready iff every batcher thread is alive, queued rows are under
+        the admission limit, the model-load breaker (if any) is not
+        open, and at least ``min_ready_models`` models are resident and
+        warm."""
+        with self._batcher_lock:
+            batchers = list(self._batchers.values())
+        queued = sum(b.queue_rows() for _, b in batchers)
+        breaker = getattr(self.model_cache, "load_breaker", None)
+        cache_stats = self.model_cache.stats()
+        warm = sum(1 for m in cache_stats["models"].values()
+                   if m.get("warmup") is not None)
+        checks = {
+            "batchers_alive": all(b.thread_alive for _, b in batchers),
+            "queue_below_limit": queued < self.max_queue_rows,
+            "breaker_closed": (breaker is None
+                               or breaker.state != CircuitBreaker.OPEN),
+            "models_warm": len(cache_stats["models"])
+                           >= self.min_ready_models,
+        }
+        return {"ready": all(checks.values()), "checks": checks,
+                "queued_rows": queued,
+                "models_resident": cache_stats["size"],
+                "models_warmed": warm}
 
     def stats(self) -> dict:
         """Serving observability: model-cache counters, per-model
@@ -333,33 +427,52 @@ class Server:
             def log_message(self, *args):
                 pass
 
-            def _respond(self, code, payload, content_type):
+            def _respond(self, code, payload, content_type,
+                         headers=None):
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(payload)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(payload)
 
             def do_GET(self):
-                """``GET /metrics`` — the raw Prometheus scrape surface
-                (``curl http://host:port/metrics``); everything else 404s."""
+                """The probe surfaces a stock scraper / load balancer /
+                ``curl`` hits without JSON-RPC framing: ``/metrics``
+                (Prometheus text), ``/healthz`` (liveness, always 200
+                while the process answers) and ``/readyz`` (readiness —
+                503 while shedding/unwarm/breaker-open, so an LB drains
+                this replica instead of feeding it)."""
                 path = self.path.split("?", 1)[0]
-                if path != "/metrics":
-                    self._respond(404, b'{"error": "not found"}',
-                                  "application/json")
-                    return
                 try:
-                    m = ep.metrics()
-                    server._count_request("GET /metrics", 200)
-                    self._respond(200, m["body"].encode(), m["content_type"])
+                    if path == "/metrics":
+                        m = ep.metrics()
+                        server._count_request("GET /metrics", 200)
+                        self._respond(200, m["body"].encode(),
+                                      m["content_type"])
+                    elif path == "/healthz":
+                        server._count_request("GET /healthz", 200)
+                        self._respond(200, json.dumps(ep.healthz()).encode(),
+                                      "application/json")
+                    elif path == "/readyz":
+                        r = ep.readyz()
+                        code = 200 if r["ready"] else 503
+                        server._count_request("GET /readyz", code)
+                        self._respond(code, json.dumps(r).encode(),
+                                      "application/json")
+                    else:
+                        self._respond(404, b'{"error": "not found"}',
+                                      "application/json")
                 except Exception as e:
-                    server._count_request("GET /metrics", 500)
+                    server._count_request(f"GET {path}", 500)
                     self._respond(500, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode(),
                         "application/json")
 
             def do_POST(self):
                 method = ""
+                headers = {}
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -371,12 +484,23 @@ class Server:
                     code = 200
                 except Exception as e:
                     err = {"error": f"{type(e).__name__}: {e}"}
-                    if server.debug:
-                        err["traceback"] = traceback.format_exc()
+                    # resilience errors carry their HTTP semantics:
+                    # shed/short-circuited → 503 + Retry-After (back
+                    # off, come back), expired deadline → 504
+                    if isinstance(e, (OverloadedError, CircuitOpenError)):
+                        code = 503
+                        headers["Retry-After"] = str(max(
+                            1, int(round(e.retry_after_s or 1.0))))
+                        err["retry_after_s"] = e.retry_after_s
+                    elif isinstance(e, DeadlineExceededError):
+                        code = 504
+                    else:
+                        code = 500
+                        if server.debug:
+                            err["traceback"] = traceback.format_exc()
                     payload = json.dumps(err).encode()
-                    code = 500
                 server._count_request(method or "?", code)
-                self._respond(code, payload, "application/json")
+                self._respond(code, payload, "application/json", headers)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address

@@ -30,6 +30,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.resilience import faults
 
 
 def default_loader(path: str):
@@ -49,9 +50,19 @@ class ModelCache:
     same path load the checkpoint once."""
 
     def __init__(self, capacity: int = 4,
-                 loader: Optional[Callable] = None):
+                 loader: Optional[Callable] = None,
+                 load_retry=None, load_breaker=None):
+        """``load_retry`` (a ``resilience.RetryPolicy``) retries
+        transient load failures; ``load_breaker`` (a
+        ``resilience.CircuitBreaker``) fails fast once loads keep
+        failing, so a broken checkpoint path can't pile threads up
+        behind the cache lock.  Both default to off; the serving
+        gateway arms them on its cache (``/readyz`` reports the breaker
+        state)."""
         self.capacity = max(1, int(capacity))
         self._loader = loader or default_loader
+        self.load_retry = load_retry
+        self.load_breaker = load_breaker
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, dict]" = OrderedDict()
         self.hits = 0
@@ -96,7 +107,7 @@ class ModelCache:
                 self._entries.move_to_end(key)
             else:
                 self._count("misses")
-                model = self._loader(key)
+                model = self._load(key)
                 if shape_bucketing is not None:
                     model.conf.global_conf.shape_bucketing = \
                         bool(shape_bucketing)
@@ -112,6 +123,25 @@ class ModelCache:
                 e["warmup"] = e["model"].warmup_inference(
                     warmup_dims, max_batch=max_batch)
             return e["model"]
+
+    def _load(self, key: str):
+        """One checkpoint load through the resilience stack: the
+        ``cache.load`` fault site, then retry (inner — a transient
+        flake is absorbed before the breaker sees it), then the breaker
+        (outer — it counts exhausted retry sequences, and fails fast
+        with ``CircuitOpenError`` while open)."""
+        def attempt():
+            faults.check("cache.load")
+            return self._loader(key)
+
+        def with_retry():
+            if self.load_retry is None:
+                return attempt()
+            return self.load_retry.call(attempt)
+
+        if self.load_breaker is None:
+            return with_retry()
+        return self.load_breaker.call(with_retry)
 
     def peek(self, path):
         """The cached model if (and only if) it is resident and fresh —
@@ -149,7 +179,7 @@ class ModelCache:
                     "warmup": e["warmup"]}
                 for k, e in self._entries.items()
             }
-            return {
+            out = {
                 "capacity": self.capacity,
                 "size": len(models),
                 "hits": self.hits,
@@ -158,3 +188,6 @@ class ModelCache:
                 "evictions": self.evictions,
                 "models": models,
             }
+        if self.load_breaker is not None:
+            out["load_breaker"] = self.load_breaker.snapshot()
+        return out
