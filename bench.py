@@ -69,15 +69,45 @@ def timed_windows(run_step, block, steps, windows=WINDOWS, warmup=8):
 
 
 def window_stats(times, items_per_step, steps):
+    """Best-of-N summary WITH variance: a headline number whose window
+    spread is recorded next to it is attributable; one that isn't is
+    noise you can't distinguish from a regression (ROADMAP item 5 — the
+    r01→r02 1.40M→511k swing had no spread recorded, so nobody could
+    tell machine noise from a real change)."""
     med = statistics.median(times)
+    rates = [items_per_step * steps / t for t in times]
     return {
         "items_per_sec_median": items_per_step * steps / med,
         "items_per_sec_max": items_per_step * steps / min(times),
         "items_per_sec_min": items_per_step * steps / max(times),
+        "items_per_sec_stdev": round(statistics.stdev(rates), 2)
+                               if len(rates) > 1 else 0.0,
+        "window_rel_spread": round((max(times) - min(times)) / med, 4),
+        "best_of": len(times),
         "step_time_ms_median": med / steps * 1e3,
         "window_sec": [round(t, 4) for t in times],
         "steps_per_window": steps,
     }
+
+
+def machine_fingerprint(devices=None):
+    """Where this record was measured: without the fingerprint, two
+    BENCH records are not comparable at all (a v5e number vs a CPU
+    fallback number looks like a 100x regression)."""
+    import platform as pyplat
+    import socket
+    fp = {"host": socket.gethostname(), "os": pyplat.platform(),
+          "python": pyplat.python_version(), "cpu_count": os.cpu_count()}
+    try:
+        import jax
+        fp["jax_version"] = jax.__version__
+        fp["platform"] = jax.default_backend()
+        if devices:
+            fp["device_kind"] = devices[0].device_kind
+            fp["device_count"] = len(devices)
+    except Exception:
+        pass
+    return fp
 
 
 def compiled_step(raw_step, args):
@@ -501,6 +531,130 @@ def bench_resilience():
             / max(legs["baseline"]["serve_requests_per_sec"], 1e-9) * 100,
             1),
         "chaos_absorbed": legs["chaos"]["retries"] > 0,
+        **legs,
+    }
+
+
+def bench_sharded(n_chips, peak):
+    """FSDP A/B (ROADMAP item 1): the same wide-MLP fit() run
+    replica-style vs ``conf.sharding(data=1, fsdp=n_chips)`` — the
+    production sharded path, not a dry-run.  Reports samples/sec per
+    leg, the per-device param/updater bytes from the ``dl4j_sharding_*``
+    gauges (the ZeRO claim: updater state shrinks ~1/fsdp), and an MFU
+    estimate computed from the per-layer flops model ×
+    ``dl4j_phase_seconds{phase=jit_call}`` step spans — derivable from
+    the record alone, no compiled-step cost model needed.  On one
+    device the sharded conf degrades to replica-style and the record
+    says so."""
+    import jax
+    from deeplearning4j_tpu import monitor
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.ops import flops as flops_model
+
+    BATCH, FEAT, HID, CLASSES, BATCHES = 256, 512, 512, 64, 12
+    fsdp_degree = max(1, n_chips)
+    rng = np.random.default_rng(8)
+    batches = [DataSet(rng.normal(size=(BATCH, FEAT)).astype(np.float32),
+                       np.eye(CLASSES, dtype=np.float32)[
+                           rng.integers(0, CLASSES, BATCH)])
+               for _ in range(BATCHES)]
+
+    def make_net(shard):
+        b = (NeuralNetConfiguration.builder().seed(3)
+             .updater("adam").learning_rate(1e-3)
+             .input_pipeline(workers=0))
+        if shard:
+            b.sharding(data=1, fsdp=fsdp_degree)
+        conf = (b.list()
+                .layer(L.DenseLayer(n_in=FEAT, n_out=HID,
+                                    activation="relu"))
+                .layer(L.DenseLayer(n_in=HID, n_out=HID,
+                                    activation="relu"))
+                .layer(L.OutputLayer(n_in=HID, n_out=CLASSES,
+                                     activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def phase_totals(phase):
+        snap = monitor.get_registry().snapshot()
+        fam = snap.get("dl4j_phase_seconds") or {"samples": []}
+        tot = cnt = 0.0
+        for s in fam["samples"]:
+            if s["labels"].get("span") == "fit/step" \
+                    and s["labels"].get("phase") == phase:
+                tot += s.get("sum") or 0.0
+                cnt += s.get("count") or 0
+        return tot, cnt
+
+    def gauge(name):
+        fam = monitor.get_registry().get(name)
+        if fam is None:
+            return None
+        samples = fam.samples()
+        return samples[0]["value"] if samples else None
+
+    legs = {}
+    for name, shard in (("replica", False), ("sharded", True)):
+        net = make_net(shard)
+        net.fit(ListDataSetIterator(list(batches[:2])))  # compile off-clock
+        walls = []
+        jit_s0, jit_c0 = phase_totals("jit_call")
+        for _ in range(3):
+            it = ListDataSetIterator(list(batches))
+            t0 = time.perf_counter()
+            net.fit(it)
+            jax.block_until_ready(net.net_params)
+            walls.append(time.perf_counter() - t0)
+        jit_s1, jit_c1 = phase_totals("jit_call")
+        steps = max(1.0, jit_c1 - jit_c0)
+        step_s = (jit_s1 - jit_s0) / steps
+        wall = min(walls)
+        leg = {
+            "samples_per_sec": round(BATCH * BATCHES / wall, 1),
+            "wall_sec_best_of_3": round(wall, 4),
+            "wall_sec_all": [round(w, 4) for w in walls],
+            "wall_sec_stdev": round(statistics.stdev(walls), 4),
+            "jit_call_ms_per_step": round(step_s * 1e3, 3),
+        }
+        est = flops_model.mfu(net, BATCH, step_s, peak)
+        if est:
+            leg.update(est)
+        if shard:
+            leg["sharding_active"] = net._sharding_plan is not None
+            for gname in ("dl4j_sharding_param_bytes_total",
+                          "dl4j_sharding_param_bytes_per_device",
+                          "dl4j_sharding_updater_bytes_total",
+                          "dl4j_sharding_updater_bytes_per_device",
+                          "dl4j_sharding_allgather_bytes_per_step",
+                          "dl4j_sharding_reducescatter_bytes_per_step"):
+                v = gauge(gname)
+                if v is not None:
+                    leg[gname.replace("dl4j_sharding_", "")] = v
+        legs[name] = leg
+    sh = legs["sharded"]
+    upd_total = sh.get("updater_bytes_total")
+    upd_dev = sh.get("updater_bytes_per_device")
+    shrink = (round(upd_dev / upd_total, 4)
+              if upd_total and upd_dev else None)
+    return {
+        "metric": f"wide-MLP fit() samples/sec, replica vs FSDP "
+                  f"(fsdp={fsdp_degree})",
+        "value": sh["samples_per_sec"],
+        "unit": "samples/sec (sharded leg)",
+        "fsdp_degree": fsdp_degree,
+        "sharding_active": sh.get("sharding_active", False),
+        "speedup_vs_replica": round(
+            sh["samples_per_sec"]
+            / max(legs["replica"]["samples_per_sec"], 1e-9), 3),
+        "updater_bytes_per_device_over_total": shrink,
+        "updater_shrink_near_1_over_fsdp":
+            (shrink is not None
+             and shrink <= 1.0 / fsdp_degree * 1.5) if fsdp_degree > 1
+            else None,
         **legs,
     }
 
@@ -1168,6 +1322,7 @@ def _run_configs(result):
 
     devices, backend_info = acquire_backend()
     result.update(backend_info)
+    result["machine"] = machine_fingerprint(devices)
     if not devices:
         result["configs"] = {}
         return
@@ -1220,6 +1375,7 @@ def _run_configs(result):
         ("bench_pipeline", bench_pipeline),
         ("bench_serving", bench_serving),
         ("bench_resilience", bench_resilience),
+        ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
         ("word2vec", bench_word2vec),
@@ -1247,7 +1403,8 @@ def _run_configs(result):
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
                  "bench_pipeline", "bench_serving", "bench_resilience",
-                 "charrnn", "word2vec", "vgg16", "resnet50"]
+                 "bench_sharded", "charrnn", "word2vec", "vgg16",
+                 "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":
@@ -1271,6 +1428,9 @@ def _run_configs(result):
                                          compiled_step.last_compile_sec)
             configs[name]["config_wall_sec"] = round(
                 time.perf_counter() - t0, 1)
+            # every record carries its own fingerprint so a single
+            # config copied out of the JSON stays attributable
+            configs[name].setdefault("machine", result["machine"])
             log(f"{name}: {configs[name]['value']} {configs[name]['unit']} "
                 f"({time.perf_counter() - t0:.1f}s)")
         except Exception as e:

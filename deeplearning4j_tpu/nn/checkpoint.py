@@ -111,6 +111,17 @@ def validate_checkpoint(path) -> dict:
     return conf
 
 
+def _sharding_meta(model):
+    """Mesh + per-param sharding description for the manifest (None for
+    replica-style models) — parallel/fsdp.sharding_manifest, guarded so
+    metadata can never break a save."""
+    try:
+        from deeplearning4j_tpu.parallel import fsdp
+        return fsdp.sharding_manifest(model)
+    except Exception:
+        return None
+
+
 def _count_fallback() -> None:
     try:
         from deeplearning4j_tpu import monitor
@@ -182,7 +193,15 @@ class CheckpointListener(TrainingListener):
                 "epoch": epochs_completed,
                 "iteration_in_epoch": iteration_in_epoch,
                 "timestamp": int(time.time() * 1000),
-                "model_class": type(model).__name__}
+                "model_class": type(model).__name__,
+                # mesh/sharding the params were laid out on at save time
+                # (None = replicated everywhere, which is also what
+                # manifests from before this field implied — readers use
+                # .get() so both load identically).  The coefficients in
+                # the zip are ALWAYS the gathered flat host vector, so a
+                # checkpoint restores onto any mesh; this records where
+                # it came from for the reshard log/metrics.
+                "sharding": _sharding_meta(model)}
         self._update_manifest(meta)
         # legacy single-entry index, kept for older readers
         _atomic_write_text(self.dir / "checkpoint_index.json",
@@ -246,11 +265,12 @@ def _checkpoint_meta(directory, path: Path) -> dict:
     m = _CKPT_RE.search(path.name)
     meta = {"file": path.name,
             "iteration": int(m.group(1)) if m else 0,
-            "epoch": None, "iteration_in_epoch": None}
+            "epoch": None, "iteration_in_epoch": None, "sharding": None}
     for e in read_manifest(directory):
         if e.get("file") == path.name:
             meta.update({k: e.get(k, meta.get(k)) for k in
-                         ("epoch", "iteration_in_epoch", "model_class")})
+                         ("epoch", "iteration_in_epoch", "model_class",
+                          "sharding")})
             return meta
     idx = Path(directory) / "checkpoint_index.json"
     if idx.exists():
@@ -341,9 +361,20 @@ def restore_into(model, directory, load_updater: bool = True
         raise ValueError(
             f"checkpoint in {directory} holds a {type(loaded).__name__}, "
             f"cannot resume a {type(model).__name__} from it")
-    model.set_params(loaded.params())
-    if load_updater and getattr(loaded, "opt_states", None) is not None:
-        model.set_updater_state_flat(loaded.updater_state_flat())
+    # set_params/set_updater_state_flat redistribute the flat host
+    # vector onto the restoring model's OWN mesh (or plain single-device
+    # arrays) — the host-side reshard that makes a checkpoint written on
+    # one mesh resume on any other
+    from deeplearning4j_tpu import monitor as _monitor
+    with _monitor.span("checkpoint/restore", phase="reshard"):
+        model.set_params(loaded.params())
+        if load_updater and getattr(loaded, "opt_states", None) is not None:
+            model.set_updater_state_flat(loaded.updater_state_flat())
+    try:
+        from deeplearning4j_tpu.parallel import fsdp
+        fsdp.note_reshard(model, meta.get("sharding"))
+    except Exception:
+        pass
     model.iteration = loaded.iteration
     model.epoch = getattr(loaded, "epoch", 0)
     _fast_forward_rng(model)

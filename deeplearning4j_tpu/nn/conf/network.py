@@ -90,6 +90,20 @@ class GlobalConf:
     ft_resume: bool = False
     ft_reader_retries: int = 0
     ft_checkpoint_dir: Optional[str] = None
+    # Sharded training (parallel/fsdp.py): ``sharding_enabled`` makes
+    # fit() train FSDP-style on the device mesh — the batch shards over
+    # data×fsdp, large params and their updater state shard over the
+    # ``fsdp`` axis (ZeRO weight-update sharding: reduce-scatter grads →
+    # per-shard updater → all-gather params, arXiv 2004.13336), arrays
+    # under ``sharding_replicate_below`` elements stay replicated.
+    # data=-1 means "all remaining devices".  Degrades to replica-style
+    # on a single device or an unsatisfiable mesh.  TBPTT nets ignore
+    # sharding (time-segmented stepping keeps replica semantics).
+    sharding_enabled: bool = False
+    sharding_data: int = -1
+    sharding_fsdp: int = 1
+    sharding_model: int = 1
+    sharding_replicate_below: int = 2048
 
 
 _MERGE_FIELDS = [
@@ -325,6 +339,33 @@ class Builder:
             self._g.ft_reader_retries = max(0, int(reader_retries))
         if checkpoint_dir is not None:
             self._g.ft_checkpoint_dir = str(checkpoint_dir)
+        return self
+
+    def sharding(self, data: Optional[int] = None,
+                 fsdp: Optional[int] = None,
+                 model: Optional[int] = None,
+                 replicate_below: Optional[int] = None,
+                 enabled: bool = True):
+        """Promote fit() to sharded (FSDP/ZeRO) training on the device
+        mesh (docs/PERFORMANCE.md "Sharded training"): the global batch
+        shards over ``data``×``fsdp`` devices, large weight matrices AND
+        their updater state shard over ``fsdp`` (reduce-scatter grads →
+        per-shard updater update → all-gather params inside the one
+        compiled step), ``model`` adds Megatron-style tensor
+        parallelism, and arrays under ``replicate_below`` elements
+        (biases, BN stats) stay replicated.  ``data=-1`` (default)
+        takes all remaining devices.  On a single device or an
+        unsatisfiable mesh the conf is inert — fit() stays
+        replica-style with identical numerics."""
+        self._g.sharding_enabled = bool(enabled)
+        if data is not None:
+            self._g.sharding_data = int(data)
+        if fsdp is not None:
+            self._g.sharding_fsdp = int(fsdp)
+        if model is not None:
+            self._g.sharding_model = int(model)
+        if replicate_below is not None:
+            self._g.sharding_replicate_below = max(0, int(replicate_below))
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

@@ -278,6 +278,7 @@ class ComputationGraph:
         normalization, LR schedule, learning rule).  Shared by the fused
         train step and the external-gradients path (apply_gradients)."""
         g = self.conf.global_conf
+        plan = getattr(self, "_sharding_plan", None)
         new_params, new_opts = {}, {}
         for name in self.order:
             gi = grads[name]
@@ -292,6 +293,10 @@ class ComputationGraph:
                 new_params[name] = params[name]
                 new_opts[name] = opts[name]
                 continue
+            if plan is not None:
+                # ZeRO reduce-scatter point — see
+                # MultiLayerNetwork._apply_updates
+                gi = plan.constrain_grads(gi)
             if layer is not None:
                 gi = upd_ops.normalize_gradient(
                     gi, layer.gradient_normalization,
@@ -312,6 +317,11 @@ class ComputationGraph:
         return new_params, new_opts
 
     def _build_step(self):
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            return fsdp.jit_sharded_step(self._build_step_raw(), plan,
+                                         self.net_params, self.opt_states)
         return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
 
     # ------------------------------------------------------------------
@@ -339,6 +349,10 @@ class ComputationGraph:
         fuse = (max(1, int(fused_steps))
                 if (self.conf.backprop_type != "truncatedbptt"
                     and self.conf.global_conf.iterations <= 1) else 1)
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        self._ensure_sharding()
         # crash-safe resume (conf.fault_tolerance(resume=True)) — same
         # contract as MultiLayerNetwork.fit: restore the newest valid
         # checkpoint, then skip the already-trained epochs/batches
@@ -374,6 +388,8 @@ class ComputationGraph:
                 and getattr(it, "async_supported", lambda: True)()):
             bucket_on = self._bucket_train_enabled()
             gg = self.conf.global_conf
+            plan = getattr(self, "_sharding_plan", None)
+            min_mult = plan.n_data if plan is not None else 1
 
             def to_mds(item):
                 if isinstance(item, DataSet):
@@ -381,13 +397,16 @@ class ComputationGraph:
                         [item.features], [item.labels],
                         [item.features_mask], [item.labels_mask])
                 if bucket_on:  # pad on the worker, off the critical path
-                    item = bucketing.bucket_train_multidataset(item, gg)[0]
+                    # (lifted to a data-degree multiple under sharding)
+                    item = bucketing.bucket_train_multidataset(
+                        item, gg, min_multiple=min_mult)[0]
                 return item
             it = AsyncMultiDataSetIterator(
                 it, queue_size=g.pipeline_prefetch,
                 workers=g.pipeline_workers,
                 staging_depth=g.pipeline_staging_depth,
-                device_put=True, transform=to_mds,
+                # sharded fit scatters batches across the mesh itself
+                device_put=(plan is None), transform=to_mds,
                 reader_retry=reader_retry_from_conf(g))
         # MultiDataSetIterator protocol when available; plain
         # __iter__-only iterables (duck-typed inputs) still work
@@ -468,6 +487,12 @@ class ComputationGraph:
         if self.net_params is None:
             self.init()
         self._check_trace_token()
+        if getattr(self, "_sharding_plan", None) is not None:
+            # stacking the multi-head tuple batches for a sharded scan is
+            # not supported yet — per-step keeps exact sharded numerics
+            for m in group:
+                self._fit_batch(m)
+            return
         sizes = [m.num_examples() for m in group]
         # ragged groups become bucket-uniform and stay on the fused scan
         # path instead of degrading to per-step (see MultiLayerNetwork)
@@ -539,10 +564,12 @@ class ComputationGraph:
     def _check_trace_token(self):
         """See MultiLayerNetwork._check_trace_token — retrace when the
         ambient sequence-parallel regime or precision policy changes."""
+        from deeplearning4j_tpu.parallel import fsdp
         from deeplearning4j_tpu.parallel import sequence as seq_ops
         tok = (seq_ops.cache_token(),
                dtype_ops.resolve(self.conf.global_conf.precision),
-               self.conf.global_conf.gradient_checkpointing)
+               self.conf.global_conf.gradient_checkpointing,
+               fsdp.conf_key(self.conf.global_conf))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -551,6 +578,30 @@ class ComputationGraph:
             self._score_ex_fn = None
             self._fused_fns = None
             self.compile_telemetry.invalidate()
+
+    def _ensure_sharding(self):
+        """Activate/deactivate the conf-declared sharding plan — see
+        MultiLayerNetwork._ensure_sharding (same contract over the
+        vertex-dict pytrees)."""
+        from deeplearning4j_tpu.parallel import fsdp
+        plan = (None if self.conf.backprop_type == "truncatedbptt"
+                else fsdp.plan_from_conf(self.conf.global_conf))
+        if fsdp.plan_key(plan) == fsdp.plan_key(
+                getattr(self, "_sharding_plan", None)):
+            return
+        self._sharding_plan = plan
+        self._step_fn = None
+        self._fused_fns = None
+        if plan is not None and self.net_params is not None:
+            fsdp.place_model(plan, self)
+
+    def _replace_on_mesh(self):
+        """Re-commit params/updater/state to the active plan's layout
+        after a host-side overwrite (set_params / checkpoint restore)."""
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            fsdp.place_model(plan, self)
 
     # ------------------------------------------------------------------
     # Shape bucketing (ops/bucketing.py) — see MultiLayerNetwork
@@ -581,19 +632,34 @@ class ComputationGraph:
             self._step_fn = self._build_step()
         self.last_batch_size = mds.num_examples()
         t_step = time.perf_counter()
-        with monitor.span("fit/step", phase="bucket"):
-            mds, bucket = self._maybe_bucket_train(mds)
-        with monitor.span("fit/step", phase="h2d"):
-            xs = tuple(jnp.asarray(f) for f in mds.features)
-            ys = tuple(jnp.asarray(l) for l in mds.labels)
-            fm = (tuple(None if m is None else jnp.asarray(m)
-                        for m in mds.features_masks)
-                  if mds.features_masks is not None else None)
-            lm = (tuple(None if m is None else jnp.asarray(m)
-                        for m in mds.labels_masks)
-                  if mds.labels_masks is not None else None)
-        self.compile_telemetry.record("train_step", (xs, ys, fm, lm),
-                                      bucket=bucket)
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            with monitor.span("fit/step", phase="bucket"):
+                norm = fsdp.normalize_batch(self, mds, plan.n_data,
+                                            is_graph=True)
+            if norm is None:
+                return
+            batch, n, bucket = norm
+            self.last_batch_size = n
+            self.compile_telemetry.record("sharded_step", batch,
+                                          bucket=bucket)
+            with monitor.span("fit/step", phase="shard_h2d"):
+                xs, ys, fm, lm = fsdp.shard_put(plan, batch)
+        else:
+            with monitor.span("fit/step", phase="bucket"):
+                mds, bucket = self._maybe_bucket_train(mds)
+            with monitor.span("fit/step", phase="h2d"):
+                xs = tuple(jnp.asarray(f) for f in mds.features)
+                ys = tuple(jnp.asarray(l) for l in mds.labels)
+                fm = (tuple(None if m is None else jnp.asarray(m)
+                            for m in mds.features_masks)
+                      if mds.features_masks is not None else None)
+                lm = (tuple(None if m is None else jnp.asarray(m)
+                            for m in mds.labels_masks)
+                      if mds.labels_masks is not None else None)
+            self.compile_telemetry.record("train_step", (xs, ys, fm, lm),
+                                          bucket=bucket)
         self._key, sub = jax.random.split(self._key)
         with monitor.span("fit/step", phase="jit_call"):
             (self.net_params, self.net_state, self.opt_states,
@@ -878,6 +944,7 @@ class ComputationGraph:
         plist = [self.net_params[n] for n in self.order]
         new = param_util.unflatten(flat, plist)
         self.net_params = {n: new[i] for i, n in enumerate(self.order)}
+        self._replace_on_mesh()
 
     def num_params(self) -> int:
         return param_util.num_params([self.net_params[n] for n in self.order])
@@ -916,7 +983,13 @@ class ComputationGraph:
             [self.opt_states[n] for n in self.order])
         if not leaves:
             return jnp.zeros((0,), jnp.float32)
-        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+        # host-side gather for concrete arrays: op-by-op concatenate
+        # over the mixed NamedShardings an FSDP model carries
+        # miscomputes (see nn/params.flatten)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            return jnp.concatenate([jnp.ravel(l) for l in leaves])
+        return jnp.asarray(np.concatenate(
+            [np.ravel(np.asarray(l)) for l in leaves]))
 
     def set_updater_state_flat(self, flat) -> None:
         ordered = [self.opt_states[n] for n in self.order]
@@ -929,6 +1002,7 @@ class ComputationGraph:
             off += size
         restored = jax.tree_util.tree_unflatten(treedef, out)
         self.opt_states = {n: restored[i] for i, n in enumerate(self.order)}
+        self._replace_on_mesh()
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

@@ -230,10 +230,12 @@ class MultiLayerNetwork:
         (parallel/sequence.sequence_mesh — shard_map collectives are baked
         into the traced program) or the mixed-precision policy
         (ops/dtypes.set_default_policy — compute dtypes are baked in too)."""
+        from deeplearning4j_tpu.parallel import fsdp
         from deeplearning4j_tpu.parallel import sequence as seq_ops
         tok = (seq_ops.cache_token(),
                dtype_ops.resolve(self.conf.global_conf.precision),
-               self.conf.global_conf.gradient_checkpointing)
+               self.conf.global_conf.gradient_checkpointing,
+               fsdp.conf_key(self.conf.global_conf))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -241,6 +243,34 @@ class MultiLayerNetwork:
             self._score_ex_fn = None
             self._fused_fns = None
             self.compile_telemetry.invalidate()
+
+    def _ensure_sharding(self):
+        """Activate (or deactivate) the conf-declared sharding plan
+        (conf.sharding(...), parallel/fsdp.py): resolve the mesh, place
+        params/updater state with their NamedShardings and invalidate
+        the cached step so it re-jits with in/out_shardings.  A no-op —
+        replica-style training, byte-identical numerics — when sharding
+        is off, only one device is visible, or the net trains TBPTT."""
+        from deeplearning4j_tpu.parallel import fsdp
+        plan = (None if self.conf.backprop_type == "truncatedbptt"
+                else fsdp.plan_from_conf(self.conf.global_conf))
+        if fsdp.plan_key(plan) == fsdp.plan_key(
+                getattr(self, "_sharding_plan", None)):
+            return
+        self._sharding_plan = plan
+        self._step_fn = None
+        self._fused_fns = None
+        if plan is not None and self.net_params is not None:
+            fsdp.place_model(plan, self)
+
+    def _replace_on_mesh(self):
+        """Re-commit params/updater/state to the active plan's layout
+        after a host-side overwrite (set_params / checkpoint restore) —
+        the host-side reshard that makes checkpoints mesh-tolerant."""
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            fsdp.place_model(plan, self)
 
     # ------------------------------------------------------------------
     # Shape bucketing (ops/bucketing.py)
@@ -267,6 +297,11 @@ class MultiLayerNetwork:
     # The jitted train step — ONE XLA computation per step
     # ------------------------------------------------------------------
     def _build_step(self):
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            return fsdp.jit_sharded_step(self._build_step_raw(), plan,
+                                         self.net_params, self.opt_states)
         return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
 
     def _build_step_raw(self):
@@ -326,6 +361,7 @@ class MultiLayerNetwork:
         frozen-layer gating.  Shared by the fused train step and the
         external-gradients path (apply_gradients)."""
         g = self.conf.global_conf
+        plan = getattr(self, "_sharding_plan", None)
         new_params, new_opts = [], []
         for i, layer in enumerate(self.layers):
             gi = grads[i]
@@ -337,6 +373,13 @@ class MultiLayerNetwork:
                 new_params.append(params[i])
                 new_opts.append(opts[i])
                 continue
+            if plan is not None:
+                # ZeRO weight-update sharding (arXiv 2004.13336): pin
+                # each gradient to its param's fsdp layout so XLA lowers
+                # the data-parallel reduction as reduce-scatter into
+                # shards; the updater below then runs per-shard and the
+                # next forward all-gathers the updated params.
+                gi = plan.constrain_grads(gi)
             gi = upd_ops.normalize_gradient(
                 gi, layer.gradient_normalization,
                 layer.gradient_normalization_threshold or 1.0)
@@ -428,6 +471,7 @@ class MultiLayerNetwork:
             self.init()
         bucketing.maybe_enable_persistent_cache()
         self._check_trace_token()
+        self._ensure_sharding()
         if self._step_fn is None:
             self._step_fn = self._build_step()
 
@@ -441,19 +485,27 @@ class MultiLayerNetwork:
         skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
         if (g.pipeline_workers > 0 and it.async_supported()
                 and not isinstance(it, AsyncDataSetIterator)):
+            plan = getattr(self, "_sharding_plan", None)
             transform = None
             if self._bucket_train_enabled():
                 gg = self.conf.global_conf
                 # bucket on a worker thread, BEFORE device_put: the
                 # H2D transfer is then already bucket-shaped and the
-                # engine's own bucketing hits its no-op fast path
+                # engine's own bucketing hits its no-op fast path.
+                # Under a sharding plan the bucket is lifted to a
+                # data-degree multiple so the sharded normalize is a
+                # no-op too.
+                min_mult = plan.n_data if plan is not None else 1
                 transform = lambda d: bucketing.bucket_train_dataset(  # noqa: E731
-                    d, gg)[0]
+                    d, gg, min_multiple=min_mult)[0]
             it = AsyncDataSetIterator(
                 it, queue_size=g.pipeline_prefetch,
                 workers=g.pipeline_workers,
                 staging_depth=g.pipeline_staging_depth,
-                device_put=True, transform=transform,
+                # sharded fit scatters each batch across the mesh itself
+                # (fsdp.shard_put); staging to one device first would
+                # just bounce the rows device→host→mesh
+                device_put=(plan is None), transform=transform,
                 reader_retry=reader_retry_from_conf(g))
 
         # fused path steps the updater once per batch; a conf with
@@ -541,6 +593,9 @@ class MultiLayerNetwork:
         return jax.jit(k_steps, donate_argnums=(0, 1, 2))
 
     def _fit_fused_group(self, group):
+        if getattr(self, "_sharding_plan", None) is not None:
+            self._fit_fused_group_sharded(group)
+            return
         sizes = [d.num_examples() for d in group]
         # bucketing makes ragged groups (mixed batch sizes / RNN time
         # lengths, the tail of any real stream) bucket-uniform so they
@@ -596,6 +651,66 @@ class MultiLayerNetwork:
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration)
 
+    def _fit_fused_group_sharded(self, group):
+        """fused_steps=K under a sharding plan: each batch is padded to
+        the data degree, the group stacks along a leading scan axis with
+        the scan-aware sharding P(None, ('data','fsdp')), and the
+        engine's own fused builder runs — params/updater are committed
+        with their mesh shardings so jit composes the per-step
+        reduce-scatter/all-gather with the scan without a wrapper-side
+        re-implementation."""
+        from deeplearning4j_tpu.parallel import fsdp
+        plan = self._sharding_plan
+        norms = [fsdp.normalize_batch(self, d, plan.n_data, is_graph=False)
+                 for d in group]
+        if any(n is None for n in norms):
+            for d in group:
+                self._fit_batch(d)
+            return
+
+        def sig(batch):
+            leaves, treedef = jax.tree_util.tree_flatten(batch)
+            return (treedef, tuple((a.shape, a.dtype) for a in leaves))
+        if len({sig(b) for b, _, _ in norms}) != 1:
+            for d in group:   # mixed shapes can't stack — per-step
+                self._fit_batch(d)
+            return
+        # first-ever launch runs ONE batch per-step so carried state
+        # reaches its steady structure before it becomes a scan carry
+        if getattr(self, "_fused_fns", None) is None:
+            self._fused_fns = {}
+            self._fit_batch(group[0])
+            group, norms = group[1:], norms[1:]
+            if not norms:
+                return
+        k = len(norms)
+        if k not in self._fused_fns:
+            self._fused_fns[k] = self._build_fused_step(k)
+        t_step = time.perf_counter()
+        with monitor.span("fit/step", phase="shard_h2d"):
+            xs, ys, fms, lms = fsdp.stack_for_scan(
+                plan, [b for b, _, _ in norms])
+        self.compile_telemetry.record(f"fused_step_k{k}",
+                                      (xs, ys, fms, lms))
+        self._key, sub = jax.random.split(self._key)
+        with monitor.span("fit/step", phase="jit_call"):
+            (self.net_params, self.net_state, self.opt_states,
+             score) = self._fused_fns[k](
+                self.net_params, self.net_state, self.opt_states,
+                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
+                sub)
+        with monitor.span("fit/step", phase="block_until_ready"):
+            jax.block_until_ready(score)
+        self._strip_rnn_state()
+        self._score = score
+        self.iteration += k
+        self.last_batch_size = sum(n for _, n, _ in norms)
+        monitor.record_fit_step(self.last_batch_size,
+                                time.perf_counter() - t_step, score)
+        with monitor.span("fit/step", phase="listeners"):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
+
     def _fit_batch(self, ds):
         g = self.conf.global_conf
         self.last_batch_size = ds.num_examples()
@@ -603,21 +718,41 @@ class MultiLayerNetwork:
             self._fit_tbptt(ds)
             return
         t_step = time.perf_counter()
-        with monitor.span("fit/step", phase="bucket"):
-            ds, bucket = self._maybe_bucket_train(ds)
-        self.compile_telemetry.record(
-            "train_step", (ds.features, ds.labels, ds.features_mask,
-                           ds.labels_mask), bucket=bucket)
-        with monitor.span("fit/step", phase="h2d"):
-            # no-op when the async iterator already device_put the batch;
-            # otherwise this is the host→device transfer, timed apart
-            # from the jitted call it used to hide inside
-            feats = jnp.asarray(ds.features)
-            labels = jnp.asarray(ds.labels)
-            fmask = (None if ds.features_mask is None
-                     else jnp.asarray(ds.features_mask))
-            lmask = (None if ds.labels_mask is None
-                     else jnp.asarray(ds.labels_mask))
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel import fsdp
+            with monitor.span("fit/step", phase="bucket"):
+                # pad (mask-exact) or trim the batch to the data degree;
+                # shape bucketing, when on, subsumes this by lifting the
+                # bucket to a data-degree multiple
+                norm = fsdp.normalize_batch(self, ds, plan.n_data,
+                                            is_graph=False)
+            if norm is None:
+                return
+            batch, n, bucket = norm
+            self.last_batch_size = n
+            self.compile_telemetry.record("sharded_step", batch,
+                                          bucket=bucket)
+            with monitor.span("fit/step", phase="shard_h2d"):
+                # host→mesh scatter: each device receives only its batch
+                # shard (the sharded step's in_shardings layout)
+                feats, labels, fmask, lmask = fsdp.shard_put(plan, batch)
+        else:
+            with monitor.span("fit/step", phase="bucket"):
+                ds, bucket = self._maybe_bucket_train(ds)
+            self.compile_telemetry.record(
+                "train_step", (ds.features, ds.labels, ds.features_mask,
+                               ds.labels_mask), bucket=bucket)
+            with monitor.span("fit/step", phase="h2d"):
+                # no-op when the async iterator already device_put the
+                # batch; otherwise this is the host→device transfer,
+                # timed apart from the jitted call it used to hide inside
+                feats = jnp.asarray(ds.features)
+                labels = jnp.asarray(ds.labels)
+                fmask = (None if ds.features_mask is None
+                         else jnp.asarray(ds.features_mask))
+                lmask = (None if ds.labels_mask is None
+                         else jnp.asarray(ds.labels_mask))
         for _ in range(max(1, g.iterations)):
             self._key, sub = jax.random.split(self._key)
             with monitor.span("fit/step", phase="jit_call"):
@@ -1053,6 +1188,7 @@ class MultiLayerNetwork:
 
     def set_params(self, flat) -> None:
         self.net_params = param_util.unflatten(flat, self.net_params)
+        self._replace_on_mesh()
 
     def num_params(self) -> int:
         return param_util.num_params(self.net_params)
@@ -1087,7 +1223,13 @@ class MultiLayerNetwork:
         leaves = jax.tree_util.tree_leaves(self.opt_states)
         if not leaves:
             return jnp.zeros((0,), jnp.float32)
-        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+        # host-side gather for concrete arrays: op-by-op concatenate
+        # over the mixed NamedShardings an FSDP model carries
+        # miscomputes (see nn/params.flatten)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            return jnp.concatenate([jnp.ravel(l) for l in leaves])
+        return jnp.asarray(np.concatenate(
+            [np.ravel(np.asarray(l)) for l in leaves]))
 
     def set_updater_state_flat(self, flat) -> None:
         leaves, treedef = jax.tree_util.tree_flatten(self.opt_states)
@@ -1098,6 +1240,7 @@ class MultiLayerNetwork:
             out.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
             off += n
         self.opt_states = jax.tree_util.tree_unflatten(treedef, out)
+        self._replace_on_mesh()
 
     # ------------------------------------------------------------------
     def evaluate(self, iterator_or_dataset):

@@ -34,14 +34,26 @@ def num_params(params: List[dict]) -> int:
 
 
 def flatten(params: List[dict]) -> jnp.ndarray:
-    """→ 1-D flat vector in canonical order (the reference's params())."""
-    flats = []
-    for lp in params:
-        for k in ordered_keys(lp):
-            flats.append(jnp.ravel(lp[k]))
-    if not flats:
+    """→ 1-D flat vector in canonical order (the reference's params()).
+
+    Concrete arrays are gathered on the HOST: the leaves of an
+    FSDP-trained model carry heterogeneous NamedShardings, and op-by-op
+    ``jnp.concatenate`` over mixed committed shardings miscomputes on
+    multi-axis meshes (observed on jax 0.4.37, CPU 2x4 data×fsdp mesh —
+    values silently wrong, not an error).  Per-leaf ``np.asarray`` is
+    the always-correct gather, and the flat vector is the portable
+    cross-mesh checkpoint format anyway (parallel/fsdp.py).  Under a
+    jit trace (the line-search solvers flatten inside their value-and-
+    grad closures) leaves are tracers — there the compiled concatenate
+    is both required and correct."""
+    import jax
+    leaves = [lp[k] for lp in params for k in ordered_keys(lp)]
+    if not leaves:
         return jnp.zeros((0,), jnp.float32)
-    return jnp.concatenate(flats)
+    if any(isinstance(l, jax.core.Tracer) for l in leaves):
+        return jnp.concatenate([jnp.ravel(l) for l in leaves])
+    return jnp.asarray(np.concatenate(
+        [np.ravel(np.asarray(l)) for l in leaves]))
 
 
 def unflatten(flat, template: List[dict]) -> List[dict]:

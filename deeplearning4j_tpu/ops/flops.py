@@ -1,0 +1,129 @@
+"""Per-layer analytic FLOPs model — the MFU estimator's numerator.
+
+``compiled.cost_analysis()`` (bench.py) is the preferred FLOPs source
+when XLA exposes it, but it needs the compiled step in hand; monitoring
+and the sharded bench want an estimate computable from the MODEL alone,
+so MFU can be derived from the registry's ``dl4j_phase_seconds``
+step spans after any fit (ROADMAP item 5).  This walks the layer stack
+with the same InputType chain the engines use and counts matmul FLOPs
+(2·M·N·K per GEMM); elementwise work (activations, BN, pooling) is
+ignored — on MXU-class hardware it is noise next to the GEMMs.
+
+Backward pass ≈ 2× forward (grad wrt activations + grad wrt weights),
+so one train step ≈ 3× forward — the standard roofline convention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+TRAIN_STEP_MULTIPLIER = 3.0  # forward + ~2x backward
+
+
+def _layer_forward_flops(layer, params: dict, cur, batch: int) -> float:
+    """One layer's forward GEMM FLOPs for ``batch`` examples given its
+    initialized params and the incoming InputType ``cur``."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    t = 1
+    if cur is not None and getattr(cur, "kind", None) == "rnn":
+        t = int(cur.timesteps or 1)
+    if isinstance(layer, L.ConvolutionLayer):
+        w = params.get("W")
+        if w is None:
+            return 0.0
+        n_out, c_in, kh, kw = (int(d) for d in w.shape)
+        try:
+            out_t = layer.output_type(cur)
+            oh, ow = int(out_t.height), int(out_t.width)
+        except Exception:
+            oh = ow = 1
+        return 2.0 * batch * oh * ow * n_out * c_in * kh * kw
+    if isinstance(layer, (L.GravesBidirectionalLSTM,)):
+        # two directions, each: 4 gates x (input + recurrent GEMM)
+        flops = 0.0
+        for wk, rk in (("f_W", "f_RW"), ("b_W", "b_RW")):
+            w, r = params.get(wk), params.get(rk)
+            if w is not None:
+                flops += 2.0 * batch * t * int(np.prod(w.shape))
+            if r is not None:
+                flops += 2.0 * batch * t * int(np.prod(r.shape))
+        return flops
+    if isinstance(layer, L.GravesLSTM):
+        w, r = params.get("W"), params.get("RW")
+        flops = 0.0
+        if w is not None:
+            flops += 2.0 * batch * t * int(np.prod(w.shape))
+        if r is not None:
+            flops += 2.0 * batch * t * int(np.prod(r.shape))
+        return flops
+    if isinstance(layer, L.EmbeddingLayer):
+        return 0.0  # a gather, not a GEMM
+    # generic dense-like fallback: every >=2-D param is a GEMM operand
+    # applied once per example (per timestep on rnn inputs) — exact for
+    # DenseLayer/OutputLayer, a reasonable bound for attention/MoE
+    return sum(2.0 * batch * t * int(np.prod(v.shape))
+               for v in params.values() if getattr(v, "ndim", 0) >= 2)
+
+
+def forward_flops(model, batch: int) -> Optional[float]:
+    """Forward-pass FLOPs for one batch, or None when the model shape
+    can't be walked (un-initialized, exotic graph)."""
+    if getattr(model, "net_params", None) is None:
+        return None
+    if type(model).__name__ == "MultiLayerNetwork":
+        try:
+            cur = model._input_type_chain_start()
+        except Exception:
+            cur = None
+        total = 0.0
+        for i, layer in enumerate(model.layers):
+            if cur is not None and i in model.conf.preprocessors:
+                try:
+                    cur = model.conf.preprocessors[i].output_type(cur)
+                except Exception:
+                    cur = None
+            total += _layer_forward_flops(layer, model.net_params[i],
+                                          cur, batch)
+            if cur is not None:
+                try:
+                    cur = layer.output_type(cur)
+                except Exception:
+                    cur = None
+        return total
+    # ComputationGraph / anything else: GEMM-operand sum over the param
+    # table (no per-vertex InputType walk; timesteps not accounted)
+    try:
+        table = model.param_table()
+    except Exception:
+        return None
+    return sum(2.0 * batch * int(np.prod(v.shape))
+               for v in table.values() if getattr(v, "ndim", 0) >= 2)
+
+
+def train_step_flops(model, batch: int) -> Optional[float]:
+    """FLOPs for one optimizer step on ``batch`` examples (≈3× forward)."""
+    fwd = forward_flops(model, batch)
+    return None if fwd is None else TRAIN_STEP_MULTIPLIER * fwd
+
+
+def mfu(model, batch: int, step_seconds: float,
+        peak_flops: Optional[float]) -> Optional[dict]:
+    """Model-FLOPs-utilization estimate: analytic step FLOPs over
+    measured step seconds, against the chip's published peak.  Returns
+    the full derivation so a bench record is explainable on its own."""
+    if not peak_flops or not step_seconds or step_seconds <= 0:
+        return None
+    flops = train_step_flops(model, batch)
+    if not flops:
+        return None
+    achieved = flops / step_seconds
+    return {
+        "mfu_estimate": round(achieved / peak_flops, 4),
+        "flops_per_step_model": flops,
+        "achieved_flops_per_sec": achieved,
+        "peak_flops_used": peak_flops,
+        "flops_source": "per-layer analytic model (ops/flops.py), "
+                        "train step = 3x forward GEMMs",
+    }

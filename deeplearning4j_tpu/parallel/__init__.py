@@ -10,5 +10,7 @@ GSPMD over DCN), inside the single jitted train step.
 """
 
 from deeplearning4j_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel import fsdp  # noqa: F401
+from deeplearning4j_tpu.parallel.fsdp import ShardingPlan  # noqa: F401
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper  # noqa: F401
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -82,9 +82,14 @@ def data_sharded(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("data", "fsdp")))
 
 
-def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...]) -> NamedSharding:
+def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...],
+                   replicate_below: int = 0) -> NamedSharding:
     """Parameter layout over the mesh:
 
+    * arrays with fewer than ``replicate_below`` elements (biases, BN
+      stats, LayerNorm scales) are REPLICATED outright: sharding a
+      few-KB vector buys nothing and costs an all-gather per step
+      (the ZeRO paper's small-tensor exemption, arXiv 2004.13336 §4).
     * 'model' (tensor parallelism): the LAST axis of ≥2-D params (a
       matmul's output features) shards over 'model' — GSPMD then
       partitions the matmuls and inserts the activation collectives
@@ -99,6 +104,8 @@ def param_sharding(mesh: Mesh, arr_shape: Tuple[int, ...]) -> NamedSharding:
       'fsdp'.
     * 'data': always replicated.
     """
+    if replicate_below and int(np.prod(arr_shape or (1,))) < replicate_below:
+        return NamedSharding(mesh, P())
     fsdp = mesh.shape["fsdp"]
     model = mesh.shape["model"]
     expert = mesh.shape["expert"]

@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.ops import bucketing
+from deeplearning4j_tpu.parallel import fsdp
 from deeplearning4j_tpu.parallel import mesh as mesh_util
 
 
@@ -52,50 +53,42 @@ class ParallelWrapper:
         self._sharded_step = None
         self._sharded_fused = None
         self._local_step = None
-        self.n_data = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+        g = model.conf.global_conf
+        # the wrapper predates conf.sharding(); its explicit mesh wins,
+        # but the small-array replication threshold is honored when the
+        # conf opted into sharding
+        rb = (g.sharding_replicate_below
+              if getattr(g, "sharding_enabled", False) else 0)
+        self.plan = fsdp.plan_from_mesh(self.mesh, replicate_below=rb)
+        self.n_data = self.plan.n_data
 
     # ------------------------------------------------------------------
+    def _adopt_plan(self, plan):
+        """Point the model's grad-constraint/sharding hooks at the
+        wrapper's plan (or None in param-averaging mode, where the
+        vmapped local step must not constrain) so the shared
+        _apply_updates traces against THIS mesh, not a conf-derived
+        one."""
+        m = self.model
+        if fsdp.plan_key(getattr(m, "_sharding_plan", None)) != \
+                fsdp.plan_key(plan):
+            m._sharding_plan = plan
+            m._step_fn = None
+            m._fused_fns = None
+
     def _build_sharded_step(self):
         """Mode 1: batch sharded over 'data', params replicated/FSDP;
-        XLA inserts the gradient psum."""
+        XLA inserts the gradient psum (reduce-scatter under fsdp — see
+        parallel/fsdp.jit_sharded_step)."""
         m = self.model
         if m.net_params is None:
             m.init()
-        base_step = m._build_step_raw()
-
-        repl = mesh_util.replicated(self.mesh)
-        batch_sh = mesh_util.data_sharded(self.mesh)
-        param_sh = jax.tree_util.tree_map(
-            lambda a: mesh_util.param_sharding(self.mesh, a.shape), m.net_params)
-        opt_sh = jax.tree_util.tree_map(
-            lambda a: mesh_util.param_sharding(self.mesh, a.shape), m.opt_states)
-
-        # net_state uses a PREFIX sharding (one sharding for every leaf):
-        # an RNN step's output state gains carried keys (rnn_state) the
-        # input structure doesn't have, so a full-tree spec would pin the
-        # wrong structure for out_shardings
-        step = jax.jit(
-            base_step,
-            in_shardings=(param_sh, repl, opt_sh, batch_sh, batch_sh,
-                          None, None, None, None),
-            out_shardings=(param_sh, repl, opt_sh, repl),
-            donate_argnums=(0, 1, 2))
-        return step
+        return fsdp.jit_sharded_step(m._build_step_raw(), self.plan,
+                                     m.net_params, m.opt_states)
 
     def _place(self):
         """Move model state onto the mesh with the right shardings."""
-        m = self.model
-        repl = mesh_util.replicated(self.mesh)
-        m.net_params = jax.device_put(
-            m.net_params,
-            jax.tree_util.tree_map(
-                lambda a: mesh_util.param_sharding(self.mesh, a.shape), m.net_params))
-        m.opt_states = jax.device_put(
-            m.opt_states,
-            jax.tree_util.tree_map(
-                lambda a: mesh_util.param_sharding(self.mesh, a.shape), m.opt_states))
-        m.net_state = jax.device_put(
-            m.net_state, jax.tree_util.tree_map(lambda a: repl, m.net_state))
+        fsdp.place_model(self.plan, self.model)
 
     # ------------------------------------------------------------------
     def fit(self, iterator, epochs: int = 1):
@@ -115,121 +108,23 @@ class ParallelWrapper:
         return bucketing.pad_supported(self.model)
 
     def _normalize_batch(self, ds, is_graph):
-        """(x, y, fm, lm) host pytrees at a data-degree multiple.  A
-        non-divisible batch is PADDED with cycled real rows whose loss is
-        masked out and the valid rows' mask rescaled, so every example
-        trains and gradients equal the unsharded step exactly (the
-        reference's round-robin feedDataSet trains on every example —
-        ref: parallelism/ParallelWrapper.java:383).  Mask-nonlinear
-        losses fall back to trimming (warned).  Returns (batch, n) with
-        ``n`` the REAL example count, or None when everything would be
-        dropped."""
-        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
-        if is_graph and isinstance(ds, DataSet):
-            # ComputationGraph steps take TUPLES of inputs/labels
-            ds = MultiDataSet([ds.features], [ds.labels],
-                              [ds.features_mask], [ds.labels_mask])
-        n = ds.num_examples()
-        g = self.model.conf.global_conf
-        if getattr(g, "shape_bucketing", False) and self._pad_supported():
-            # shape bucketing subsumes the remainder policy: the batch
-            # bucket is lifted to a data-degree multiple, rows are
-            # cycled and the labels mask rescaled exactly as below —
-            # every sharded launch is then bucket-shaped, so the jitted
-            # sharded step (and the fused scan) compiles once per bucket
-            fn = (bucketing.bucket_train_multidataset
-                  if isinstance(ds, MultiDataSet)
-                  else bucketing.bucket_train_dataset)
-            ds_b, bucket = fn(ds, g, min_multiple=self.n_data)
-            if bucket is not None:
-                batch = self._host_batch(ds_b)
-                tel = getattr(self.model, "compile_telemetry", None)
-                if tel is not None:
-                    tel.record("sharded_step", batch, bucket=bucket)
-                return batch, n
-        rem = n % self.n_data
-        pad_ok = bool(rem) and self._pad_supported()
-        lm_base = None
-        if pad_ok:
-            # The synthesized labels mask takes precedence over the
-            # features-propagated time mask in the step's loss
-            # (multilayer.py loss_fn lm resolution), so when a features
-            # mask exists without a labels mask it must BECOME the base
-            # of the scaled mask — and only when its shape provably
-            # matches the labels' time layout; otherwise trim.
-            if isinstance(ds, MultiDataSet):
-                # container-level None checks are not enough: the
-                # DataSet→MultiDataSet wrap above produces [None] lists,
-                # so compare the ENTRIES
-                def _all_none(t):
-                    return t is None or all(m is None for m in t)
-                if not _all_none(ds.features_masks) \
-                        and _all_none(ds.labels_masks):
-                    pad_ok = False  # multi-input→output mask routing is
-                    # ambiguous; don't guess
-            elif ds.labels_mask is not None:
-                lm_base = np.asarray(ds.labels_mask)
-            elif ds.features_mask is not None:
-                fm_arr = np.asarray(ds.features_mask)
-                y_arr = np.asarray(ds.labels)
-                if fm_arr.ndim == y_arr.ndim - 1 \
-                        and fm_arr.shape == y_arr.shape[:-1]:
-                    lm_base = fm_arr
-                else:
-                    pad_ok = False
-        if pad_ok:
-            target = n + (self.n_data - rem)
-            cyc = lambda a: (None if a is None  # noqa: E731
-                             else self._cycle_rows(a, target))
-            if isinstance(ds, MultiDataSet):
-                lms = (ds.labels_masks
-                       if ds.labels_masks is not None
-                       else (None,) * len(ds.labels))
-                return ((tuple(cyc(a) for a in ds.features),
-                         tuple(cyc(a) for a in ds.labels),
-                         None if ds.features_masks is None else
-                         tuple(cyc(a) for a in ds.features_masks),
-                         tuple(self._scaled_mask(lm, y, n, target)
-                               for lm, y in zip(lms, ds.labels))), n)
-            return ((cyc(ds.features), cyc(ds.labels),
-                     cyc(ds.features_mask),
-                     self._scaled_mask(lm_base, ds.labels,
-                                       n, target)), n)
-        if rem:
-            n_new = (n // self.n_data) * self.n_data
-            self._warn_remainder(n - n_new, n)
-            n = n_new
-            if n == 0:
-                return None
-        if isinstance(ds, MultiDataSet):
-            trim = lambda arrs: (  # noqa: E731
-                None if arrs is None else tuple(
-                    None if a is None else np.asarray(a)[:n] for a in arrs))
-            return (trim(ds.features), trim(ds.labels),
-                    trim(ds.features_masks), trim(ds.labels_masks)), n
-        return ((np.asarray(ds.features)[:n], np.asarray(ds.labels)[:n],
-                 None if ds.features_mask is None
-                 else np.asarray(ds.features_mask)[:n],
-                 None if ds.labels_mask is None
-                 else np.asarray(ds.labels_mask)[:n])), n
+        """Pad-or-trim one batch to the data degree — the shared
+        implementation lives in parallel/fsdp.normalize_batch (the
+        engines' conf.sharding() fit path uses the very same function).
+        Returns (batch, n) with ``n`` the REAL example count, or None
+        when everything would be dropped."""
+        norm = fsdp.normalize_batch(self.model, ds, self.n_data, is_graph,
+                                    owner=self)
+        if norm is None:
+            return None
+        batch, n, bucket = norm
+        if bucket is not None:
+            tel = getattr(self.model, "compile_telemetry", None)
+            if tel is not None:
+                tel.record("sharded_step", batch, bucket=bucket)
+        return batch, n
 
-    @staticmethod
-    def _host_batch(ds):
-        """DataSet/MultiDataSet → the (x, y, fm, lm) host-pytree the
-        sharded step consumes."""
-        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
-        if isinstance(ds, MultiDataSet):
-            tup = lambda arrs: (  # noqa: E731
-                None if arrs is None else tuple(
-                    None if a is None else np.asarray(a) for a in arrs))
-            return (tuple(np.asarray(a) for a in ds.features),
-                    tuple(np.asarray(a) for a in ds.labels),
-                    tup(ds.features_masks), tup(ds.labels_masks))
-        return (np.asarray(ds.features), np.asarray(ds.labels),
-                None if ds.features_mask is None
-                else np.asarray(ds.features_mask),
-                None if ds.labels_mask is None
-                else np.asarray(ds.labels_mask))
+    _host_batch = staticmethod(fsdp.host_batch)
 
     def _run_sharded_step(self, batch, n):
         m = self.model
@@ -297,6 +192,7 @@ class ParallelWrapper:
         is_graph = type(m).__name__ == "ComputationGraph"
         if m.net_params is None:
             m.init()
+        self._adopt_plan(self.plan)
         if self._sharded_step is None:
             self._sharded_step = self._build_sharded_step()
             self._place()
@@ -368,22 +264,11 @@ class ParallelWrapper:
             return jax.make_array_from_process_local_data(batch_sh, arr)
         return jax.device_put(arr, batch_sh)
 
-    def _warn_remainder(self, dropped: int, batch: int):
-        """Non-divisible batches are normally padded+masked so every
-        example trains (round-4 verdict weak #5); this warning only fires
-        on the trim fallback for mask-nonlinear losses
-        (_MASK_NONLINEAR_LOSSES / CenterLoss)."""
-        import warnings
-        if not getattr(self, "_remainder_warned", False):
-            self._remainder_warned = True
-            warnings.warn(
-                f"ParallelWrapper: dropping {dropped} of {batch} examples "
-                f"per batch (batch not divisible by data degree "
-                f"{self.n_data}); pad or resize batches to avoid this",
-                stacklevel=3)
-
     def _fit_param_averaging(self, iterator, epochs: int):
         m = self.model
+        # the vmapped local step must not carry sharding constraints —
+        # params deliberately live replica-per-device here
+        self._adopt_plan(None)
         if m.net_params is None:
             m.init()
         if self._local_step is None:

@@ -498,6 +498,8 @@ def test_bench_dry_run_emits_record_on_cpu():
     assert rec["configs"], "config registry empty"
     assert all(c.get("skipped") == "dry-run" for c in rec["configs"].values())
     assert "bench_pipeline" in rec["configs"]
+    assert "bench_sharded" in rec["configs"]
+    assert rec.get("machine", {}).get("host"), "machine fingerprint missing"
     assert "metrics_registry" in rec
     assert rec.get("platform_forced") == "cpu" or "cpu" in str(
         rec.get("platform", ""))
