@@ -1409,6 +1409,25 @@ def _run_configs(result):
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":
             config_list.insert(2, ("lenet_scan", bench_lenet_scan))
+    if dry_run:
+        # the lint gate rides the dry-run smoke: a rule regression (or a
+        # new unsuppressed finding) fails tier-1 loudly, next to the
+        # record-plumbing checks this path already covers
+        import subprocess
+        import sys as _sys
+        repo = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [_sys.executable, "-m", "deeplearning4j_tpu.analysis",
+             "deeplearning4j_tpu", "tests", "--format", "json"],
+            cwd=repo, capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, (
+            f"dl4j-lint gate failed (exit {proc.returncode}):\n"
+            f"{proc.stdout[-2000:]}{proc.stderr[-1000:]}")
+        lint_summary = json.loads(proc.stdout)["summary"]
+        assert lint_summary["gating"] == 0, lint_summary
+        result["lint"] = {"exit_code": proc.returncode, **lint_summary}
+        log(f"dl4j-lint gate: exit 0, {lint_summary}")
+
     for name, fn in config_list:
         if dry_run:
             configs[name] = {"skipped": "dry-run"}

@@ -1,0 +1,23 @@
+"""dl4j-lint: tracer-safety & concurrency static analysis, plus the
+runtime sanitizer harness.
+
+Static side (``python -m deeplearning4j_tpu.analysis <paths>``): an
+AST-based whole-program linter with codebase-specific rules — host
+syncs and impurity inside jit-reachable functions, retrace traps,
+blocking-under-lock and a whole-program lock-order graph, and
+two-directional drift between registry call sites and the
+docs/OBSERVABILITY.md catalog.  Suppression: ``# dl4j: noqa[RULE]``
+pragmas and the checked-in ``.dl4j-lint-baseline.json``.
+
+Runtime side (:mod:`deeplearning4j_tpu.analysis.sanitizer`): env-gated
+modes (``DL4J_SANITIZE=1``) that arm ``jax.transfer_guard`` around the
+jitted/pjit'd train step, ``jax_debug_nans``, rank-promotion checking
+and a retrace-budget assertion fed by ``CompileTelemetry`` — through
+both fit loops and the serving micro-batcher.
+
+Rule catalog + workflow: docs/ANALYSIS.md.
+"""
+
+from deeplearning4j_tpu.analysis.core import (  # noqa: F401
+    ERROR, INFO, RULES, WARNING, Baseline, Finding, Project, Rule,
+    apply_suppressions, build_project, lint, register, run_rules)

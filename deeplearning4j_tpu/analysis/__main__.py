@@ -1,0 +1,134 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis [paths...]``.
+
+Exit codes: 0 = no gating findings (everything clean, noqa'd, or
+baselined), 1 = gating findings, 2 = usage error.
+
+The default baseline is ``.dl4j-lint-baseline.json`` in the current
+directory when it exists; ``--write-baseline`` rewrites it from the
+current run's unsuppressed findings (the grandfathering workflow:
+fix what you can, noqa what is intentional, baseline the residue,
+then the gate holds the line at zero NEW findings).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from deeplearning4j_tpu.analysis import core
+
+DEFAULT_BASELINE = ".dl4j-lint-baseline.json"
+
+
+def _text_report(findings, verbose: bool) -> str:
+    lines: List[str] = []
+    for f in findings:
+        if (f.suppressed or f.baselined) and not verbose:
+            continue
+        tag = ""
+        if f.suppressed:
+            tag = " [noqa]"
+        elif f.baselined:
+            tag = " [baseline]"
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                     f"[{f.severity}]{tag} {f.message}")
+    return "\n".join(lines)
+
+
+def _summary(findings) -> dict:
+    gating = [f for f in findings if f.gates()]
+    return {
+        "total": len(findings),
+        "gating": len(gating),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "by_rule": {r: sum(1 for f in findings if f.rule == r)
+                    for r in sorted({f.rule for f in findings})},
+    }
+
+
+def main(argv=None) -> int:
+    import os
+
+    parser = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="dl4j-lint: tracer-safety & concurrency static "
+                    "analysis")
+    parser.add_argument("paths", nargs="*",
+                        default=["deeplearning4j_tpu", "tests"],
+                        help="files/directories to lint")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: {DEFAULT_BASELINE}"
+                             " when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from this run's "
+                             "unsuppressed findings and exit 0")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--disable", default="",
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--docs", default=None,
+                        help="observability catalog path (default: "
+                             "docs/OBSERVABILITY.md)")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="also print noqa'd/baselined findings")
+    args = parser.parse_args(argv)
+
+    import deeplearning4j_tpu.analysis.rules  # noqa: F401
+
+    if args.list_rules:
+        for rid in sorted(core.RULES):
+            r = core.RULES[rid]
+            print(f"{rid}  {r.name:<22} [{r.severity}] "
+                  f"{' '.join(r.doc.split())}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline \
+            and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline_path = None
+
+    rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    disabled = [r.strip() for r in args.disable.split(",") if r.strip()]
+
+    findings, _project = core.lint(
+        args.paths, baseline_path=baseline_path, docs_path=args.docs,
+        rule_ids=rule_ids, disabled=disabled)
+
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        core.Baseline.write(path, [f for f in findings if f.gates()])
+        print(f"baseline written: {path} "
+              f"({sum(1 for f in findings if f.gates())} entries)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.to_dict() for f in findings],
+            "summary": _summary(findings),
+        }, indent=1, sort_keys=True))
+    else:
+        report = _text_report(findings, args.verbose)
+        if report:
+            print(report)
+        s = _summary(findings)
+        print(f"dl4j-lint: {s['total']} finding(s) — {s['gating']} "
+              f"gating, {s['suppressed']} noqa'd, {s['baselined']} "
+              "baselined")
+    return 1 if any(f.gates() for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

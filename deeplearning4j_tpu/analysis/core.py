@@ -1,0 +1,835 @@
+"""dl4j-lint core: findings, rule registry, pragmas, baseline, and the
+static project model the rules query.
+
+The hazards this framework exists for are invisible to pytest — a
+host sync inside a jitted step, a lock-order inversion between the
+batcher and the input pipeline, a metric renamed in code but not in
+docs — because they only degrade performance or corrupt numerics on a
+real mesh ("Array Languages Make Neural Networks Fast" attributes most
+framework-level slowdowns to accidental host round-trips and
+re-compilation, both statically detectable).  So the linter builds a
+whole-program model once (:class:`Project`: per-file ASTs, a function
+index with a heuristic call graph, the set of functions reachable from
+``jit``/``pjit``/``scan``/``shard_map`` call sites, every lock object
+and every with-lock region) and each rule walks that model.
+
+Suppression has three layers, in precedence order:
+
+* ``# dl4j: noqa[RULE]`` pragma on the finding's line (a reason string
+  after the bracket is encouraged and kept verbatim in ``--format
+  json`` output);
+* a checked-in baseline file of grandfathered fingerprints
+  (``.dl4j-lint-baseline.json``) — fingerprints are line-number-free
+  (rule / path / enclosing symbol / message) so unrelated edits don't
+  invalidate them;
+* disabling the rule for the run (``--disable``).
+
+Anything not suppressed fails the run (exit 1) unless its severity is
+``info``.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ERROR, WARNING, INFO = "error", "warning", "info"
+
+#: pragma grammar: ``# dl4j: noqa`` (all rules) or
+#: ``# dl4j: noqa[DL4J101]`` / ``# dl4j: noqa[DL4J101,DL4J202] reason``
+_PRAGMA_RE = re.compile(
+    r"#\s*dl4j:\s*noqa(?:\[(?P<rules>[A-Za-z0-9_,\s]+)\])?"
+    r"(?:\s+(?P<reason>\S.*))?")
+
+_ALL = "__all__"
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""          # enclosing function/class qualname
+    suppressed: bool = False  # by a # dl4j: noqa pragma
+    baselined: bool = False   # grandfathered in the baseline file
+    noqa_reason: str = ""
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: stable under
+        unrelated edits to the same file."""
+        return "::".join((self.rule, self.path.replace(os.sep, "/"),
+                          self.symbol, self.message))
+
+    def gates(self) -> bool:
+        """Does this finding fail the run?"""
+        return (not self.suppressed and not self.baselined
+                and self.severity != INFO)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "path": self.path.replace(os.sep, "/"), "line": self.line,
+            "col": self.col, "message": self.message, "symbol": self.symbol,
+            "suppressed": self.suppressed, "baselined": self.baselined,
+            "noqa_reason": self.noqa_reason,
+            "fingerprint": self.fingerprint(),
+        }
+
+
+class Rule:
+    """One lint rule.  Subclasses set the class attrs and implement
+    :meth:`run` over the whole :class:`Project` (every rule here is
+    whole-program: tracer rules need the jit-reachability set, the
+    concurrency rules need cross-file lock identities, the drift rules
+    need every registry call site at once)."""
+
+    id: str = "DL4J000"
+    name: str = "unnamed"
+    severity: str = ERROR
+    doc: str = ""
+
+    def run(self, project: "Project") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, project: "Project", node: ast.AST, path: str,
+                message: str, severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.id, severity=severity or self.severity, path=path,
+            line=getattr(node, "lineno", 1), col=getattr(node, "col_offset", 0),
+            message=message, symbol=project.enclosing_symbol(path, node))
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and add to the rule registry."""
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+# ----------------------------------------------------------------------
+# Baseline
+# ----------------------------------------------------------------------
+class Baseline:
+    """Grandfathered findings, keyed by line-number-free fingerprints.
+    The checked-in file keeps the human-readable entries so a reviewer
+    can see WHAT was grandfathered, not just hashes."""
+
+    def __init__(self, entries: Optional[List[dict]] = None,
+                 path: Optional[str] = None):
+        self.path = path
+        self.entries = list(entries or [])
+        self._fps: Set[str] = {e["fingerprint"] for e in self.entries
+                               if "fingerprint" in e}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("findings", []), path=path)
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self._fps
+
+    @staticmethod
+    def write(path: str, findings: Sequence[Finding]) -> None:
+        entries = sorted(
+            ({"rule": f.rule, "path": f.path.replace(os.sep, "/"),
+              "symbol": f.symbol, "message": f.message,
+              "fingerprint": f.fingerprint()}
+             for f in findings if not f.suppressed),
+            key=lambda e: e["fingerprint"])
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "findings": entries}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+
+
+# ----------------------------------------------------------------------
+# Source files and pragmas
+# ----------------------------------------------------------------------
+class SourceFile:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        #: line -> (set of rule ids or _ALL, reason)
+        self.pragmas: Dict[int, Tuple[object, str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            if "dl4j:" not in line:
+                continue
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = m.group("rules")
+            ids = (_ALL if rules is None else
+                   {r.strip() for r in rules.split(",") if r.strip()})
+            self.pragmas[i] = (ids, (m.group("reason") or "").strip())
+
+    def pragma_for(self, rule_id: str, line: int) -> Optional[str]:
+        """Reason string ('' if none given) when ``rule_id`` is noqa'd
+        on ``line``, else None."""
+        got = self.pragmas.get(line)
+        if got is None:
+            return None
+        ids, reason = got
+        if ids is _ALL or rule_id in ids:
+            return reason
+        return None
+
+
+_TEST_FILE_RE = re.compile(r"(^|[\\/])(test_[^\\/]*\.py|conftest\.py)$")
+
+
+def is_test_path(path: str) -> bool:
+    return bool(_TEST_FILE_RE.search(path)) or "tests" in path.split(os.sep)
+
+
+# ----------------------------------------------------------------------
+# Function index / call graph
+# ----------------------------------------------------------------------
+@dataclass
+class FunctionInfo:
+    qualname: str              # "module.sub:Class.method.<locals>.inner"
+    module: str
+    path: str
+    node: ast.AST              # FunctionDef / AsyncFunctionDef / Lambda
+    class_name: str = ""       # nearest enclosing class, "" at module level
+    parent: Optional["FunctionInfo"] = None
+    params: Set[str] = field(default_factory=set)
+    local_defs: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _param_names(node: ast.AST) -> Set[str]:
+    a = node.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+#: call names whose function argument is traced by JAX
+JIT_WRAPPER_SUFFIXES = {
+    "jit", "pjit", "shard_map", "scan", "vmap", "pmap", "checkpoint",
+    "remat", "grad", "value_and_grad", "vjp", "jit_sharded_step",
+}
+#: wrappers whose *first* positional argument is the traced callable
+_FN_ARG_INDEX = {name: 0 for name in JIT_WRAPPER_SUFFIXES}
+
+#: lock constructors, with their kind ("lock", "rlock", "condition",
+#: "semaphore") — conditions matter because Condition.wait releases
+LOCK_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
+}
+_LOCKISH_NAME_RE = re.compile(r"(^|_)(lock|mutex|cond)(_|$)|lock$|cond$",
+                              re.IGNORECASE)
+
+
+@dataclass
+class LockSite:
+    """One ``with <lock>:`` region (or withitem of a multi-item with)."""
+    lock_id: str              # canonical cross-file identity
+    kind: str                 # lock / rlock / condition / semaphore / unknown
+    node: ast.With            # the with statement
+    item_expr: ast.AST        # the lock expression itself
+    path: str
+    func: Optional[FunctionInfo]
+
+
+class Project:
+    """The parsed program: files, function index, heuristic call graph,
+    jit-reachability, and lock model.  Built once; rules only read."""
+
+    def __init__(self, files: Sequence[SourceFile],
+                 docs_path: Optional[str] = None):
+        self.files = list(files)
+        self.docs_path = docs_path
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._by_module: Dict[str, Dict[str, FunctionInfo]] = {}
+        self._by_class: Dict[Tuple[str, str], Dict[str, FunctionInfo]] = {}
+        self._methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self._imports: Dict[str, Dict[str, str]] = {}   # module -> alias -> target module
+        self._str_consts: Dict[str, Dict[str, str]] = {}  # module -> NAME -> value
+        self._parents: Dict[str, Dict[ast.AST, ast.AST]] = {}
+        self._fn_of_node: Dict[Tuple[str, int], FunctionInfo] = {}
+        self.lock_attrs: Dict[str, str] = {}            # lock_id -> kind
+        self.lock_sites: List[LockSite] = []
+        self._jit_roots: List[FunctionInfo] = []
+        self._jit_sites: Dict[str, List[ast.Call]] = {}  # path -> jit Call nodes
+        self._reachable: Optional[Set[int]] = None
+        self._reachable_infos: List[FunctionInfo] = []
+        for f in self.files:
+            if f.tree is not None:
+                self._index_file(f)
+        self._find_jit_roots()
+        self._find_locks()
+
+    # -- indexing ------------------------------------------------------
+    @staticmethod
+    def module_of(path: str) -> str:
+        mod = path.replace(os.sep, "/")
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        return mod.replace("/", ".")
+
+    def _index_file(self, f: SourceFile) -> None:
+        module = self.module_of(f.path)
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(f.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        self._parents[f.path] = parents
+
+        consts: Dict[str, str] = {}
+        for node in f.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                consts[node.targets[0].id] = node.value.value
+        self._str_consts[module] = consts
+
+        imports: Dict[str, str] = {}
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+        self._imports[module] = imports
+
+        def visit(node: ast.AST, qual: str, class_name: str,
+                  parent_fn: Optional[FunctionInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, f"{qual}{child.name}.", child.name,
+                          parent_fn)
+                elif isinstance(child,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = FunctionInfo(
+                        qualname=f"{module}:{qual}{child.name}",
+                        module=module, path=f.path, node=child,
+                        class_name=class_name, parent=parent_fn,
+                        params=_param_names(child))
+                    self.functions[info.qualname] = info
+                    self._fn_of_node[(f.path, id(child))] = info
+                    if parent_fn is None and not class_name:
+                        self._by_module.setdefault(module, {})[child.name] \
+                            = info
+                    if class_name and parent_fn is None:
+                        self._by_class.setdefault(
+                            (module, class_name), {})[child.name] = info
+                        self._methods_by_name.setdefault(
+                            child.name, []).append(info)
+                    if parent_fn is not None:
+                        parent_fn.local_defs[child.name] = info
+                    visit(child, f"{qual}{child.name}.<locals>.",
+                          class_name, info)
+                else:
+                    visit(child, qual, class_name, parent_fn)
+
+        visit(f.tree, "", "", None)
+
+    def file(self, path: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.path == path:
+                return f
+        return None
+
+    def parent(self, path: str, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(path, {}).get(node)
+
+    def ancestors(self, path: str, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parent(path, node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(path, cur)
+
+    def enclosing_function(self, path: str,
+                           node: ast.AST) -> Optional[FunctionInfo]:
+        for anc in self.ancestors(path, node):
+            info = self._fn_of_node.get((path, id(anc)))
+            if info is not None:
+                return info
+        return None
+
+    def enclosing_symbol(self, path: str, node: ast.AST) -> str:
+        info = self._fn_of_node.get((path, id(node)))
+        if info is None:
+            info = self.enclosing_function(path, node)
+        if info is not None:
+            return info.qualname.split(":", 1)[1]
+        for anc in self.ancestors(path, node):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+        return "<module>"
+
+    # -- call resolution ----------------------------------------------
+    def resolve_call(self, call: ast.Call,
+                     caller: Optional[FunctionInfo],
+                     path: str) -> List[FunctionInfo]:
+        """Best-effort static resolution of ``call`` to project
+        functions.  Handles: local defs in the lexical chain, module
+        functions, ``self.method`` (same class), and
+        ``imported_module.func``.  Unresolvable calls return []."""
+        func = call.func
+        module = self.module_of(path)
+        if isinstance(func, ast.Name):
+            name = func.id
+            cur = caller
+            while cur is not None:
+                if name in cur.local_defs:
+                    return [cur.local_defs[name]]
+                cur = cur.parent
+            if caller is not None and caller.class_name:
+                pass  # bare names inside methods don't hit the class ns
+            mod_fns = self._by_module.get(module, {})
+            if name in mod_fns:
+                return [mod_fns[name]]
+            target = self._imports.get(module, {}).get(name)
+            if target:
+                tmod, _, tname = target.rpartition(".")
+                got = self._resolve_imported(tmod, tname)
+                if got:
+                    return got
+            return []
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                    and caller is not None and caller.class_name:
+                meth = self._by_class.get(
+                    (caller.module, caller.class_name), {}).get(func.attr)
+                return [meth] if meth else []
+            chain = _attr_chain(func.value)
+            if chain:
+                target = self._imports.get(module, {}).get(
+                    chain.split(".")[0])
+                if target:
+                    suffix = chain.split(".", 1)[1] if "." in chain else ""
+                    tmod = target + ("." + suffix if suffix else "")
+                    got = self._resolve_imported(tmod, func.attr)
+                    if got:
+                        return got
+        return []
+
+    def _resolve_imported(self, module: str,
+                          name: str) -> List[FunctionInfo]:
+        """Match an absolute-module reference against indexed modules
+        (which are keyed by file path): exact and suffix matches first,
+        bare-basename equality only as a fallback — two project modules
+        share basenames (datasets/iterators vs records/iterators) and
+        must not cross-wire."""
+        fallback: List[FunctionInfo] = []
+        for mod, fns in self._by_module.items():
+            if name not in fns:
+                continue
+            if (mod == module or mod.endswith("." + module)
+                    or module.endswith("." + mod)):
+                return [fns[name]]
+            if module.split(".")[-1] == mod.split(".")[-1] \
+                    and not fallback:
+                fallback = [fns[name]]
+        return fallback
+
+    # -- jit roots and reachability ------------------------------------
+    @staticmethod
+    def _wrapper_name(func: ast.AST) -> Optional[str]:
+        chain = _attr_chain(func)
+        if chain is None:
+            return None
+        leaf = chain.split(".")[-1]
+        return leaf if leaf in JIT_WRAPPER_SUFFIXES else None
+
+    def _returned_functions(self, info: FunctionInfo) -> List[FunctionInfo]:
+        out = []
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Return) and isinstance(node.value,
+                                                           ast.Name):
+                enc = self.enclosing_function(info.path, node)
+                cur = enc
+                while cur is not None:
+                    if node.value.id in cur.local_defs:
+                        out.append(cur.local_defs[node.value.id])
+                        break
+                    cur = cur.parent
+        return out
+
+    def _fn_arg_targets(self, arg: ast.AST, caller: Optional[FunctionInfo],
+                        path: str) -> List[FunctionInfo]:
+        """Resolve the callable argument of a jit-style wrapper."""
+        if isinstance(arg, ast.Lambda):
+            info = FunctionInfo(
+                qualname=f"{self.module_of(path)}:<lambda:{arg.lineno}>",
+                module=self.module_of(path), path=path, node=arg,
+                class_name=caller.class_name if caller else "",
+                parent=caller, params=_param_names(arg))
+            self._fn_of_node[(path, id(arg))] = info
+            return [info]
+        if isinstance(arg, ast.Name):
+            cur = caller
+            while cur is not None:
+                if arg.id in cur.local_defs:
+                    return [cur.local_defs[arg.id]]
+                cur = cur.parent
+            mod_fns = self._by_module.get(self.module_of(path), {})
+            if arg.id in mod_fns:
+                return [mod_fns[arg.id]]
+            return []
+        if isinstance(arg, ast.Call):
+            built = []
+            for target in self.resolve_call(arg, caller, path):
+                built.extend(self._returned_functions(target))
+            return built
+        if isinstance(arg, ast.Attribute):
+            if isinstance(arg.value, ast.Name) and arg.value.id == "self" \
+                    and caller is not None and caller.class_name:
+                meth = self._by_class.get(
+                    (caller.module, caller.class_name), {}).get(arg.attr)
+                return [meth] if meth else []
+        return []
+
+    def _find_jit_roots(self) -> None:
+        roots: List[FunctionInfo] = []
+        for f in self.files:
+            if f.tree is None:
+                continue
+            sites: List[ast.Call] = []
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call):
+                    wname = self._wrapper_name(node.func)
+                    if wname is None or not node.args:
+                        continue
+                    sites.append(node)
+                    caller = self.enclosing_function(f.path, node)
+                    roots.extend(self._fn_arg_targets(
+                        node.args[_FN_ARG_INDEX[wname]], caller, f.path))
+                elif isinstance(node,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        target = dec.func if isinstance(dec, ast.Call) \
+                            else dec
+                        if isinstance(dec, ast.Call) and \
+                                _attr_chain(dec.func) and \
+                                _attr_chain(dec.func).split(".")[-1] == \
+                                "partial" and dec.args:
+                            target = dec.args[0]
+                            inner = self._wrapper_name(target)
+                            if inner:
+                                info = self._fn_of_node.get(
+                                    (f.path, id(node)))
+                                if info:
+                                    roots.append(info)
+                            continue
+                        if self._wrapper_name(target):
+                            info = self._fn_of_node.get((f.path, id(node)))
+                            if info:
+                                roots.append(info)
+            self._jit_sites[f.path] = sites
+        self._jit_roots = roots
+
+    def jit_reachable(self) -> List[FunctionInfo]:
+        """Functions reachable (via the heuristic call graph) from any
+        jit/pjit/scan/shard_map call site — the set the tracer-safety
+        rules scan."""
+        if self._reachable is not None:
+            return self._reachable_infos
+        seen: Set[int] = set()
+        infos: List[FunctionInfo] = []
+        frontier = list(self._jit_roots)
+        while frontier:
+            info = frontier.pop()
+            if id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            infos.append(info)
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    inner = self.enclosing_function(info.path, node) or info
+                    for callee in self.resolve_call(node, inner, info.path):
+                        if id(callee.node) not in seen:
+                            frontier.append(callee)
+        self._reachable = seen
+        self._reachable_infos = infos
+        return infos
+
+    def is_jit_reachable(self, info: FunctionInfo) -> bool:
+        self.jit_reachable()
+        return id(info.node) in (self._reachable or set())
+
+    # -- locks ---------------------------------------------------------
+    def _lock_id_and_kind(self, expr: ast.AST, path: str,
+                          func: Optional[FunctionInfo]) \
+            -> Optional[Tuple[str, str]]:
+        """Canonical identity for a lock expression, or None when the
+        expression isn't lock-like.  ``self._lock`` in class C of module
+        m -> ``m:C._lock`` so every method (and every instance) of C
+        shares one node in the order graph — the standard static
+        approximation."""
+        chain = _attr_chain(expr)
+        if chain is None:
+            return None
+        module = self.module_of(path)
+        leaf = chain.split(".")[-1]
+        if chain.startswith("self.") and func is not None \
+                and func.class_name:
+            lock_id = f"{module}:{func.class_name}.{chain[len('self.'):]}"
+        elif "." not in chain:
+            # bare name: module-global lock unless a known function-local
+            # binding shadows it (globals are the common case — one id
+            # per module-level lock, shared across every function)
+            scoped = None
+            if func is not None:
+                cand = (f"{module}:"
+                        f"{func.qualname.split(':', 1)[1]}.{chain}")
+                if cand in self.lock_attrs:
+                    scoped = cand
+            lock_id = scoped or f"{module}:{chain}"
+        else:
+            lock_id = f"{module}:{chain}"
+        kind = self.lock_attrs.get(lock_id)
+        if kind is None and not _LOCKISH_NAME_RE.search(leaf):
+            return None
+        return lock_id, kind or "unknown"
+
+    def _find_locks(self) -> None:
+        # pass 1: every `X = threading.Lock()`-style binding, so locks
+        # with non-lockish names are still tracked
+        for f in self.files:
+            if f.tree is None:
+                continue
+            module = self.module_of(f.path)
+            for node in ast.walk(f.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                chain = _attr_chain(value.func) or ""
+                ctor = chain.split(".")[-1]
+                if ctor not in LOCK_CTORS:
+                    continue
+                kind = LOCK_CTORS[ctor]
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                func = self.enclosing_function(f.path, node)
+                for t in targets:
+                    tchain = _attr_chain(t)
+                    if tchain is None:
+                        continue
+                    if tchain.startswith("self.") and func is not None \
+                            and func.class_name:
+                        lock_id = (f"{module}:{func.class_name}."
+                                   f"{tchain[len('self.'):]}")
+                    elif "." not in tchain and func is None:
+                        lock_id = f"{module}:{tchain}"
+                    elif "." not in tchain and func is not None:
+                        scope = func.qualname.split(":", 1)[1]
+                        lock_id = f"{module}:{scope}.{tchain}"
+                    else:
+                        lock_id = f"{module}:{tchain}"
+                    self.lock_attrs[lock_id] = kind
+        # pass 2: every with-lock region
+        for f in self.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                func = self.enclosing_function(f.path, node)
+                for item in node.items:
+                    expr = item.context_expr
+                    got = self._lock_id_and_kind(expr, f.path, func)
+                    if got is None:
+                        continue
+                    lock_id, kind = got
+                    self.lock_sites.append(LockSite(
+                        lock_id=lock_id, kind=kind, node=node,
+                        item_expr=expr, path=f.path, func=func))
+
+    # -- registry call sites (for the drift rules) ---------------------
+    REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+    def metric_call_sites(self) -> List[Tuple[str, ast.Call, str, bool]]:
+        """Every ``*.counter/gauge/histogram("dl4j_...")`` call:
+        ``(path, call_node, name_or_pattern, is_pattern)`` — f-string
+        names become regex patterns with ``[a-z0-9_]+`` holes."""
+        out = []
+        for f in self.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                if not isinstance(node.func, ast.Attribute) or \
+                        node.func.attr not in self.REGISTRY_METHODS:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    # registration through a module constant, e.g.
+                    # reg.histogram(PHASE_METRIC, ...)
+                    val = self._str_consts.get(
+                        self.module_of(f.path), {}).get(arg.id)
+                    if val is not None:
+                        arg = ast.Constant(value=val)
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    if arg.value.startswith("dl4j_"):
+                        out.append((f.path, node, arg.value, False))
+                elif isinstance(arg, ast.JoinedStr):
+                    parts = []
+                    for v in arg.values:
+                        if isinstance(v, ast.Constant):
+                            parts.append(re.escape(str(v.value)))
+                        else:
+                            parts.append("[a-z0-9_]+")
+                    pattern = "".join(parts)
+                    if pattern.startswith("dl4j_"):
+                        out.append((f.path, node, pattern, True))
+        return out
+
+
+# ----------------------------------------------------------------------
+# Runner
+# ----------------------------------------------------------------------
+def collect_py_files(paths: Sequence[str],
+                     root: Optional[str] = None) -> List[str]:
+    """Expand files/directories into a sorted list of .py paths,
+    relative to ``root`` (default cwd)."""
+    root = os.path.abspath(root or os.getcwd())
+    found: List[str] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            found.append(ap)
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        found.append(os.path.join(dirpath, fn))
+    rel = []
+    for ap in found:
+        try:
+            rel.append(os.path.relpath(ap, root))
+        except ValueError:
+            rel.append(ap)
+    return sorted(set(rel))
+
+
+def build_project(paths: Sequence[str], root: Optional[str] = None,
+                  docs_path: Optional[str] = None) -> Project:
+    root = os.path.abspath(root or os.getcwd())
+    files = []
+    for rel in collect_py_files(paths, root):
+        full = rel if os.path.isabs(rel) else os.path.join(root, rel)
+        try:
+            with open(full, "r", encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        files.append(SourceFile(rel, src))
+    if docs_path is None:
+        cand = os.path.join(root, "docs", "OBSERVABILITY.md")
+        docs_path = cand if os.path.exists(cand) else None
+    return Project(files, docs_path=docs_path)
+
+
+def run_rules(project: Project,
+              rule_ids: Optional[Sequence[str]] = None,
+              disabled: Sequence[str] = ()) -> List[Finding]:
+    import deeplearning4j_tpu.analysis.rules  # noqa: F401 — registers
+    chosen = [RULES[r] for r in (rule_ids or sorted(RULES))
+              if r in RULES and r not in set(disabled)]
+    findings: List[Finding] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            findings.append(Finding(
+                rule="DL4J000", severity=ERROR, path=f.path,
+                line=f.parse_error.lineno or 1, col=0,
+                message=f"syntax error: {f.parse_error.msg}",
+                symbol="<module>"))
+    for rule in chosen:
+        seen = set()
+        for finding in rule.run(project):
+            key = (finding.rule, finding.path, finding.line, finding.col,
+                   finding.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(finding)
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+    return findings
+
+
+def apply_suppressions(project: Project, findings: Sequence[Finding],
+                       baseline: Optional[Baseline] = None) -> None:
+    for finding in findings:
+        f = project.file(finding.path)
+        if f is not None:
+            reason = f.pragma_for(finding.rule, finding.line)
+            if reason is not None:
+                finding.suppressed = True
+                finding.noqa_reason = reason
+                continue
+        if baseline is not None and finding in baseline:
+            finding.baselined = True
+
+
+def lint(paths: Sequence[str], root: Optional[str] = None,
+         baseline_path: Optional[str] = None,
+         docs_path: Optional[str] = None,
+         rule_ids: Optional[Sequence[str]] = None,
+         disabled: Sequence[str] = ()) -> Tuple[List[Finding], Project]:
+    """One-call API: build the project, run the rules, apply pragma and
+    baseline suppression.  Returns (findings, project)."""
+    project = build_project(paths, root=root, docs_path=docs_path)
+    findings = run_rules(project, rule_ids=rule_ids, disabled=disabled)
+    baseline = Baseline.load(baseline_path) if baseline_path else None
+    apply_suppressions(project, findings, baseline)
+    return findings, project

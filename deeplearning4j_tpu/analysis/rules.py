@@ -1,0 +1,22 @@
+"""Rule catalog loader: importing this module registers every built-in
+rule with :data:`deeplearning4j_tpu.analysis.core.RULES`.
+
+| id      | name                  | severity | hazard                       |
+|---------|-----------------------|----------|------------------------------|
+| DL4J101 | tracer-host-sync      | error    | `.item()`/float() in jit     |
+| DL4J102 | tracer-host-transfer  | error    | np.asarray/device_get in jit |
+| DL4J103 | tracer-impure         | warning  | time/random/print in jit     |
+| DL4J104 | retrace-risk          | warning  | closure/loop-jit retraces    |
+| DL4J201 | blocking-under-lock   | warning  | I/O or unbounded wait w/ lock|
+| DL4J202 | lock-order-cycle      | error    | cross-file deadlock ordering |
+| DL4J203 | bare-lock-acquire     | error    | acquire without finally      |
+| DL4J301 | metric-undocumented   | error    | code metric not in docs      |
+| DL4J302 | metric-doc-stale      | error    | doc metric not in code       |
+
+Rationale and worked examples: docs/ANALYSIS.md.
+"""
+
+from deeplearning4j_tpu.analysis import rules_concurrency  # noqa: F401
+from deeplearning4j_tpu.analysis import rules_metrics  # noqa: F401
+from deeplearning4j_tpu.analysis import rules_tracer  # noqa: F401
+from deeplearning4j_tpu.analysis.core import RULES  # noqa: F401

@@ -1,0 +1,412 @@
+"""Concurrency rules (DL4J2xx): blocking calls while holding a lock, a
+whole-program lock-acquisition-order graph that fails on cycles, and
+bare ``acquire()`` without a try/finally release.
+
+Lock identity is the standard static approximation: ``self._lock`` in
+class ``C`` of module ``m`` is the node ``m:C._lock`` — every method
+and every instance of ``C`` shares it.  That makes the order graph
+conservative (two DIFFERENT instances of one class count as one lock),
+which is the right bias for deadlock detection: an inversion between
+`datasets/iterators.py`'s reorder-buffer condition and
+`server/batcher.py`'s dispatch condition only manifests under
+concurrent load on a real serving host, never in unit tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.core import (
+    ERROR, WARNING, Finding, FunctionInfo, LockSite, Project, Rule,
+    _attr_chain, register)
+
+#: how many call-graph levels below a with-lock block are searched for
+#: blocking primitives / nested lock acquisitions
+_CALL_DEPTH = 3
+
+_BLOCKING_MODULE_CALLS = {
+    "time.sleep": "time.sleep()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "os.system": "os.system()",
+    "urllib.request.urlopen": "urlopen()",
+    "urlopen": "urlopen()",
+    "socket.create_connection": "socket.create_connection()",
+}
+
+
+def _timeout_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+def _block_false(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def _blocking_reason(call: ast.Call, held_kinds: Dict[str, str],
+                     project: Project, path: str,
+                     func: "FunctionInfo") -> Optional[str]:
+    """Why ``call`` blocks indefinitely, or None if it doesn't."""
+    func_expr = call.func
+    chain = _attr_chain(func_expr) or ""
+    if chain in _BLOCKING_MODULE_CALLS:
+        return _BLOCKING_MODULE_CALLS[chain]
+    if isinstance(func_expr, ast.Name) and func_expr.id == "open":
+        return "open() (file I/O)"
+    if not isinstance(func_expr, ast.Attribute):
+        return None
+    attr = func_expr.attr
+    if attr in ("put", "get", "put_nowait", "get_nowait"):
+        if attr.endswith("_nowait") or _timeout_kw(call) \
+                or _block_false(call):
+            return None
+        # put(item, timeout) / get(block, timeout) positional forms
+        if attr == "put" and len(call.args) >= 2:
+            return None
+        if attr == "get" and len(call.args) >= 2:
+            return None
+        recv = _attr_chain(func_expr.value) or ""
+        leaf = recv.split(".")[-1]
+        if "q" in leaf.lower() or "queue" in leaf.lower():
+            return f"{leaf}.{attr}() without timeout"
+        return None
+    if attr == "join" and not call.args and not call.keywords:
+        # str.join always takes an iterable argument; a no-arg join is
+        # a Thread/Process join — unbounded
+        return "unbounded .join()"
+    if attr == "result" and not call.args and not _timeout_kw(call):
+        recv = _attr_chain(func_expr.value) or ""
+        leaf = recv.split(".")[-1].lower()
+        if "fut" in leaf or "promise" in leaf:
+            return f"{recv.split('.')[-1]}.result() without timeout"
+        return None
+    if attr == "wait" and not call.args and not _timeout_kw(call):
+        recv = _attr_chain(func_expr.value) or ""
+        got = project._lock_id_and_kind(func_expr.value, path, func)
+        if got is not None:
+            lock_id, kind = got
+            # Condition.wait on a lock we hold RELEASES it — fine when
+            # bounded; an unbounded wait still stalls shutdown forever
+            return f"{recv.split('.')[-1] or 'condition'}.wait() " \
+                   "without timeout"
+        return None
+    if attr == "acquire" and not _timeout_kw(call) \
+            and not _block_false(call):
+        got = project._lock_id_and_kind(func_expr.value, path, func)
+        if got is not None and got[0] not in held_kinds:
+            return f"nested {got[0].split(':')[-1]}.acquire()"
+    return None
+
+
+def _locks_in_with(project: Project, site: LockSite) -> List[ast.AST]:
+    """Statements governed by a with-lock item (its body)."""
+    return site.node.body
+
+
+def _prune_walk(stmts):
+    """Walk a statement list without descending into nested function
+    definitions (their bodies run later, outside the lock)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _iter_block_calls(stmts):
+    """Calls in a statement list, NOT descending into nested function
+    definitions (a closure defined under a lock runs later, lock-free)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _LockWalker:
+    """Shared traversal for DL4J201/DL4J202: from each with-lock region,
+    explore the statically-resolvable call graph a few levels deep,
+    reporting blocking primitives and nested lock acquisitions with the
+    call chain that reaches them."""
+
+    def __init__(self, project: Project):
+        self.project = project
+
+    def explore(self, site: LockSite):
+        """Yields ('blocking'|'lock', payload, chain) events.
+
+        payload: reason string for blocking events, (lock_id, kind) for
+        nested-acquisition events.  chain: "f -> g" call path."""
+        yield from self._walk_stmts(
+            _locks_in_with(self.project, site), site.path, site.func,
+            held={site.lock_id: site.kind}, chain=(), depth=0,
+            visited={id(site.node)})
+
+    def _walk_stmts(self, stmts, path, func, held, chain, depth, visited):
+        project = self.project
+        for node in _iter_block_calls(stmts):
+            reason = _blocking_reason(node, held, project, path, func)
+            if reason is not None:
+                yield ("blocking", reason, chain, node, path)
+            got = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                got = project._lock_id_and_kind(node.func.value, path,
+                                                func)
+            if got is not None:
+                yield ("lock", got, chain, node, path)
+            # descend into resolvable callees
+            if depth >= _CALL_DEPTH:
+                continue
+            for callee in project.resolve_call(node, func, path):
+                if id(callee.node) in visited:
+                    continue
+                visited = visited | {id(callee.node)}
+                body = callee.node.body
+                if isinstance(callee.node, ast.Lambda):
+                    body = [callee.node.body]
+                yield from self._walk_with_subwiths(
+                    body, callee.path, callee, held,
+                    chain + (callee.name,), depth + 1, visited)
+
+    def _walk_with_subwiths(self, stmts, path, func, held, chain, depth,
+                            visited):
+        """Like _walk_stmts but also reports with-lock regions inside
+        the callee (a lock ACQUIRED while the outer one is held)."""
+        project = self.project
+        for node in _prune_walk(stmts):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    got = project._lock_id_and_kind(
+                        item.context_expr, path, func)
+                    if got is not None:
+                        yield ("lock", got, chain, node, path)
+        yield from self._walk_stmts(stmts, path, func, held, chain,
+                                    depth, visited)
+
+
+@register
+class BlockingUnderLock(Rule):
+    id = "DL4J201"
+    name = "blocking-under-lock"
+    severity = WARNING
+    doc = ("Blocking calls (queue put/get without timeout, unbounded "
+           ".join()/.wait()/.result(), time.sleep, file/network I/O) "
+           "while holding a threading lock: every other thread needing "
+           "that lock stalls behind the slow operation — the classic "
+           "input-pipeline/batcher tail-latency bug.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        walker = _LockWalker(project)
+        for site in project.lock_sites:
+            for kind, payload, chain, node, path in walker.explore(site):
+                if kind != "blocking":
+                    continue
+                via = f" (via {' -> '.join(chain)})" if chain else ""
+                lock_name = site.lock_id.split(":")[-1]
+                yield Finding(
+                    rule=self.id, severity=self.severity, path=site.path,
+                    line=site.node.lineno, col=site.node.col_offset,
+                    message=f"{payload} while holding {lock_name}{via}",
+                    symbol=project.enclosing_symbol(site.path, site.node))
+
+
+@register
+class LockOrderCycle(Rule):
+    id = "DL4J202"
+    name = "lock-order-cycle"
+    severity = ERROR
+    doc = ("Whole-program lock-acquisition-order graph: an edge A->B "
+           "for every place lock B is acquired while A is held (same "
+           "function or through resolvable calls).  A cycle means two "
+           "threads can each hold one lock and wait for the other — "
+           "a deadlock that only fires under concurrent load.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        walker = _LockWalker(project)
+        # edge -> first witness (path, line, chain)
+        edges: Dict[Tuple[str, str], Tuple[str, int, Tuple[str, ...]]] = {}
+        # nested with-blocks inside one function body
+        for site in project.lock_sites:
+            for stmt in site.node.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        continue
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        for item in node.items:
+                            got = project._lock_id_and_kind(
+                                item.context_expr, site.path, site.func)
+                            if got is not None:
+                                self._edge(edges, site, got[0],
+                                           node.lineno, ())
+            for kind, payload, chain, node, path in walker.explore(site):
+                if kind != "lock":
+                    continue
+                self._edge(edges, site, payload[0],
+                           getattr(node, "lineno", site.node.lineno),
+                           chain)
+        # RLock self-edges are re-entrant, drop them; plain-Lock
+        # self-edges are immediate self-deadlocks, keep
+        adj: Dict[str, Set[str]] = {}
+        for (a, b), _w in edges.items():
+            if a == b:
+                kind = project.lock_attrs.get(a, "unknown")
+                if kind in ("rlock", "condition", "unknown"):
+                    continue
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for cycle in self._cycles(adj):
+            path_desc = " -> ".join(cycle + (cycle[0],))
+            witness = None
+            for i in range(len(cycle)):
+                w = edges.get((cycle[i], cycle[(i + 1) % len(cycle)]))
+                if w is not None:
+                    witness = w
+                    break
+            wpath, wline = (witness[0], witness[1]) if witness \
+                else ("<unknown>", 1)
+            yield Finding(
+                rule=self.id, severity=self.severity, path=wpath,
+                line=wline, col=0,
+                message=("lock-order cycle: "
+                         + path_desc.replace("\\", "/")
+                         + " — acquisition order must be globally "
+                           "consistent"),
+                symbol="<lock-graph>")
+
+    @staticmethod
+    def _edge(edges, site: LockSite, to_lock: str, line: int,
+              chain: Tuple[str, ...]) -> None:
+        key = (site.lock_id, to_lock)
+        if key not in edges:
+            edges[key] = (site.path, line, chain)
+
+    @staticmethod
+    def _cycles(adj: Dict[str, Set[str]]) -> List[Tuple[str, ...]]:
+        """Elementary cycles via DFS over SCCs — canonicalized (rotated
+        to the smallest node, deduped) so each cycle reports once."""
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        out: List[Tuple[str, ...]] = []
+        for start in sorted(adj):
+            stack: List[Tuple[str, Tuple[str, ...]]] = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == path[0]:
+                        i = path.index(min(path))
+                        canon = path[i:] + path[:i]
+                        if canon not in seen_cycles and \
+                                (len(path) > 1 or nxt == node):
+                            seen_cycles.add(canon)
+                            out.append(canon)
+                    elif nxt not in path and nxt > path[0]:
+                        # only explore cycles whose smallest node is the
+                        # start — each elementary cycle found exactly once
+                        stack.append((nxt, path + (nxt,)))
+        return out
+
+
+@register
+class UnboundedJoin(Rule):
+    id = "DL4J204"
+    name = "unbounded-join"
+    severity = WARNING
+    doc = ("`thread.join()` with no timeout in non-test code: a worker "
+           "wedged in user ETL or a dead-peer socket read blocks the "
+           "caller forever — shutdown paths hang instead of failing. "
+           "Join with a timeout and escalate, or noqa with the reason "
+           "the unbounded wait is required.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        from deeplearning4j_tpu.analysis.core import is_test_path
+        for f in project.files:
+            if f.tree is None or is_test_path(f.path):
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "join" \
+                        or node.args or node.keywords:
+                    continue
+                # str.join always takes the iterable argument, so a
+                # no-arg .join() is a Thread/Process join
+                yield self.finding(
+                    project, node, f.path,
+                    f"unbounded .join() on "
+                    f"`{_attr_chain(node.func.value) or '<expr>'}` — a "
+                    "stuck worker blocks this caller forever; join "
+                    "with a timeout and escalate")
+
+
+@register
+class BareAcquire(Rule):
+    id = "DL4J203"
+    name = "bare-lock-acquire"
+    severity = ERROR
+    doc = ("`lock.acquire()` without a matching `release()` in a "
+           "`finally:` block (and outside a with-statement): any "
+           "exception between acquire and release leaks the lock and "
+           "wedges every other thread.  Use `with lock:`.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute) \
+                        or node.func.attr != "acquire":
+                    continue
+                func = project.enclosing_function(f.path, node)
+                got = project._lock_id_and_kind(node.func.value, f.path,
+                                                func)
+                if got is None:
+                    continue
+                lock_chain = _attr_chain(node.func.value)
+                if self._released_in_finally(project, f.path, node,
+                                             lock_chain):
+                    continue
+                yield self.finding(
+                    project, node, f.path,
+                    f"{lock_chain}.acquire() without a release() in a "
+                    "finally block — use `with " + (lock_chain or "lock")
+                    + ":` instead")
+
+    @staticmethod
+    def _released_in_finally(project: Project, path: str, node: ast.AST,
+                             lock_chain: Optional[str]) -> bool:
+        # search the enclosing function for `lock.release()` inside any
+        # finally block — pairing heuristics beyond that aren't worth
+        # the false negatives
+        fn = project.enclosing_function(path, node)
+        scope = fn.node if fn is not None else None
+        if scope is None:
+            f = project.file(path)
+            scope = f.tree if f else None
+        if scope is None:
+            return False
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Try):
+                for stmt in n.finalbody:
+                    for c in ast.walk(stmt):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and c.func.attr == "release" \
+                                and _attr_chain(c.func.value) == lock_chain:
+                            return True
+        return False

@@ -1,0 +1,134 @@
+"""Observability-drift rules (DL4J3xx): the `dl4j_*` metric names at
+registry call sites and the catalog in ``docs/OBSERVABILITY.md`` must
+be the same set, in both directions.
+
+The doc catalog is the operator contract — dashboards and alerts are
+built against it.  A metric registered in code but missing from the
+doc is invisible to operators; a doc row with no registration behind
+it is a dashboard querying nothing.  Both directions drift silently
+(PR 3's catalog predates the sharding and pipeline families), so both
+fail the lint.
+
+Name matching handles the two non-literal forms the codebase uses:
+f-string registrations (``f"dl4j_model_cache_{k}_total"`` becomes the
+pattern ``dl4j_model_cache_[a-z0-9_]+_total``) and doc brace rows
+(``dl4j_sharding_params_{sharded,replicated}`` expands to each
+alternative).  Test files are exempt from the undocumented-metric
+direction — ad-hoc names registered by a test are not operator surface.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from deeplearning4j_tpu.analysis.core import (
+    ERROR, Finding, Project, Rule, is_test_path, register)
+
+_DOC_NAME_RE = re.compile(r"`(dl4j_[a-z0-9_{},]+)`")
+_BRACE_RE = re.compile(r"\{([a-z0-9_,]+)\}")
+
+
+def doc_metric_names(doc_text: str) -> List[Tuple[str, int]]:
+    """(name, line) for every `dl4j_...` in a markdown TABLE row,
+    brace-alternations expanded."""
+    out: List[Tuple[str, int]] = []
+    for lineno, line in enumerate(doc_text.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for raw in _DOC_NAME_RE.findall(line):
+            for name in _expand_braces(raw):
+                out.append((name, lineno))
+    return out
+
+
+def _expand_braces(name: str) -> List[str]:
+    m = _BRACE_RE.search(name)
+    if not m:
+        return [name]
+    head, tail = name[: m.start()], name[m.end():]
+    out: List[str] = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_braces(head + alt + tail))
+    return out
+
+
+def _code_sites(project: Project):
+    """[(path, node, name, is_pattern)] of registry registrations."""
+    return project.metric_call_sites()
+
+
+def _doc_entries(project: Project) -> Tuple[List[Tuple[str, int]], str]:
+    if project.docs_path is None or not os.path.exists(project.docs_path):
+        return [], ""
+    with open(project.docs_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    return doc_metric_names(text), text
+
+
+@register
+class UndocumentedMetric(Rule):
+    id = "DL4J301"
+    name = "metric-undocumented"
+    severity = ERROR
+    doc = ("A `dl4j_*` metric name registered at a counter/gauge/"
+           "histogram call site does not appear in the "
+           "docs/OBSERVABILITY.md catalog — operators cannot see it.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        doc_names, doc_text = _doc_entries(project)
+        if not doc_text:
+            return
+        names: Set[str] = {n for n, _ in doc_names}
+        for path, node, name, is_pattern in _code_sites(project):
+            if is_test_path(path):
+                continue
+            if is_pattern:
+                rx = re.compile(name + r"\Z")
+                if not any(rx.match(n) for n in names):
+                    yield self.finding(
+                        project, node, path,
+                        f"metric pattern `{name}` matches no entry in "
+                        "the docs/OBSERVABILITY.md catalog")
+            elif name not in names:
+                yield self.finding(
+                    project, node, path,
+                    f"metric `{name}` is registered here but missing "
+                    "from the docs/OBSERVABILITY.md catalog")
+
+
+@register
+class StaleMetricDoc(Rule):
+    id = "DL4J302"
+    name = "metric-doc-stale"
+    severity = ERROR
+    doc = ("A `dl4j_*` row in the docs/OBSERVABILITY.md catalog has no "
+           "registry call site behind it — a dashboard built on it "
+           "queries nothing.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        doc_names, doc_text = _doc_entries(project)
+        if not doc_text:
+            return
+        literals: Set[str] = set()
+        patterns: List[re.Pattern] = []
+        for path, _node, name, is_pattern in _code_sites(project):
+            if is_pattern:
+                patterns.append(re.compile(name + r"\Z"))
+            else:
+                literals.add(name)
+        doc_rel = os.path.relpath(project.docs_path) \
+            if project.docs_path else "docs/OBSERVABILITY.md"
+        for name, lineno in doc_names:
+            if name in literals:
+                continue
+            if any(p.match(name) for p in patterns):
+                continue
+            yield Finding(
+                rule=self.id, severity=self.severity, path=doc_rel,
+                line=lineno, col=0,
+                message=(f"documented metric `{name}` has no registry "
+                         "call site in the scanned code — stale catalog "
+                         "row"),
+                symbol="<catalog>")

@@ -1,0 +1,356 @@
+"""Tracer-safety and retrace-risk rules (DL4J1xx).
+
+Scope: functions reachable from ``jit``/``pjit``/``scan``/``shard_map``
+call sites (:meth:`Project.jit_reachable`).  Inside that set, host
+syncs and impure constructs either concretize a tracer (hard error on a
+real mesh), force a silent device→host round-trip per step, or bake a
+trace-time value into the compiled program ("Array Languages Make
+Neural Networks Fast": accidental host transfers and re-compilation
+are the dominant framework-level slowdowns — both statically visible).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    ERROR, WARNING, Finding, FunctionInfo, Project, Rule, _attr_chain,
+    is_test_path, register)
+
+#: methods whose mere invocation forces a device→host sync
+_SYNC_METHODS = {"item", "block_until_ready", "tolist", "numpy"}
+#: builtins that concretize a traced value
+_CONCRETIZERS = {"float", "int", "bool", "complex"}
+
+_HOST_TRANSFER_CALLS = {
+    "np.asarray", "np.array", "np.copy", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get", "device_get",
+}
+
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.",
+                    "os.environ", "os.getenv")
+_IMPURE_CALLS = {"print", "input", "open"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Expressions that are Python-level static under tracing (shapes,
+    dtypes, literals, len of pytrees) — concretizing these is fine."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in {"shape", "ndim", "size", "dtype", "nbytes"}:
+            return True
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        chain = _attr_chain(node.func) or ""
+        leaf = chain.split(".")[-1]
+        if leaf in {"len", "prod", "range", "isinstance", "getattr",
+                    "hasattr", "min", "max"} and all(
+                _is_static_expr(a) for a in node.args):
+            return True
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+def _is_explicit_transfer(node: ast.AST) -> bool:
+    """`jax.device_get(...)` already IS the explicit, sanctioned sync —
+    re-wrapping its (numpy) result is not another transfer."""
+    return (isinstance(node, ast.Call)
+            and (_attr_chain(node.func) or "").endswith("device_get"))
+
+
+def _scan_nodes(info: FunctionInfo) -> Iterable[ast.AST]:
+    """Walk a reachable function's full subtree (nested defs included —
+    they are traced when called from the traced body)."""
+    body = info.node.body if not isinstance(info.node, ast.Lambda) \
+        else [info.node.body]
+    for stmt in body if isinstance(body, list) else [body]:
+        yield from ast.walk(stmt)
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "DL4J101"
+    name = "tracer-host-sync"
+    severity = ERROR
+    doc = ("Host-sync calls (`.item()`, `.tolist()`, "
+           "`.block_until_ready()`, `float()/int()/bool()` on traced "
+           "values) inside functions reachable from jit/pjit/scan/"
+           "shard_map call sites: they concretize a tracer (error) or "
+           "silently stall the device pipeline every step.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for info in project.jit_reachable():
+            for node in _scan_nodes(info):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute) and not node.args \
+                        and func.attr in _SYNC_METHODS:
+                    yield self.finding(
+                        project, node, info.path,
+                        f".{func.attr}() forces a device->host sync "
+                        f"inside jit-reachable `{info.name}`")
+                elif isinstance(func, ast.Name) \
+                        and func.id in _CONCRETIZERS and len(node.args) == 1 \
+                        and not _is_static_expr(node.args[0]):
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{func.id}() on a possibly-traced value inside "
+                        f"jit-reachable `{info.name}` concretizes the "
+                        "tracer (use jnp ops, or hoist to the host side)")
+
+
+@register
+class HostTransferInJit(Rule):
+    id = "DL4J102"
+    name = "tracer-host-transfer"
+    severity = ERROR
+    doc = ("`np.asarray`/`np.array`/`jax.device_get`/`.numpy()` on "
+           "device arrays inside jit-reachable functions: a host "
+           "round-trip per call, and a TracerArrayConversionError once "
+           "actually traced.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for info in project.jit_reachable():
+            for node in _scan_nodes(info):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                if chain in _HOST_TRANSFER_CALLS and node.args \
+                        and not _is_static_expr(node.args[0]):
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{chain}() inside jit-reachable `{info.name}` "
+                        "moves data to the host (use jnp.asarray, or "
+                        "hoist out of the traced step)")
+
+
+@register
+class ImpureInJit(Rule):
+    id = "DL4J103"
+    name = "tracer-impure"
+    severity = WARNING
+    doc = ("Impure constructs (`time.*`, `random.*`, `print`, `open`, "
+           "`global` mutation, env reads) inside jit-reachable "
+           "functions run at TRACE time only — the compiled program "
+           "re-runs with the stale value, silently.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for info in project.jit_reachable():
+            for node in _scan_nodes(info):
+                if isinstance(node, ast.Global):
+                    yield self.finding(
+                        project, node, info.path,
+                        f"global mutation inside jit-reachable "
+                        f"`{info.name}` happens once at trace time, "
+                        "not per step")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func) or ""
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in _IMPURE_CALLS:
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{node.func.id}() inside jit-reachable "
+                        f"`{info.name}` runs at trace time only (use "
+                        "jax.debug.print for per-step output)")
+                elif any(chain.startswith(p) for p in _IMPURE_PREFIXES):
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{chain}() inside jit-reachable `{info.name}` "
+                        "is trace-time-impure: its value is baked into "
+                        "the compiled program")
+
+
+@register
+class HostTransferInHotSpan(Rule):
+    id = "DL4J105"
+    name = "host-transfer-in-hot-span"
+    severity = ERROR
+    doc = ("Implicit device->host conversion (`np.asarray`/`np.array`/"
+           "`float()`/`.item()`) directly inside a `monitor.span(...)` "
+           "hot region (the fit-step and serve-batch phases): the span "
+           "exists because the region is the per-step critical path — "
+           "an implicit transfer there stalls the device pipeline "
+           "every step.  Use `jax.device_get` for an explicit, "
+           "sanitizer-approved sync, or move the pull off the hot "
+           "path.")
+
+    _SPAN_HOT = ("fit/", "serve/")
+
+    def _hot_span_stmts(self, project: Project):
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    ctx = item.context_expr
+                    if not isinstance(ctx, ast.Call):
+                        continue
+                    chain = _attr_chain(ctx.func) or ""
+                    if not chain.endswith("span") or not ctx.args:
+                        continue
+                    first = ctx.args[0]
+                    if isinstance(first, ast.Constant) and \
+                            isinstance(first.value, str) and \
+                            first.value.startswith(self._SPAN_HOT):
+                        yield f.path, node
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for path, with_node in self._hot_span_stmts(project):
+            if is_test_path(path):
+                continue
+            # direct statements only — descending into callees would
+            # flag every host-side helper the span legitimately times
+            stack = list(with_node.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Call):
+                    chain = _attr_chain(node.func) or ""
+                    if chain in ("np.asarray", "np.array",
+                                 "numpy.asarray", "numpy.array") \
+                            and node.args \
+                            and not _is_static_expr(node.args[0]) \
+                            and not _is_explicit_transfer(node.args[0]):
+                        yield self.finding(
+                            project, node, path,
+                            f"{chain}() inside a hot monitor.span "
+                            "region forces an implicit device->host "
+                            "sync per step — use jax.device_get (or "
+                            "hoist it off the hot path)")
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "item" and not node.args:
+                        yield self.finding(
+                            project, node, path,
+                            ".item() inside a hot monitor.span region "
+                            "syncs the device per step — use "
+                            "jax.device_get off the hot path")
+                stack.extend(ast.iter_child_nodes(node))
+
+
+def _free_loads(info: FunctionInfo) -> Set[str]:
+    """Names loaded in the function subtree that are neither its
+    params, its assigned locals, nor locally-defined functions."""
+    bound: Set[str] = set(info.params) | {"self", "cls"}
+    loads: Set[str] = set()
+    node = info.node
+    body = [node.body] if isinstance(node, ast.Lambda) else node.body
+    for stmt in body:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(n.name)
+                for p in _param_names_of(n):
+                    bound.add(p)
+            elif isinstance(n, ast.Lambda):
+                for p in _param_names_of(n):
+                    bound.add(p)
+            elif isinstance(n, ast.Name):
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    bound.add(n.id)
+                else:
+                    loads.add(n.id)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+            elif isinstance(n, ast.comprehension):
+                for t in ast.walk(n.target):
+                    if isinstance(t, ast.Name):
+                        bound.add(t.id)
+    import builtins
+    return {n for n in loads - bound if not hasattr(builtins, n)}
+
+
+def _param_names_of(node: ast.AST) -> List[str]:
+    a = node.args
+    out = [p.arg for p in
+           list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        out.append(a.vararg.arg)
+    if a.kwarg:
+        out.append(a.kwarg.arg)
+    return out
+
+
+def _has_static_treatment(call: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in call.keywords)
+
+
+@register
+class RetraceRisk(Rule):
+    id = "DL4J104"
+    name = "retrace-risk"
+    severity = WARNING
+    doc = ("Retrace traps: `jax.jit(f)(...)` immediately invoked (fresh "
+           "cache every call), jit created inside a loop body, and "
+           "jitted functions closing over a Python scalar parameter of "
+           "their builder without static_argnums — each silently "
+           "recompiles when the closed-over value changes.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                wname = project._wrapper_name(node.func)
+                if wname not in ("jit", "pjit") or not node.args:
+                    continue
+                parent = project.parent(f.path, node)
+                # (a) jax.jit(f)(...) — a new cache per invocation
+                if isinstance(parent, ast.Call) and parent.func is node:
+                    yield self.finding(
+                        project, node, f.path,
+                        "jax.jit(...) immediately invoked: every call "
+                        "builds a fresh jit cache and recompiles — bind "
+                        "the jitted function once")
+                    continue
+                # (b) jit construction inside a loop body
+                for anc in project.ancestors(f.path, node):
+                    if isinstance(anc, (ast.For, ast.While,
+                                        ast.AsyncFor)):
+                        yield self.finding(
+                            project, node, f.path,
+                            "jax.jit(...) created inside a loop: each "
+                            "iteration makes a new jitted function and "
+                            "recompiles — hoist the jit out of the loop")
+                        break
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        break
+                # (c) closure over a builder parameter w/o static_argnums
+                if _has_static_treatment(node):
+                    continue
+                caller = project.enclosing_function(f.path, node)
+                for target in project._fn_arg_targets(
+                        node.args[0], caller, f.path):
+                    free = _free_loads(target)
+                    cur = target.parent
+                    seen_params: Set[str] = set()
+                    while cur is not None:
+                        seen_params |= (free & cur.params) - {"self"}
+                        cur = cur.parent
+                    for name in sorted(seen_params):
+                        yield self.finding(
+                            project, node, f.path,
+                            f"jitted `{target.name}` closes over "
+                            f"enclosing parameter `{name}` without "
+                            "static_argnums: a different value silently "
+                            "retraces — key the jit cache on it or mark "
+                            "it static")

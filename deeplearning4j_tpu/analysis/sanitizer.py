@@ -1,0 +1,210 @@
+"""Runtime sanitizer harness — the dynamic half of dl4j-lint.
+
+The static rules catch what is visible in source; this harness catches
+what only exists at runtime: an implicit host transfer the call graph
+hid, a NaN born inside the compiled step, a silent rank promotion, a
+retrace storm from a shape the bucketing ladder missed.  Four
+env-gated modes:
+
+``transfer``
+    Arms ``jax.transfer_guard("disallow")`` around the jitted/pjit'd
+    train-step dispatch (both fit loops) and the serving
+    micro-batcher's compute call.  Every input the step needs is
+    explicitly placed (``jnp.asarray``/``device_put``/``shard_put``)
+    BEFORE the guarded region, so any implicit transfer inside it is a
+    bug by construction.  Compile steps (a fresh ``CompileTelemetry``
+    signature) are exempt — constant materialization during lowering is
+    a legitimate transfer.
+``nans``
+    ``jax_debug_nans``: the step re-runs op-by-op when a NaN appears,
+    pointing at the producing primitive.
+``rank``
+    ``jax_numpy_rank_promotion`` checking.  NOT armed by
+    ``DL4J_SANITIZE=1`` (layer bias adds are rank promotion by design);
+    opt in with ``DL4J_SANITIZE=all`` or ``DL4J_SANITIZE_RANK=warn|raise``.
+``retrace``
+    Budget assertion on ``CompileTelemetry``: a ``fit()`` that retraces
+    more than ``DL4J_SANITIZE_RETRACE_BUDGET`` (default 64) times
+    raises :class:`SanitizerError` at the end of the (otherwise
+    successful) fit — the "your bucketing is not working" alarm.
+
+Switches: ``DL4J_SANITIZE=1`` (transfer+nans+retrace), ``=all`` (the
+four), or a comma list (``DL4J_SANITIZE=transfer,retrace``).
+Programmatic arming for tests: ``with sanitizer.sanitize(modes=...):``
+(the ``dl4j_sanitize`` pytest fixture in tests/conftest.py is exactly
+this).  Violations and armed state meter into the registry
+(``dl4j_sanitizer_*``, docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Iterable, Optional, Tuple
+
+MODES: Tuple[str, ...] = ("transfer", "nans", "rank", "retrace")
+DEFAULT_MODES: Tuple[str, ...] = ("transfer", "nans", "retrace")
+_DEFAULT_RETRACE_BUDGET = 64
+
+_TRUTHY = ("1", "true", "on", "yes")
+_local = threading.local()
+
+
+class SanitizerError(AssertionError):
+    """A sanitizer mode tripped (retrace budget exceeded, or a guarded
+    transfer re-raised with context)."""
+
+
+def _registry():
+    from deeplearning4j_tpu import monitor
+    return monitor.get_registry()
+
+
+def _violation(mode: str) -> None:
+    try:
+        _registry().counter(
+            "dl4j_sanitizer_violations_total",
+            "sanitizer modes tripped (guarded transfer, NaN, retrace "
+            "budget)", labels=("mode",)).labels(mode=mode).inc()
+    except Exception:
+        pass  # the sanitizer must never die on telemetry
+
+
+def _env_modes() -> frozenset:
+    raw = os.environ.get("DL4J_SANITIZE", "").strip().lower()
+    if raw in ("", "0", "false", "off"):
+        base = frozenset()
+    elif raw in _TRUTHY:
+        base = frozenset(DEFAULT_MODES)
+    elif raw == "all":
+        base = frozenset(MODES)
+    else:
+        base = frozenset(m.strip() for m in raw.split(",")
+                         if m.strip() in MODES)
+    if os.environ.get("DL4J_SANITIZE_RANK", "").strip().lower() in (
+            "1", "warn", "raise"):
+        base = base | {"rank"}
+    return base
+
+
+def active_modes() -> frozenset:
+    """Programmatic arming (innermost ``sanitize()`` block) wins over
+    the environment."""
+    stack = getattr(_local, "stack", None)
+    if stack:
+        return stack[-1][0]
+    return _env_modes()
+
+
+def enabled(mode: str) -> bool:
+    return mode in active_modes()
+
+
+def retrace_budget() -> int:
+    stack = getattr(_local, "stack", None)
+    if stack and stack[-1][1] is not None:
+        return stack[-1][1]
+    try:
+        return int(os.environ.get("DL4J_SANITIZE_RETRACE_BUDGET",
+                                  str(_DEFAULT_RETRACE_BUDGET)))
+    except ValueError:
+        return _DEFAULT_RETRACE_BUDGET
+
+
+def _rank_level() -> str:
+    lvl = os.environ.get("DL4J_SANITIZE_RANK", "").strip().lower()
+    return "warn" if lvl == "warn" else "raise"
+
+
+@contextlib.contextmanager
+def sanitize(modes: Iterable[str] = DEFAULT_MODES,
+             retrace_budget: Optional[int] = None):
+    """Programmatically arm sanitizer modes for the current thread —
+    the test-facing surface (see the ``dl4j_sanitize`` fixture)."""
+    bad = set(modes) - set(MODES)
+    if bad:
+        raise ValueError(f"unknown sanitizer modes: {sorted(bad)}")
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    stack.append((frozenset(modes), retrace_budget))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+@contextlib.contextmanager
+def armed_fit(net):
+    """Wrap one ``fit()``: flips the jax debug configs for the duration
+    and asserts the retrace budget (fed by the net's
+    ``CompileTelemetry``) on successful exit."""
+    modes = active_modes()
+    if not modes:
+        yield
+        return
+    import jax
+    try:
+        _registry().gauge(
+            "dl4j_sanitizer_armed",
+            "sanitizer modes currently armed around fit/serve "
+            "(0 = off)").set(len(modes))
+    except Exception:
+        pass
+    saved = {}
+
+    def _flip(key, value):
+        saved[key] = getattr(jax.config, key)
+        jax.config.update(key, value)
+
+    telemetry = getattr(net, "compile_telemetry", None)
+    start_retraces = telemetry.retraces if telemetry is not None else 0
+    ok = False
+    try:
+        if "nans" in modes:
+            _flip("jax_debug_nans", True)
+        if "rank" in modes:
+            _flip("jax_numpy_rank_promotion", _rank_level())
+        yield
+        ok = True
+    except FloatingPointError:
+        _violation("nans")
+        raise
+    finally:
+        for key, value in saved.items():
+            jax.config.update(key, value)
+        try:
+            _registry().gauge("dl4j_sanitizer_armed", "").set(0)
+        except Exception:
+            pass
+    if ok and "retrace" in modes and telemetry is not None:
+        budget = retrace_budget()
+        delta = telemetry.retraces - start_retraces
+        if delta > budget:
+            _violation("retrace")
+            raise SanitizerError(
+                f"retrace budget exceeded: {delta} retraces in one "
+                f"fit() against a budget of {budget} — shapes are not "
+                "bucketing (enable conf.shape_bucketing, or raise "
+                "DL4J_SANITIZE_RETRACE_BUDGET if this workload "
+                "legitimately compiles that many programs)")
+
+
+@contextlib.contextmanager
+def guard_step(compiling: bool = False):
+    """Arm ``jax.transfer_guard("disallow")`` around one jitted step
+    dispatch.  ``compiling=True`` (a fresh jit signature, per
+    ``CompileTelemetry.record``) disarms for that call: constant
+    materialization during lowering transfers legitimately."""
+    if compiling or not enabled("transfer"):
+        yield
+        return
+    import jax
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    except Exception as e:
+        if "transfer" in str(e).lower():
+            _violation("transfer")
+        raise

@@ -449,7 +449,7 @@ class _PipelineRun:
                 "pipeline shutdown: %d thread(s) still in ETL after 5s; "
                 "waiting for in-flight work to finish", len(stuck))
             for t in stuck:
-                t.join()
+                t.join()  # dl4j: noqa[DL4J204] callers touch the shared stateful reader right after shutdown() — in-flight ETL must fully drain
         self.threads = []
 
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.analysis import sanitizer
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.graph_conf import (
@@ -360,7 +361,8 @@ class ComputationGraph:
         skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
         if isinstance(data, MultiDataSet):
             batches = [data]
-            with monitor.profile_if_configured("fit"):
+            with sanitizer.armed_fit(self), \
+                    monitor.profile_if_configured("fit"):
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue
@@ -423,7 +425,10 @@ class ComputationGraph:
                 yield from it
 
         try:
-            with monitor.profile_if_configured("fit"):
+            # DL4J_SANITIZE: debug-nans/rank checks for the duration,
+            # retrace-budget assertion on clean exit (analysis/sanitizer)
+            with sanitizer.armed_fit(self), \
+                    monitor.profile_if_configured("fit"):
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue  # resumed past this epoch entirely
@@ -481,7 +486,7 @@ class ComputationGraph:
                 (jnp.arange(k), xs, ys, fms, lms))
             return params, state, opts, scores[-1]
 
-        return jax.jit(k_steps, donate_argnums=(0, 1, 2))
+        return jax.jit(k_steps, donate_argnums=(0, 1, 2))  # dl4j: noqa[DL4J104] one jitted fn per k, cached in _fused_fns[k]
 
     def _fit_fused_group(self, group):
         if self.net_params is None:
@@ -539,16 +544,17 @@ class ComputationGraph:
                           group[0].features_masks is not None)
         lms = stack_tuple(lambda m: m.labels_masks,
                           group[0].labels_masks is not None)
-        self.compile_telemetry.record(f"fused_step_k{k}",
-                                      (xs, ys, fms, lms))
+        fresh = self.compile_telemetry.record(f"fused_step_k{k}",
+                                              (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
+        it_arr = jnp.asarray(self.iteration, jnp.int32)
         t_step = time.perf_counter()
-        with monitor.span("fit/step", phase="jit_call"):
+        with monitor.span("fit/step", phase="jit_call"), \
+                sanitizer.guard_step(compiling=fresh):
             (self.net_params, self.net_state, self.opt_states,
              score) = self._fused_fns[k](
                 self.net_params, self.net_state, self.opt_states,
-                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
-                sub)
+                xs, ys, fms, lms, it_arr, sub)
         with monitor.span("fit/step", phase="block_until_ready"):
             jax.block_until_ready(score)
         self._strip_rnn_state()
@@ -642,8 +648,8 @@ class ComputationGraph:
                 return
             batch, n, bucket = norm
             self.last_batch_size = n
-            self.compile_telemetry.record("sharded_step", batch,
-                                          bucket=bucket)
+            fresh = self.compile_telemetry.record("sharded_step", batch,
+                                                  bucket=bucket)
             with monitor.span("fit/step", phase="shard_h2d"):
                 xs, ys, fm, lm = fsdp.shard_put(plan, batch)
         else:
@@ -658,14 +664,18 @@ class ComputationGraph:
                 lm = (tuple(None if m is None else jnp.asarray(m)
                             for m in mds.labels_masks)
                       if mds.labels_masks is not None else None)
-            self.compile_telemetry.record("train_step", (xs, ys, fm, lm),
-                                          bucket=bucket)
+            fresh = self.compile_telemetry.record(
+                "train_step", (xs, ys, fm, lm), bucket=bucket)
         self._key, sub = jax.random.split(self._key)
-        with monitor.span("fit/step", phase="jit_call"):
+        # the iteration scalar moves H2D here, OUTSIDE the guarded
+        # dispatch — inside it every transfer is a bug
+        it_arr = jnp.asarray(self.iteration, jnp.int32)
+        with monitor.span("fit/step", phase="jit_call"), \
+                sanitizer.guard_step(compiling=fresh):
             (self.net_params, self.net_state, self.opt_states,
              score) = self._step_fn(
                 self.net_params, self.net_state, self.opt_states, xs, ys,
-                fm, lm, jnp.asarray(self.iteration, jnp.int32), sub)
+                fm, lm, it_arr, sub)
         with monitor.span("fit/step", phase="block_until_ready"):
             jax.block_until_ready(score)
         self._strip_rnn_state()
@@ -807,7 +817,10 @@ class ComputationGraph:
                 pairs.append((t, b[1]))
             inputs = xs_p
             if any(m is not None for m in ms_p):
-                masks = tuple(ms_p)
+                # explicit H2D for the masks, like the inputs below — a
+                # numpy mask handed to the jitted fn transfers implicitly
+                masks = tuple(None if m is None else jnp.asarray(m)
+                              for m in ms_p)
             bucket = (b[0], tuple(tb for _, tb in pairs))
             unpad = (n, pairs)
         xs = tuple(jnp.asarray(x) for x in inputs)
@@ -931,7 +944,7 @@ class ComputationGraph:
             else:
                 feats, labels = ds.features, ds.labels
             outs = self.output(*feats)
-            ev.eval(labels[output_idx], np.asarray(outs[output_idx]))
+            ev.eval(labels[output_idx], jax.device_get(outs[output_idx]))
         return ev
 
     # ------------------------------------------------------------------

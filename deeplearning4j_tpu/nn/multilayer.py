@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.analysis import sanitizer
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
@@ -515,7 +516,10 @@ class MultiLayerNetwork:
                 if (self.conf.backprop_type != "truncatedbptt"
                     and self.conf.global_conf.iterations <= 1) else 1)
         try:
-            with monitor.profile_if_configured("fit"):
+            # DL4J_SANITIZE: debug-nans/rank checks for the duration,
+            # retrace-budget assertion on clean exit (analysis/sanitizer)
+            with sanitizer.armed_fit(self), \
+                    monitor.profile_if_configured("fit"):
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue  # resumed past this epoch entirely
@@ -590,7 +594,7 @@ class MultiLayerNetwork:
                 (jnp.arange(k), xs, ys, fms, lms))
             return params, state, opts, scores[-1]
 
-        return jax.jit(k_steps, donate_argnums=(0, 1, 2))
+        return jax.jit(k_steps, donate_argnums=(0, 1, 2))  # dl4j: noqa[DL4J104] one jitted fn per k, cached in _fused_fns[k]
 
     def _fit_fused_group(self, group):
         if getattr(self, "_sharding_plan", None) is not None:
@@ -630,15 +634,16 @@ class MultiLayerNetwork:
                    if group[0].features_mask is not None else None)
             lms = (jnp.stack([jnp.asarray(d.labels_mask) for d in group])
                    if group[0].labels_mask is not None else None)
-        self.compile_telemetry.record(f"fused_step_k{k}",
-                                      (xs, ys, fms, lms))
+        fresh = self.compile_telemetry.record(f"fused_step_k{k}",
+                                              (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
-        with monitor.span("fit/step", phase="jit_call"):
+        it_arr = jnp.asarray(self.iteration, jnp.int32)
+        with monitor.span("fit/step", phase="jit_call"), \
+                sanitizer.guard_step(compiling=fresh):
             (self.net_params, self.net_state, self.opt_states,
              score) = self._fused_fns[k](
                 self.net_params, self.net_state, self.opt_states,
-                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
-                sub)
+                xs, ys, fms, lms, it_arr, sub)
         with monitor.span("fit/step", phase="block_until_ready"):
             jax.block_until_ready(score)
         self._strip_rnn_state()
@@ -690,15 +695,16 @@ class MultiLayerNetwork:
         with monitor.span("fit/step", phase="shard_h2d"):
             xs, ys, fms, lms = fsdp.stack_for_scan(
                 plan, [b for b, _, _ in norms])
-        self.compile_telemetry.record(f"fused_step_k{k}",
-                                      (xs, ys, fms, lms))
+        fresh = self.compile_telemetry.record(f"fused_step_k{k}",
+                                              (xs, ys, fms, lms))
         self._key, sub = jax.random.split(self._key)
-        with monitor.span("fit/step", phase="jit_call"):
+        it_arr = jnp.asarray(self.iteration, jnp.int32)
+        with monitor.span("fit/step", phase="jit_call"), \
+                sanitizer.guard_step(compiling=fresh):
             (self.net_params, self.net_state, self.opt_states,
              score) = self._fused_fns[k](
                 self.net_params, self.net_state, self.opt_states,
-                xs, ys, fms, lms, jnp.asarray(self.iteration, jnp.int32),
-                sub)
+                xs, ys, fms, lms, it_arr, sub)
         with monitor.span("fit/step", phase="block_until_ready"):
             jax.block_until_ready(score)
         self._strip_rnn_state()
@@ -731,8 +737,8 @@ class MultiLayerNetwork:
                 return
             batch, n, bucket = norm
             self.last_batch_size = n
-            self.compile_telemetry.record("sharded_step", batch,
-                                          bucket=bucket)
+            fresh = self.compile_telemetry.record("sharded_step", batch,
+                                                  bucket=bucket)
             with monitor.span("fit/step", phase="shard_h2d"):
                 # host→mesh scatter: each device receives only its batch
                 # shard (the sharded step's in_shardings layout)
@@ -740,7 +746,7 @@ class MultiLayerNetwork:
         else:
             with monitor.span("fit/step", phase="bucket"):
                 ds, bucket = self._maybe_bucket_train(ds)
-            self.compile_telemetry.record(
+            fresh = self.compile_telemetry.record(
                 "train_step", (ds.features, ds.labels, ds.features_mask,
                                ds.labels_mask), bucket=bucket)
             with monitor.span("fit/step", phase="h2d"):
@@ -755,12 +761,15 @@ class MultiLayerNetwork:
                          else jnp.asarray(ds.labels_mask))
         for _ in range(max(1, g.iterations)):
             self._key, sub = jax.random.split(self._key)
-            with monitor.span("fit/step", phase="jit_call"):
+            # the iteration scalar moves H2D here, OUTSIDE the guarded
+            # dispatch — inside it every transfer is a bug
+            it_arr = jnp.asarray(self.iteration, jnp.int32)
+            with monitor.span("fit/step", phase="jit_call"), \
+                    sanitizer.guard_step(compiling=fresh):
                 (self.net_params, self.net_state, self.opt_states,
                  score) = self._step_fn(
                     self.net_params, self.net_state, self.opt_states,
-                    feats, labels, fmask, lmask,
-                    jnp.asarray(self.iteration, jnp.int32), sub)
+                    feats, labels, fmask, lmask, it_arr, sub)
             with monitor.span("fit/step", phase="block_until_ready"):
                 jax.block_until_ready(score)
             self._strip_rnn_state()
@@ -772,6 +781,7 @@ class MultiLayerNetwork:
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration)
             t_step = time.perf_counter()
+            fresh = False
 
     def _fit_tbptt(self, ds):
         """Truncated BPTT over time segments, carrying RNN state
@@ -865,7 +875,7 @@ class MultiLayerNetwork:
             new_lp = {k: lp[k] - upd[k] for k in lp}
             return new_lp, new_opt, loss
 
-        step_jit = jax.jit(pre_step, donate_argnums=(0, 1))
+        step_jit = jax.jit(pre_step, donate_argnums=(0, 1))  # dl4j: noqa[DL4J104] one pretrain jit per layer by design
         for _ in range(epochs):
             data.reset()
             while data.has_next():
@@ -910,7 +920,8 @@ class MultiLayerNetwork:
         out = self._output_fn(self.net_params,
                               [{k: v for k, v in s.items() if k != "rnn_state"}
                                for s in self.net_state],
-                              jnp.asarray(x), mask)
+                              jnp.asarray(x),
+                              None if mask is None else jnp.asarray(mask))
         if unpad is not None:
             out = bucketing.unpad_outputs(out, *unpad)
         return out
@@ -918,7 +929,7 @@ class MultiLayerNetwork:
     def predict(self, x) -> np.ndarray:
         """Argmax class predictions (ref: MultiLayerNetwork.predict :1456)."""
         out = self.output(x)
-        return np.asarray(jnp.argmax(out, axis=-1))
+        return jax.device_get(jnp.argmax(out, axis=-1))
 
     def warmup_inference(self, feature_dims, max_batch: int = 32,
                          batch_sizes=None, dtype=np.float32) -> dict:
@@ -1255,7 +1266,7 @@ class MultiLayerNetwork:
             batches = iterator_or_dataset
         for ds in batches:
             out = self.output(ds.features)
-            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+            ev.eval(ds.labels, jax.device_get(out), mask=ds.labels_mask)
         return ev
 
     def clone(self) -> "MultiLayerNetwork":

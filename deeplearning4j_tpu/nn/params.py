@@ -53,7 +53,7 @@ def flatten(params: List[dict]) -> jnp.ndarray:
     if any(isinstance(l, jax.core.Tracer) for l in leaves):
         return jnp.concatenate([jnp.ravel(l) for l in leaves])
     return jnp.asarray(np.concatenate(
-        [np.ravel(np.asarray(l)) for l in leaves]))
+        [np.ravel(np.asarray(l)) for l in leaves]))  # dl4j: noqa[DL4J102] tracer-guarded host gather — the traced branch above uses jnp
 
 
 def unflatten(flat, template: List[dict]) -> List[dict]:

@@ -32,7 +32,7 @@ def _nhwc_internal() -> bool:
     verdict weak #4.  Read at TRACE time: flip it before building a
     model, not between steps of an already-jitted one."""
     import os
-    return os.environ.get("DL4J_CONV_LAYOUT", "").lower() == "nhwc"
+    return os.environ.get("DL4J_CONV_LAYOUT", "").lower() == "nhwc"  # dl4j: noqa[DL4J103] env flag read at trace time by design (fixed per process)
 
 
 def _same_pad(kernel: Sequence[int], stride: Sequence[int], pad: Sequence[int],

@@ -79,7 +79,7 @@ def _fused_xent_wanted(labels, preout, mask) -> bool:
     row-level masks (a per-class mask needs the elementwise path).
     DL4J_FUSED_XENT=1|0 overrides for testing."""
     import os
-    env = os.environ.get("DL4J_FUSED_XENT")
+    env = os.environ.get("DL4J_FUSED_XENT")  # dl4j: noqa[DL4J103] env flag read at trace time by design (fixed per process)
     if env == "0":
         return False
     if preout.ndim < 2 or preout.shape != labels.shape:

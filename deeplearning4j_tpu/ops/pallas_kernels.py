@@ -56,7 +56,7 @@ def _on_tpu() -> bool:
     # the bench chip registers via the experimental 'axon' PJRT plugin and
     # a string compare against "tpu" would force interpret-mode emulation.
     import os
-    if os.environ.get("DL4J_PALLAS") == "0":
+    if os.environ.get("DL4J_PALLAS") == "0":  # dl4j: noqa[DL4J103] env flag read at trace time by design (fixed per process)
         return False
     from deeplearning4j_tpu.ops import platform
     return platform.is_tpu()
@@ -518,8 +518,8 @@ def kernel_self_test(disable_on_error: bool = True) -> dict:
 
         def loss(q, k, v):
             return flash_attention(q, k, v, km, causal=True).sum()
-        out, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
-            q, k, v)
+        vg = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+        out, grads = vg(q, k, v)
         jax.block_until_ready(grads)
         if not bool(jnp.isfinite(out)):
             raise FloatingPointError("non-finite flash attention loss")
@@ -532,7 +532,8 @@ def kernel_self_test(disable_on_error: bool = True) -> dict:
 
         def loss(lg):
             return softmax_xent_rows(lg, labels).mean()
-        out, g = jax.jit(jax.value_and_grad(loss))(logits)
+        vg = jax.jit(jax.value_and_grad(loss))
+        out, g = vg(logits)
         jax.block_until_ready(g)
         if not bool(jnp.isfinite(out)):
             raise FloatingPointError("non-finite fused xent loss")

@@ -20,7 +20,7 @@ import os
 def is_tpu() -> bool:
     """True when the default JAX backend is TPU hardware, however the
     PJRT plugin chooses to register itself."""
-    env = os.environ.get("DL4J_TPU")
+    env = os.environ.get("DL4J_TPU")  # dl4j: noqa[DL4J103] env flag read at trace time by design (fixed per process)
     if env is not None and env != "":
         return env not in ("0", "false", "False")
     return _probe_is_tpu()

@@ -61,7 +61,7 @@ def schedule_lr(base_lr, policy: Optional[str], iteration, *,
         # schedule_map: {iteration: lr}; piecewise-constant, traced via where-chain.
         lr = jnp.asarray(base_lr, jnp.float32)
         for k in sorted(schedule_map or {}, key=float):
-            lr = jnp.where(it >= float(k), jnp.asarray(schedule_map[k], jnp.float32), lr)
+            lr = jnp.where(it >= float(k), jnp.asarray(schedule_map[k], jnp.float32), lr)  # dl4j: noqa[DL4J101] k is a host-side schedule-dict key, never traced
         return lr
     raise ValueError(f"Unknown learning rate policy '{policy}'")
 

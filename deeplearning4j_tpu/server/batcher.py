@@ -33,11 +33,13 @@ import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.analysis import sanitizer
 from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
@@ -108,11 +110,13 @@ class ServingMetrics:
         self._c_batches.inc()
 
     def snapshot(self) -> dict:
+        # one acquisition for the whole snapshot: two sequential locked
+        # reads could interleave with a record_batch/record_shed and
+        # return counters from two different instants
         with self._lock:
             requests, rows, batches = self.requests, self.rows, self.batches
             hist = {str(k): v for k, v in
                     sorted(self.batch_size_hist.items())}
-        with self._lock:
             shed = dict(self.shed)
         return {
             "requests": requests,
@@ -319,8 +323,13 @@ class MicroBatcher:
                         x = np.concatenate(
                             [x, np.zeros((nb - n,) + x.shape[1:], x.dtype)])
             t0 = time.perf_counter()
-            with monitor.span("serve/batch", phase="compute"):
-                out = np.asarray(self._infer_fn(x))[:n]
+            with monitor.span("serve/batch", phase="compute"), \
+                    sanitizer.guard_step():
+                # explicit device->host pull (jax.device_get), not an
+                # implicit np.asarray sync: the sanitizer's transfer
+                # guard allows explicit transfers, and a non-jax output
+                # (plain numpy infer_fn) passes through unchanged
+                out = np.asarray(jax.device_get(self._infer_fn(x)))[:n]
             t1 = time.perf_counter()
             i = 0
             for p in group:

@@ -22,6 +22,19 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.default_backend() == "cpu", jax.default_backend()
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def dl4j_sanitize():
+    """Arm the runtime sanitizer (transfer guard + debug-nans + retrace
+    budget) for one test — the fixture surface of
+    ``deeplearning4j_tpu.analysis.sanitizer`` (docs/ANALYSIS.md)."""
+    from deeplearning4j_tpu.analysis import sanitizer
+    with sanitizer.sanitize(modes=("transfer", "nans", "retrace")):
+        yield sanitizer
+
+
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`; register the marker so the serving
     # load-generator test (and future slow cases) don't warn

@@ -269,8 +269,8 @@ class TestExternalGradientsPrecision:
 
         # jit the reference too: un-jitted XLA:CPU keeps bf16 chains in
         # f32 registers, so only jit-vs-jit is exactly comparable
-        want_p, want_x = jax.jit(jax.grad(loss, argnums=(0, 1)))(
-            net.net_params, jnp.asarray(x))
+        ref_grad = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        want_p, want_x = ref_grad(net.net_params, jnp.asarray(x))
         for g, w in zip(grads, want_p):
             for k in w:
                 np.testing.assert_allclose(g[k], w[k], rtol=1e-3, atol=1e-4)

@@ -501,6 +501,9 @@ def test_bench_dry_run_emits_record_on_cpu():
     assert "bench_sharded" in rec["configs"]
     assert rec.get("machine", {}).get("host"), "machine fingerprint missing"
     assert "metrics_registry" in rec
+    # the dry run also gates dl4j-lint: zero unsuppressed findings
+    assert rec.get("lint", {}).get("exit_code") == 0, rec.get("lint")
+    assert rec["lint"]["gating"] == 0
     assert rec.get("platform_forced") == "cpu" or "cpu" in str(
         rec.get("platform", ""))
 
