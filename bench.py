@@ -985,6 +985,143 @@ def bench_ragged():
     }
 
 
+def bench_kernels():
+    """Fused-vs-dense helper-tier A/B (ops/helpers.py): for each op with
+    a registered Pallas helper (conv2d+bias+act, the fused LSTM cell
+    inside lstm_scan, in-kernel threshold dropout, fused softmax-xent),
+    run the same jitted fwd+bwd workload with the tier forced FUSED and
+    forced DENSE and report both throughputs, window variance and the
+    speedup.  On CPU the fused legs execute under interpret=True — they
+    prove the A/B harness and measure dispatch overhead, not the win
+    (same caveat as bench_sharded's CPU-mesh legs); chip numbers are the
+    evidence.  The flash-attention tier is exercised by the model
+    configs (charrnn/attention paths), not re-benched here."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import helpers
+    from deeplearning4j_tpu.ops import losses
+    from deeplearning4j_tpu.ops import platform
+    from deeplearning4j_tpu.ops import recurrent as rnn_ops
+
+    on_tpu = platform.is_tpu()
+    if on_tpu:
+        conv_n, conv_cin, conv_hw, conv_cout = 64, 64, 32, 64
+        lstm_n, lstm_t, lstm_in, lstm_h = 32, 64, 128, 256
+        xent_n, xent_v = 8192, 4096
+        drop_shape = (4096, 1024)
+        steps, windows, warmup = 10, 3, 3
+    else:  # interpret-mode legs: keep the working set tiny
+        conv_n, conv_cin, conv_hw, conv_cout = 4, 4, 12, 12
+        lstm_n, lstm_t, lstm_in, lstm_h = 4, 8, 8, 32
+        xent_n, xent_v = 256, 512
+        drop_shape = (256, 256)
+        steps, windows, warmup = 2, 2, 1
+    rng = np.random.default_rng(0)
+
+    def _time(build, items_per_step):
+        fn, args = build()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        holder = [out]
+
+        def run():
+            holder[0] = fn(*args)
+        times = timed_windows(run, lambda: jax.block_until_ready(holder[0]),
+                              steps, windows=windows, warmup=warmup)
+        return window_stats(times, items_per_step, steps)
+
+    def conv_build():
+        x = jnp.asarray(rng.normal(
+            size=(conv_n, conv_cin, conv_hw, conv_hw)), jnp.float32)
+        w = jnp.asarray(rng.normal(
+            size=(conv_cout, conv_cin, 3, 3)) * 0.2, jnp.float32)
+        b = jnp.zeros((conv_cout,), jnp.float32)
+
+        def loss(x, w, b):
+            return jnp.sum(helpers.conv2d_bias_act(
+                x, w, b, border_mode="same", activation="relu") ** 2)
+        return jax.jit(jax.value_and_grad(loss, argnums=(1, 2))), (x, w, b)
+
+    def lstm_build():
+        p = {"W": jnp.asarray(rng.normal(
+                 size=(lstm_in, 4 * lstm_h)) * 0.2, jnp.float32),
+             "RW": jnp.asarray(rng.normal(
+                 size=(lstm_h, 4 * lstm_h)) * 0.2, jnp.float32),
+             "b": jnp.zeros((4 * lstm_h,), jnp.float32),
+             "pI": jnp.zeros((lstm_h,), jnp.float32),
+             "pF": jnp.zeros((lstm_h,), jnp.float32),
+             "pO": jnp.zeros((lstm_h,), jnp.float32)}
+        x = jnp.asarray(rng.normal(
+            size=(lstm_n, lstm_t, lstm_in)), jnp.float32)
+
+        def loss(p, x):
+            hs, _ = rnn_ops.lstm_scan(p, x)
+            return jnp.sum(hs ** 2)
+        return jax.jit(jax.grad(loss)), (p, x)
+
+    def xent_build():
+        logits = jnp.asarray(rng.normal(size=(xent_n, xent_v)), jnp.float32)
+        y = jnp.asarray(np.eye(xent_v, dtype=np.float32)[
+            rng.integers(0, xent_v, xent_n)])
+
+        def loss(lg):
+            return jnp.sum(losses.mcxent(y, lg, "softmax"))
+        return jax.jit(jax.value_and_grad(loss)), (logits,)
+
+    def drop_build():
+        x = jnp.asarray(rng.normal(size=drop_shape), jnp.float32)
+        key = jax.random.PRNGKey(3)
+
+        def loss(x):
+            return jnp.sum(helpers.dropout(x, 0.8, key) ** 2)
+        return jax.jit(jax.grad(loss)), (x,)
+
+    workloads = {
+        "conv2d": ("DL4J_PALLAS_CONV", conv_build, conv_n),
+        "lstm_step": ("DL4J_PALLAS_LSTM", lstm_build, lstm_n * lstm_t),
+        "softmax_xent": ("DL4J_FUSED_XENT", xent_build, xent_n),
+        "dropout": ("DL4J_PALLAS_DROPOUT", drop_build,
+                    drop_shape[0] * drop_shape[1]),
+    }
+    ops = {}
+    speedups = []
+    for op, (env_key, build, items) in workloads.items():
+        saved = os.environ.get(env_key)
+        try:
+            os.environ[env_key] = "1"   # selection reads env at trace time
+            fused = _time(build, items)
+            os.environ[env_key] = "0"
+            dense = _time(build, items)
+        finally:
+            if saved is None:
+                os.environ.pop(env_key, None)
+            else:
+                os.environ[env_key] = saved
+        sp = (fused["items_per_sec_median"]
+              / max(dense["items_per_sec_median"], 1e-9))
+        speedups.append(sp)
+        ops[op] = {"fused": fused, "dense": dense,
+                   "speedup_fused_vs_dense": round(sp, 3)}
+    geomean = float(np.prod(speedups) ** (1.0 / len(speedups)))
+    return {
+        "metric": "fused-kernel helper tier, fused/dense throughput "
+                  "(geomean over ops)",
+        "value": round(geomean, 3),
+        "unit": "x",
+        "emulated_interpret_mode": not on_tpu,
+        "self_test": pk_self_test_summary(),
+        **ops,
+    }
+
+
+def pk_self_test_summary():
+    """One-line helper verdicts for the bench record (full report lands
+    in result['pallas_kernels'])."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    return {t: ("disabled: " + r[:80]) for t, r in pk._disabled.items()} \
+        or "all tiers healthy"
+
+
 def bench_serving():
     """Closed-loop serving A/B: 8 client threads issue small
     ``predict(features=...)`` requests against the gateway entry point —
@@ -1376,6 +1513,7 @@ def _run_configs(result):
         ("bench_serving", bench_serving),
         ("bench_resilience", bench_resilience),
         ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
+        ("bench_kernels", bench_kernels),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
         ("word2vec", bench_word2vec),
@@ -1402,9 +1540,9 @@ def _run_configs(result):
         # whole wall-clock budget — run the cheap configs first so a
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
-                 "bench_pipeline", "bench_serving", "bench_resilience",
-                 "bench_sharded", "charrnn", "word2vec", "vgg16",
-                 "resnet50"]
+                 "bench_kernels", "bench_pipeline", "bench_serving",
+                 "bench_resilience", "bench_sharded", "charrnn", "word2vec",
+                 "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":

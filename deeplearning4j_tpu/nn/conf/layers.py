@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.ops import activations as act_ops
 from deeplearning4j_tpu.ops import convolution as conv_ops
+from deeplearning4j_tpu.ops import helpers as helper_ops
 from deeplearning4j_tpu.ops import initializers
 from deeplearning4j_tpu.ops import losses as loss_ops
 from deeplearning4j_tpu.ops import normalization as norm_ops
@@ -107,7 +108,9 @@ class Layer:
         if self.use_drop_connect:
             return x
         if train and self.dropout and 0.0 < self.dropout < 1.0 and rng is not None:
-            return norm_ops.dropout(x, self.dropout, rng)
+            # helper selection (ops/helpers.py): in-kernel threshold
+            # dropout on TPU, jax.random.bernoulli fallback elsewhere
+            return helper_ops.dropout(x, self.dropout, rng)
         return x
 
     def _maybe_drop_connect(self, params: dict, train: bool, rng):
@@ -324,9 +327,13 @@ class ConvolutionLayer(Layer):
     def forward(self, params, state, x, *, train, rng, mask=None):
         x = self._maybe_dropout(x, train, rng)
         p = self._maybe_drop_connect(params, train, rng)
-        y = conv_ops.conv2d(x, p["W"], p["b"], self.stride,
-                            self.padding, self.dilation, self.convolution_mode)
-        return self._act(y), state, mask
+        # helper selection (ops/helpers.py): conv+bias+activation as one
+        # fused Pallas VMEM pass when the conv tier selects; the dense
+        # conv-HLO → bias → activation chain otherwise
+        y = helper_ops.conv2d_bias_act(
+            x, p["W"], p["b"], self.stride, self.padding, self.dilation,
+            self.convolution_mode, self.activation or "identity")
+        return y, state, mask
 
     def output_type(self, input_type):
         oh, ow = conv_ops.conv2d_output_shape(

@@ -352,6 +352,11 @@ class ComputationGraph:
                     and self.conf.global_conf.iterations <= 1) else 1)
         if self.net_params is None:
             self.init()
+        # warm-validate the fused-kernel helper tier (ops/helpers.py) —
+        # same contract as MultiLayerNetwork.fit: a kernel rejection
+        # disables its tier before the first step traces
+        from deeplearning4j_tpu.ops import helpers as pallas_helpers
+        pallas_helpers.ensure_validated()
         self._check_trace_token()
         self._ensure_sharding()
         # crash-safe resume (conf.fault_tolerance(resume=True)) — same

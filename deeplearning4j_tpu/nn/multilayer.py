@@ -471,6 +471,11 @@ class MultiLayerNetwork:
         if self.net_params is None:
             self.init()
         bucketing.maybe_enable_persistent_cache()
+        # warm-validate the fused-kernel helper tier (ops/helpers.py)
+        # BEFORE the first step traces: a Mosaic rejection flips that
+        # tier's kill switch here instead of killing the training run
+        from deeplearning4j_tpu.ops import helpers as pallas_helpers
+        pallas_helpers.ensure_validated()
         self._check_trace_token()
         self._ensure_sharding()
         if self._step_fn is None:

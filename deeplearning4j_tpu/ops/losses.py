@@ -74,27 +74,22 @@ def xent(labels, preout, activation="sigmoid", mask=None):
 
 def _fused_xent_wanted(labels, preout, mask) -> bool:
     """Dispatch gate for the Pallas fused softmax+CE kernel
-    (ops/pallas_kernels.softmax_xent_rows): TPU only, wide-vocab rows
-    where the saved HBM round-trips pay for the kernel launch, and only
-    row-level masks (a per-class mask needs the elementwise path).
-    DL4J_FUSED_XENT=1|0 overrides for testing."""
-    import os
-    env = os.environ.get("DL4J_FUSED_XENT")  # dl4j: noqa[DL4J103] env flag read at trace time by design (fixed per process)
-    if env == "0":
-        return False
+    (ops/pallas_kernels.softmax_xent_rows): shape/mask legality decided
+    here (only row-level masks — a per-class mask needs the elementwise
+    path); platform/size selection delegated to the helper tier
+    (ops/helpers.softmax_xent_wanted, which also meters the decision and
+    honors the DL4J_FUSED_XENT=1|0 test override)."""
     if preout.ndim < 2 or preout.shape != labels.shape:
         return False
     if mask is not None and mask.ndim == preout.ndim \
             and mask.shape[-1] == preout.shape[-1] and preout.shape[-1] != 1:
         return False  # genuine per-class mask
-    if env == "1":
-        return True
-    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    from deeplearning4j_tpu.ops import helpers
     V = preout.shape[-1]
     n_rows = 1
     for d in preout.shape[:-1]:
         n_rows *= d
-    return pk.xent_available() and V >= 128 and n_rows * V >= (1 << 16)
+    return helpers.softmax_xent_wanted(n_rows, V)
 
 
 def mcxent(labels, preout, activation="softmax", mask=None):

@@ -2,7 +2,12 @@
 (SURVEY.md §7: the native-kernel tier; the reference's analog is the
 fused libnd4j Aggregate ops + cuDNN helpers, §2.3/§2.10).
 
-Two kernels:
+This module holds the KERNELS and their shape/dtype support predicates;
+per-layer selection between a kernel and its dense XLA fallback lives in
+``ops/helpers.py`` (the cuDNN-helper-selection tier: registry, per-tier
+kill switches, warm validation, ``dl4j_pallas_*`` selection metrics).
+
+Five kernels:
 
 * **flash_attention** — block-wise online-softmax attention.  The dense
   XLA path materializes the [B, H, T, T] score matrix in HBM; this
@@ -22,7 +27,31 @@ Two kernels:
   writing the [N, V] probability matrix to HBM twice (once for loss,
   once for grad).
 
-Both run under ``interpret=True`` off-TPU so the same code is testable
+* **fused_conv2d_bias_act** — stride-1 2D convolution + bias + an
+  elementwise activation in one VMEM pass (the Pallas analog of the
+  reference's CudnnConvolutionHelper fused conv+bias+act path,
+  ConvolutionLayer.java:171-212): the KH·KW input patches stream
+  through the MXU as back-to-back [OH·OW, Cin]·[Cin, Cout] tiles and
+  the bias-add + activation happen on the accumulator before it ever
+  leaves VMEM — the unfused chain writes the conv result, the biased
+  result AND the activated result to HBM.  Backward recomputes via the
+  XLA reference (``jax.vjp``), so gradients are exactly the dense
+  gradients.
+
+* **fused_lstm_step** — one peephole-LSTM timestep (the scan body of
+  ``ops/recurrent.lstm_scan``) in one VMEM pass: the [N, H]·[H, 4H]
+  recurrent matmul plus ALL the elementwise gate math (2 peephole
+  muls, 3 sigmoids, 2 tanhs, the cell/hidden updates) that XLA:TPU
+  otherwise schedules as separate HLO ops per timestep.  Backward
+  recomputes through the XLA reference cell.
+
+* **fused_threshold_dropout** — inverted dropout whose mask is a
+  counter-hash THRESHOLD test computed inside the kernel (the
+  libnd4j-style threshold dropout): no [N, ...] mask tensor is ever
+  materialized in HBM, and the backward pass re-derives the same mask
+  from the seed instead of saving it.
+
+All run under ``interpret=True`` off-TPU so the same code is testable
 on the CPU mesh (the reference's cuDNN-vs-builtin cross-check pattern,
 SURVEY.md §4)."""
 
@@ -40,15 +69,27 @@ NEG_INF = -1e30
 
 # Runtime kill switches, PER KERNEL TIER: set by kernel_self_test() when
 # a Mosaic compile fails on the real chip, so one bad kernel degrades to
-# the dense XLA path without disabling the other, healthy one (the
+# the dense XLA path without disabling the other, healthy ones (the
 # cuDNN-helper-with-builtin-fallback pattern, ref
-# ConvolutionLayer.java:157-212).  DL4J_PALLAS=0 disables everything.
-_disabled: dict = {}  # tier ("flash" | "xent") -> reason
+# ConvolutionLayer.java:157-212).  DL4J_PALLAS=0 disables everything;
+# per-tier state is read by ops/helpers.available().
+ALL_TIERS = ("flash", "xent", "conv", "lstm", "dropout")
+_disabled: dict = {}  # tier -> reason
 
 
 def disable_kernels(reason: str, tier: Optional[str] = None) -> None:
-    for t in ((tier,) if tier else ("flash", "xent")):
+    tiers = (tier,) if tier else ALL_TIERS
+    for t in tiers:
         _disabled[t] = reason
+    try:  # mirror the kill-switch state into the monitor registry
+        from deeplearning4j_tpu import monitor
+        g = monitor.get_registry().gauge(
+            "dl4j_pallas_tier_disabled",
+            "kernel-tier kill switch (1 = disabled)", labels=("tier",))
+        for t in tiers:
+            g.labels(tier=t).set(1)
+    except Exception:
+        pass  # metering must never break kernel dispatch
 
 
 def _on_tpu() -> bool:
@@ -184,10 +225,14 @@ def _recompute_p(q_blk, k_blk, lse_blk, mask_blk, q_pos, k_pos, causal,
     live = mask_blk > 0                                   # [1, BK]
     if causal:
         live = jnp.logical_and(live, q_pos >= k_pos)      # [BQ, BK]
-    # where() (not exp of a masked score) so fully-masked rows whose lse
-    # is NEG_INF don't produce exp(-inf - -inf) = 1
-    p = jnp.exp(s - lse_blk[:, None])
-    return jnp.where(live, p, 0.0)
+    # Fully-masked/T-pad query rows carry lse = NEG_INF; exponentiating
+    # s - (-1e30) would overflow to inf and 0·inf = NaN would leak into
+    # dk/dv, so clamp the EXPONENT (not the result) to NEG_INF wherever
+    # the tile is dead — exp then yields an exact 0.
+    row_live = lse_blk[:, None] > NEG_INF * 0.5           # [BQ, 1]
+    expo = jnp.where(jnp.logical_and(live, row_live),
+                     s - lse_blk[:, None], NEG_INF)
+    return jnp.exp(expo)
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
@@ -377,25 +422,41 @@ def flash_attention(q, k, v, key_mask, causal: bool = False,
     both directions.  q,k,v: [B,H,T,D]; key_mask [B,T] (1=keep).  scale
     defaults to 1/sqrt(D) of the ORIGINAL head dim; head dims that are
     not lane-tileable (64, 96, ...) are zero-padded to the next multiple
-    of 128 — zero k/v columns change neither scores nor outputs, and the
-    pad/slice sits outside the custom_vjp so gradients pass through."""
+    of 128, and sequence lengths that don't tile into the 128-row blocks
+    (ragged/bucketed ladders) are zero-padded along T with a ZEROED key
+    mask — masked keys change no real row, and fully-masked pad query
+    rows come out 0 with lse = NEG_INF so the backward recomputation
+    drops them (see _recompute_p).  Both pad/slice pairs sit outside the
+    custom_vjp so gradients pass through."""
     D = q.shape[-1]
+    T = q.shape[2]
     s = scale if scale is not None else 1.0 / (D ** 0.5)
-    pad = (-D) % LANE
-    if pad:
-        widths = [(0, 0)] * 3 + [(0, pad)]
+    pad_d = (-D) % LANE
+    pad_t = (-T) % LANE
+    if pad_d:
+        widths = [(0, 0)] * 3 + [(0, pad_d)]
         q = jnp.pad(q, widths)
         k = jnp.pad(k, widths)
         v = jnp.pad(v, widths)
+    if pad_t:
+        widths_t = [(0, 0), (0, 0), (0, pad_t), (0, 0)]
+        q = jnp.pad(q, widths_t)
+        k = jnp.pad(k, widths_t)
+        v = jnp.pad(v, widths_t)
+        key_mask = jnp.pad(key_mask, [(0, 0), (0, pad_t)])  # pads masked out
     out = _flash_core(q, k, v, key_mask, causal, s)
-    return out[..., :D] if pad else out
+    return out[:, :, :T, :D] if (pad_d or pad_t) else out
 
 
 def flash_attention_supported(q, block: int = 128) -> bool:
-    """Shape gate: T must tile into blocks; any head dim works (lane
-    padding), but tiny ones waste >4x MXU lanes — fall back to dense."""
+    """Shape gate: any T >= one block works (shorter-than-block pads
+    would waste most of the MXU and dense attention is cheap there) —
+    ragged/bucketed lengths that aren't 128-multiples are zero-padded
+    inside flash_attention, like head-dim lane padding.  Any head dim
+    works too (lane padding), but tiny ones waste >4x MXU lanes — fall
+    back to dense."""
     B, H, T, D = q.shape
-    return T >= block and T % block == 0 and D >= 32
+    return T >= block and D >= 32
 
 
 # ===========================================================================
@@ -480,67 +541,401 @@ def _sxr_bwd(grad, g):
 softmax_xent_rows.defvjp(_sxr_fwd, _sxr_bwd)
 
 
+# ===========================================================================
+# Fused conv2d + bias + activation (stride-1) — the CudnnConvolutionHelper
+# analog.  Forward is one Pallas pass (patch matmuls accumulate in VMEM,
+# bias+activation applied before the single HBM write); backward
+# recomputes through the XLA reference conv via jax.vjp, so training
+# gradients are exactly the dense-path gradients.
+# ===========================================================================
+
+# Elementwise activations the kernel can fuse (cross-feature ones like
+# softmax stay on the dense path).  Names resolve via ops/activations.
+CONV_FUSED_ACTS = frozenset((
+    "identity", "linear", "relu", "relu6", "tanh", "sigmoid", "leakyrelu",
+    "elu", "gelu", "softplus", "softsign", "swish", "selu", "hardsigmoid",
+    "hardtanh"))
+
+_VMEM_BUDGET = 10 << 20  # bytes of live f32 buffers one program may hold
+
+
+def _act_fn(name: str):
+    from deeplearning4j_tpu.ops import activations as act_ops
+    return act_ops.get(name or "identity")
+
+
+def _conv_pads(H, W, KH, KW, pad, border_mode):
+    """Explicit ((top, bottom), (left, right)) pads for stride 1.  'same'
+    matches XLA's SAME split: total = K-1, low = (K-1)//2, high = rest
+    (the extra row/col goes HIGH, as lax.conv does)."""
+    if border_mode == "same":
+        return (((KH - 1) // 2, KH - 1 - (KH - 1) // 2),
+                ((KW - 1) // 2, KW - 1 - (KW - 1) // 2))
+    return ((pad[0], pad[0]), (pad[1], pad[1]))
+
+
+def _conv_bias_act_kernel(x_ref, w_ref, b_ref, out_ref, *, act_name: str):
+    """One batch element: x [Hp, Wp, Cin] NHWC, w [KH, KW, Cin, Cout]
+    HWIO, b [1, Cout] → out [OH, OW, Cout].  The KH·KW patch matmuls
+    accumulate into one f32 VMEM buffer; bias + activation run on the
+    accumulator before the single output write."""
+    KH, KW, Cin, Cout = w_ref.shape
+    OH, OW = out_ref.shape[0], out_ref.shape[1]
+    acc = jnp.zeros((OH * OW, Cout), jnp.float32)
+    for kh in range(KH):
+        for kw in range(KW):
+            patch = x_ref[pl.dslice(kh, OH), pl.dslice(kw, OW), :].astype(
+                jnp.float32)                              # [OH, OW, Cin]
+            acc = acc + jax.lax.dot_general(
+                patch.reshape(OH * OW, Cin),
+                w_ref[kh, kw].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    y = acc + b_ref[...].astype(jnp.float32)              # [OH*OW, Cout]
+    y = _act_fn(act_name)(y)
+    out_ref[...] = y.reshape(OH, OW, Cout).astype(out_ref.dtype)
+
+
+def _conv_forward(xp, w, b2, act_name: str):
+    """xp [N, Hp, Wp, Cin] (already padded), w [KH, KW, Cin, Cout],
+    b2 [1, Cout] → [N, OH, OW, Cout]."""
+    N, Hp, Wp, Cin = xp.shape
+    KH, KW, _, Cout = w.shape
+    OH, OW = Hp - KH + 1, Wp - KW + 1
+    return pl.pallas_call(
+        functools.partial(_conv_bias_act_kernel, act_name=act_name),
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((None, Hp, Wp, Cin), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((KH, KW, Cin, Cout), lambda n: (0, 0, 0, 0)),
+            pl.BlockSpec((1, Cout), lambda n: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, OH, OW, Cout), lambda n: (n, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, OH, OW, Cout), xp.dtype),
+        interpret=_interpret(),
+    )(xp, w, b2)
+
+
+def _conv_ref_nhwc(xp, w, b2, act_name: str):
+    """Dense XLA reference of the SAME math (stride-1 VALID conv on the
+    pre-padded input) — the backward pass differentiates this."""
+    y = lax.conv_general_dilated(
+        xp, w, window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    y = _act_fn(act_name)(y + b2.reshape(1, 1, 1, -1))
+    return y.astype(xp.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _conv_core(xp, w, b2, act_name: str):
+    return _conv_forward(xp, w, b2, act_name)
+
+
+def _conv_vjp_fwd(xp, w, b2, act_name):
+    return _conv_forward(xp, w, b2, act_name), (xp, w, b2)
+
+
+def _conv_vjp_bwd(act_name, res, g):
+    # Recompute-through-reference: one extra conv in the backward buys
+    # gradients that are EXACTLY the dense path's (the cuDNN helpers
+    # similarly run distinct bwd algorithms against the same math).
+    xp, w, b2 = res
+    _, vjp = jax.vjp(
+        lambda x_, w_, b_: _conv_ref_nhwc(x_, w_, b_, act_name), xp, w, b2)
+    return vjp(g)
+
+
+_conv_core.defvjp(_conv_vjp_fwd, _conv_vjp_bwd)
+
+
+def fused_conv2d_bias_act(x, w, b, stride=(1, 1), pad=(0, 0),
+                          dilation=(1, 1), border_mode: str = "truncate",
+                          activation: str = "identity"):
+    """Fused conv+bias+activation, NCHW in / OIHW weights (the
+    ops/convolution.conv2d surface plus the activation).  Only valid for
+    shapes conv_fused_supported() accepts — callers go through
+    ops/helpers.conv2d_bias_act, which falls back to the dense chain."""
+    N, Cin, H, W = x.shape
+    Cout, _, KH, KW = w.shape
+    (pt, pb), (pl_, pr) = _conv_pads(H, W, KH, KW, pad, border_mode)
+    xp = jnp.transpose(x, (0, 2, 3, 1))                   # NCHW → NHWC
+    xp = jnp.pad(xp, ((0, 0), (pt, pb), (pl_, pr), (0, 0)))
+    whwio = jnp.transpose(w, (2, 3, 1, 0))                # OIHW → HWIO
+    y = _conv_core(xp, whwio, b.reshape(1, -1), activation)
+    return jnp.transpose(y, (0, 3, 1, 2))                 # back to NCHW
+
+
+def conv_fused_supported(x_shape, w_shape, dtype, stride=(1, 1),
+                         dilation=(1, 1), activation: str = "identity",
+                         pad=(0, 0), border_mode: str = "truncate") -> bool:
+    """Support predicate for the conv tier: stride-1/dilation-1 convs
+    with an elementwise activation whose whole working set (one image +
+    the filter + accumulator + output) fits the per-program VMEM
+    budget.  Strided/dilated convs and f64 (CPU gradient checks) take
+    the dense path."""
+    if len(x_shape) != 4 or len(w_shape) != 4:
+        return False
+    if tuple(stride) != (1, 1) or tuple(dilation) != (1, 1):
+        return False
+    if (activation or "identity").lower() not in CONV_FUSED_ACTS:
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    N, Cin, H, W = x_shape
+    Cout, _, KH, KW = w_shape
+    (pt, pb), (pl_, pr) = _conv_pads(H, W, KH, KW, pad, border_mode)
+    Hp, Wp = H + pt + pb, W + pl_ + pr
+    OH, OW = Hp - KH + 1, Wp - KW + 1
+    if OH <= 0 or OW <= 0:
+        return False
+    live = (Hp * Wp * Cin + KH * KW * Cin * Cout
+            + 2 * OH * OW * Cout + Cout) * 4
+    return live <= _VMEM_BUDGET
+
+
+# ===========================================================================
+# Fused LSTM cell — one VMEM pass for the recurrent matmul + gate math
+# inside the lax.scan of ops/recurrent.lstm_scan (the cudnnRNN analog).
+# ===========================================================================
+
+def _lstm_step_kernel(zx_ref, h_ref, c_ref, rw_ref, p_ref, c_out_ref,
+                      h_out_ref):
+    """zx [N, 4H] (pre-projected input), h/c [N, H], rw [H, 4H],
+    p [3, H] (peephole pI/pF/pO rows) → (c_new, h_new) [N, H].  Gate
+    layout [i, f, o, c] matches GravesLSTMParamInitializer."""
+    zx = zx_ref[...].astype(jnp.float32)
+    h = h_ref[...].astype(jnp.float32)
+    c = c_ref[...].astype(jnp.float32)
+    z = zx + jax.lax.dot_general(
+        h, rw_ref[...].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [N, 4H]
+    H = c.shape[1]
+    pI = p_ref[0, :].astype(jnp.float32)[None, :]
+    pF = p_ref[1, :].astype(jnp.float32)[None, :]
+    pO = p_ref[2, :].astype(jnp.float32)[None, :]
+    i = jax.nn.sigmoid(z[:, :H] + c * pI)
+    f = jax.nn.sigmoid(z[:, H:2 * H] + c * pF)
+    g = jnp.tanh(z[:, 3 * H:])
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(z[:, 2 * H:3 * H] + c_new * pO)
+    h_new = o * jnp.tanh(c_new)
+    c_out_ref[...] = c_new.astype(c_out_ref.dtype)
+    h_out_ref[...] = h_new.astype(h_out_ref.dtype)
+
+
+def _lstm_forward(zx, h, c, rw, p3):
+    N, H = c.shape
+    return pl.pallas_call(
+        _lstm_step_kernel,
+        out_shape=[jax.ShapeDtypeStruct((N, H), c.dtype),
+                   jax.ShapeDtypeStruct((N, H), h.dtype)],
+        interpret=_interpret(),
+    )(zx, h, c, rw, p3)
+
+
+def _lstm_step_reference(zx, h, c, rw, p3):
+    """XLA reference of the same cell math (matches
+    ops/recurrent._lstm_cell_pre with sigmoid/tanh + peephole) — the
+    backward pass differentiates this."""
+    z = zx + h @ rw
+    zi, zf, zo, zc = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(zi + c * p3[0])
+    f = jax.nn.sigmoid(zf + c * p3[1])
+    g = jnp.tanh(zc)
+    c_new = f * c + i * g
+    o = jax.nn.sigmoid(zo + c_new * p3[2])
+    h_new = o * jnp.tanh(c_new)
+    return c_new, h_new
+
+
+@jax.custom_vjp
+def fused_lstm_step(zx, h, c, rw, p3):
+    """One fused peephole-LSTM step: (c_new, h_new).  zx is the
+    pre-projected input row (x_t·W + b hoisted outside the scan)."""
+    return _lstm_forward(zx, h, c, rw, p3)
+
+
+def _lstm_vjp_fwd(zx, h, c, rw, p3):
+    return _lstm_forward(zx, h, c, rw, p3), (zx, h, c, rw, p3)
+
+
+def _lstm_vjp_bwd(res, g):
+    _, vjp = jax.vjp(_lstm_step_reference, *res)
+    return vjp(g)
+
+
+fused_lstm_step.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)
+
+
+def lstm_fused_supported(n: int, h: int, dtype) -> bool:
+    """Support predicate for the lstm tier: f32/bf16, lane-friendly H,
+    whole step (z + recurrent weights + states) within the VMEM
+    budget.  The scan body is ONE program — no grid — so the batch must
+    fit too."""
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    if h < 8 or h % 8:
+        return False
+    live = (2 * n * 4 * h + h * 4 * h + 3 * h + 4 * n * h) * 4
+    return live <= _VMEM_BUDGET
+
+
+# ===========================================================================
+# In-kernel threshold dropout — mask generated from a counter hash inside
+# the kernel; the [shape]-sized mask tensor never exists in HBM, and the
+# backward pass regenerates it from the seed (same kernel applied to the
+# cotangent) instead of saving it.
+# ===========================================================================
+
+_DROPOUT_WIDTH = 128     # lane width of the flattened 2-D view
+_DROPOUT_ROWS = 1024     # row-block per program (512 KB f32)
+
+
+def _mix32(idx, s0, s1):
+    """xxhash-style avalanche over a uint32 element counter + two seed
+    words.  Plain integer jnp ops, so the SAME function runs inside the
+    Pallas kernel and on the XLA reference path — bit-identical masks."""
+    h = idx * jnp.uint32(2654435761)
+    h = h ^ s0
+    h = h * jnp.uint32(2246822519)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h ^ s1
+    h = h * jnp.uint32(3266489917)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _threshold_dropout_math(x, idx, s0, s1, rate: float):
+    """keep iff the top-24 hash bits fall under round(rate·2²⁴) — an
+    integer threshold test (P(keep) = rate to 2⁻²⁴), then inverted
+    scaling, matching ops/normalization.dropout semantics (rate is the
+    RETAIN probability)."""
+    bits = _mix32(idx, s0, s1)
+    thresh = jnp.uint32(int(round(rate * float(1 << 24))))  # dl4j: noqa[DL4J101] rate is a static Python float by contract (layer config), never traced
+    keep = (bits >> jnp.uint32(8)) < thresh
+    # multiply by the host-computed reciprocal (not x/rate): XLA folds a
+    # divide-by-constant differently inside vs outside the kernel, and
+    # the kernel-vs-reference parity contract is BIT-identical
+    inv = jnp.float32(1.0 / float(rate))  # dl4j: noqa[DL4J101] rate is a static Python float by contract, never traced
+    return jnp.where(keep, x.astype(jnp.float32) * inv,
+                     jnp.float32(0.0)).astype(x.dtype)
+
+
+def _dropout_kernel(x_ref, seed_ref, out_ref, *, rate: float):
+    R, W = x_ref.shape
+    r0 = pl.program_id(0) * R
+    rows = (r0 + lax.broadcasted_iota(jnp.int32, (R, W), 0)).astype(
+        jnp.uint32)
+    cols = lax.broadcasted_iota(jnp.int32, (R, W), 1).astype(jnp.uint32)
+    idx = rows * jnp.uint32(W) + cols                     # global element id
+    out_ref[...] = _threshold_dropout_math(
+        x_ref[...], idx, seed_ref[0, 0], seed_ref[0, 1], rate)
+
+
+def _dropout_forward(x2d, seed, rate: float):
+    R = x2d.shape[0]
+    br = min(_DROPOUT_ROWS, R)
+    return pl.pallas_call(
+        functools.partial(_dropout_kernel, rate=rate),
+        grid=(R // br,),
+        in_specs=[
+            pl.BlockSpec((br, _DROPOUT_WIDTH), lambda i: (i, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, _DROPOUT_WIDTH), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, seed)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _dropout_core(x2d, seed, rate: float):
+    return _dropout_forward(x2d, seed, rate)
+
+
+def _dropout_vjp_fwd(x2d, seed, rate):
+    # residual is the SEED alone — the mask is recomputed, never stored
+    return _dropout_forward(x2d, seed, rate), seed
+
+
+def _dropout_vjp_bwd(rate, seed, g):
+    # d/dx of (mask ∘ x / rate) is the same masked scaling applied to g
+    return _dropout_forward(g, seed, rate), None
+
+
+_dropout_core.defvjp(_dropout_vjp_fwd, _dropout_vjp_bwd)
+
+
+def _dropout_seed(rng):
+    """Two uint32 seed words from a PRNG key (old-style uint32[2] raw
+    keys and new typed keys both)."""
+    kd = rng
+    try:
+        if jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            kd = jax.random.key_data(rng)
+    except (AttributeError, TypeError):
+        pass
+    kd = jnp.asarray(kd, jnp.uint32).reshape(-1)
+    return jnp.stack([kd[0], kd[-1]]).reshape(1, 2)
+
+
+def fused_threshold_dropout(x, rate: float, rng):
+    """Inverted dropout with the mask THRESHOLD test fused into the
+    kernel.  rate is the RETAIN probability (ops/normalization.dropout
+    parity).  NOTE: draws from a different (hash-counter) stream than
+    jax.random.bernoulli — same distribution, different masks — so the
+    dense fallback is distribution-equivalent, not mask-identical;
+    threshold_dropout_reference() is the bit-exact XLA reference."""
+    if rate >= 1.0 or rate <= 0.0:
+        return x
+    n = x.size
+    rows = -(-n // _DROPOUT_WIDTH)
+    br = min(_DROPOUT_ROWS, max(8, rows))
+    rows_p = -(-rows // br) * br
+    flat = x.reshape(-1)
+    pad = rows_p * _DROPOUT_WIDTH - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    out = _dropout_core(flat.reshape(rows_p, _DROPOUT_WIDTH),
+                        _dropout_seed(rng), float(rate))  # dl4j: noqa[DL4J101] rate is a static Python float (nondiff custom_vjp arg), never traced
+    return out.reshape(-1)[:n].reshape(x.shape)
+
+
+def threshold_dropout_reference(x, rate: float, rng):
+    """Same math on the dense XLA path (global element counter = the
+    kernel's row·width+col) — bit-identical to the kernel output; the
+    parity tests pin this."""
+    if rate >= 1.0 or rate <= 0.0:
+        return x
+    seed = _dropout_seed(rng)
+    idx = jnp.arange(x.size, dtype=jnp.uint32).reshape(x.shape)
+    return _threshold_dropout_math(x, idx, seed[0, 0], seed[0, 1],
+                                   float(rate))  # dl4j: noqa[DL4J101] rate is a static Python float by contract, never traced
+
+
+def dropout_fused_supported(shape, dtype) -> bool:
+    """Support predicate for the dropout tier: float tensors big enough
+    that skipping the HBM mask round-trip beats the kernel launch."""
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n >= (1 << 12)
+
+
 def kernel_self_test(disable_on_error: bool = True) -> dict:
-    """Compile+run each kernel once on small shapes through the REAL
-    dispatch path (interpret only off-TPU) and report per-kernel status.
-
-    Run this before anything perf-critical: the first Mosaic compile of
-    a kernel otherwise happens cold inside whatever model hits it first,
-    and a compile rejection there kills that whole run.  On error the
-    offending tier is disabled via :func:`disable_kernels`, so callers
-    (ops/losses.mcxent, parallel/sequence.dense_attention) silently fall
-    back to the dense XLA path.  Ref analog: ConvolutionLayer's
-    cuDNN-helper-try/builtin-fallback, ConvolutionLayer.java:67,157-212.
-    """
-    import numpy as _np
-    results = {}
-    # snapshot BEFORE any _try can flip a kill switch: the mode the tests
-    # actually ran under, not the post-disable state
-    interp = _interpret()
-
-    def _try(name, tier, fn):
-        try:
-            fn()
-            results[name] = "ok"
-        except Exception as e:  # Mosaic/XLA compile or runtime failure
-            results[name] = f"error: {type(e).__name__}: {e}"[:300]
-            if disable_on_error:
-                disable_kernels(f"{name} self-test failed: {e}", tier=tier)
-
-    rng = _np.random.default_rng(0)
-
-    def _flash():
-        B, H, T, D = 1, 2, 256, 64
-        q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
-        k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
-        v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.float32)
-        km = jnp.ones((B, T), jnp.float32)
-
-        def loss(q, k, v):
-            return flash_attention(q, k, v, km, causal=True).sum()
-        vg = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-        out, grads = vg(q, k, v)
-        jax.block_until_ready(grads)
-        if not bool(jnp.isfinite(out)):
-            raise FloatingPointError("non-finite flash attention loss")
-
-    def _xent():
-        N, V = 256, 512
-        logits = jnp.asarray(rng.normal(size=(N, V)), jnp.float32)
-        labels = jnp.asarray(_np.eye(V, dtype=_np.float32)[
-            rng.integers(0, V, N)])
-
-        def loss(lg):
-            return softmax_xent_rows(lg, labels).mean()
-        vg = jax.jit(jax.value_and_grad(loss))
-        out, g = vg(logits)
-        jax.block_until_ready(g)
-        if not bool(jnp.isfinite(out)):
-            raise FloatingPointError("non-finite fused xent loss")
-
-    _try("flash_attention", "flash", _flash)
-    _try("softmax_xent", "xent", _xent)
-    results["interpret_mode"] = interp
-    if _disabled:
-        results["disabled"] = {t: r[:300] for t, r in _disabled.items()}
-    return results
+    """Compile+run each registered kernel once on small shapes through
+    the REAL dispatch path (interpret only off-TPU) and report
+    per-kernel status — delegates to the helper-selection tier
+    (ops/helpers.kernel_self_test), which covers EVERY registered
+    helper, disables a failing tier via :func:`disable_kernels` and
+    mirrors verdicts into ``dl4j_pallas_selftest_ok``.  Ref analog:
+    ConvolutionLayer's cuDNN-helper-try/builtin-fallback,
+    ConvolutionLayer.java:67,157-212."""
+    from deeplearning4j_tpu.ops import helpers
+    return helpers.kernel_self_test(disable_on_error=disable_on_error)

@@ -87,15 +87,33 @@ def lstm_scan(params: dict, x: jnp.ndarray, init: Optional[LSTMState] = None,
     zxs = jnp.swapaxes(zx, 0, 1)  # [T, N, 4H]
     ms = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
 
+    # Helper selection (ops/helpers.py, trace time): the standard
+    # sigmoid/tanh peephole cell can run as ONE Pallas VMEM pass per
+    # step (recurrent matmul + all gate math fused,
+    # pallas_kernels.fused_lstm_step) instead of separate HLO ops.
+    from deeplearning4j_tpu.ops import helpers
+    use_fused = helpers.lstm_step_wanted(params, x, gate_act, cell_act,
+                                         peephole)
+    if use_fused:
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+        p3 = jnp.stack([params["pI"], params["pF"], params["pO"]])
+
+        def cell(zx_t, carry):
+            c_new, h_new = pk.fused_lstm_step(zx_t, carry.h, carry.c,
+                                              params["RW"], p3)
+            return LSTMState(c_new, h_new), h_new
+    else:
+        def cell(zx_t, carry):
+            return _lstm_cell_pre(params, zx_t, carry, gate_act, cell_act,
+                                  peephole)
+
     def step(carry: LSTMState, inp):
         if ms is None:
             zx_t = inp
-            new, h = _lstm_cell_pre(params, zx_t, carry, gate_act, cell_act,
-                                    peephole)
+            new, h = cell(zx_t, carry)
             return new, h
         zx_t, m_t = inp
-        new, h = _lstm_cell_pre(params, zx_t, carry, gate_act, cell_act,
-                                peephole)
+        new, h = cell(zx_t, carry)
         c = jnp.where(m_t > 0, new.c, carry.c)
         hh = jnp.where(m_t > 0, new.h, carry.h)
         return LSTMState(c, hh), hh * (m_t > 0)

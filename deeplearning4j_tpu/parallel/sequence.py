@@ -92,8 +92,11 @@ def dense_attention(q, k, v, *, causal: bool = False, key_mask=None,
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     if allow_flash and q.shape[2] == k.shape[2]:
+        # helper selection (ops/helpers.py): the attention tier routes
+        # tile-friendly shapes to the flash kernel and meters the choice
+        from deeplearning4j_tpu.ops import helpers
         from deeplearning4j_tpu.ops import pallas_kernels as pk
-        if pk.flash_available() and pk.flash_attention_supported(q):
+        if helpers.attention_wanted(q):
             km = (key_mask if key_mask is not None
                   else jnp.ones((q.shape[0], k.shape[2]), q.dtype))
             return pk.flash_attention(q, k, v, km.astype(q.dtype), causal,

@@ -1,0 +1,333 @@
+"""Helper-selection tier (ops/helpers.py): availability/kill-switch
+semantics, trace-time selection metering, warm validation, and the
+fallback-equivalence contract through the public fit()/output() path —
+the cuDNN-helper-with-builtin-fallback pattern the reference runs
+(ConvolutionLayer.java:157-212), TPU-native."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import helpers
+from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+
+@pytest.fixture(autouse=True)
+def _clean_tiers():
+    pk._disabled.clear()
+    helpers.reset_validation()
+    yield
+    pk._disabled.clear()
+    helpers.reset_validation()
+
+
+def _counter_value(name, op):
+    from deeplearning4j_tpu import monitor
+    fam = monitor.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    for s in fam.samples():
+        if s["labels"].get("op") == op:
+            return s["value"]
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# Availability / kill-switch matrix
+# ---------------------------------------------------------------------------
+
+class TestAvailability:
+    def test_off_tpu_default_is_fallback(self):
+        for op in helpers.OPS:
+            assert not helpers.available(op)
+
+    def test_global_kill_beats_force(self, monkeypatch):
+        monkeypatch.setenv("DL4J_PALLAS", "0")
+        monkeypatch.setenv("DL4J_PALLAS_CONV", "1")
+        assert not helpers.available("conv2d")
+
+    def test_per_tier_force_on_and_off(self, monkeypatch):
+        monkeypatch.setenv("DL4J_PALLAS_CONV", "1")
+        assert helpers.available("conv2d")
+        assert not helpers.available("lstm_step")  # other tiers untouched
+        monkeypatch.setenv("DL4J_PALLAS_CONV", "0")
+        assert not helpers.available("conv2d")
+
+    def test_runtime_kill_switch_beats_force(self, monkeypatch):
+        monkeypatch.setenv("DL4J_PALLAS_LSTM", "1")
+        assert helpers.available("lstm_step")
+        pk.disable_kernels("mosaic said no", tier="lstm")
+        assert not helpers.available("lstm_step")
+
+    def test_fake_tpu_enables_all(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU", "1")
+        for op in helpers.OPS:
+            assert helpers.available(op)
+
+    def test_disable_all_tiers(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU", "1")
+        pk.disable_kernels("everything broke")
+        for op in helpers.OPS:
+            assert not helpers.available(op)
+        assert set(pk._disabled) == set(pk.ALL_TIERS)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time selection + metering
+# ---------------------------------------------------------------------------
+
+class TestSelection:
+    def test_conv_selection_counts(self, monkeypatch):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8, 8)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(4, 3, 3, 3)) * 0.2, jnp.float32)
+        b = jnp.zeros((4,), jnp.float32)
+
+        before_f = _counter_value("dl4j_pallas_fallback_total", "conv2d")
+        dense = helpers.conv2d_bias_act(x, w, b, activation="relu")
+        assert _counter_value("dl4j_pallas_fallback_total",
+                              "conv2d") == before_f + 1
+
+        monkeypatch.setenv("DL4J_PALLAS_CONV", "1")
+        before_s = _counter_value("dl4j_pallas_selected_total", "conv2d")
+        fused = helpers.conv2d_bias_act(x, w, b, activation="relu")
+        assert _counter_value("dl4j_pallas_selected_total",
+                              "conv2d") == before_s + 1
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_conv_unsupported_shape_falls_back_even_forced(self, monkeypatch):
+        monkeypatch.setenv("DL4J_PALLAS_CONV", "1")
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8, 8)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(4, 3, 3, 3)) * 0.2, jnp.float32)
+        b = jnp.zeros((4,), jnp.float32)
+        before = _counter_value("dl4j_pallas_fallback_total", "conv2d")
+        y = helpers.conv2d_bias_act(x, w, b, stride=(2, 2),
+                                    activation="relu")   # strided: dense
+        assert y.shape == (2, 4, 3, 3)
+        assert _counter_value("dl4j_pallas_fallback_total",
+                              "conv2d") == before + 1
+
+    def test_dropout_selection(self, monkeypatch):
+        x = jnp.ones((64, 128), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        out_dense = helpers.dropout(x, 0.5, key)
+        monkeypatch.setenv("DL4J_PALLAS_DROPOUT", "1")
+        out_fused = helpers.dropout(x, 0.5, key)
+        # different streams (bernoulli vs counter hash), same contract
+        for out in (out_dense, out_fused):
+            kept = float(jnp.mean(out != 0))
+            assert abs(kept - 0.5) < 0.1
+            assert bool(jnp.all((out == 0) | (out == 2.0)))
+        np.testing.assert_array_equal(
+            np.asarray(out_fused),
+            np.asarray(pk.fused_threshold_dropout(x, 0.5, key)))
+
+    def test_lstm_wanted_gate(self, monkeypatch):
+        from deeplearning4j_tpu.ops import activations as act_ops
+        params = {"RW": jnp.zeros((16, 64)), "pI": jnp.zeros(16),
+                  "pF": jnp.zeros(16), "pO": jnp.zeros(16)}
+        x = jnp.zeros((4, 8, 8), jnp.float32)
+        assert not helpers.lstm_step_wanted(params, x, jax.nn.sigmoid,
+                                            jnp.tanh)   # off-TPU
+        monkeypatch.setenv("DL4J_PALLAS_LSTM", "1")
+        assert helpers.lstm_step_wanted(params, x, jax.nn.sigmoid, jnp.tanh)
+        assert helpers.lstm_step_wanted(params, x, act_ops.get("sigmoid"),
+                                        act_ops.get("tanh"))
+        # exotic gate activation keeps the composable XLA cell
+        assert not helpers.lstm_step_wanted(params, x, act_ops.get("relu"),
+                                            jnp.tanh)
+        assert not helpers.lstm_step_wanted(params, x, jax.nn.sigmoid,
+                                            jnp.tanh, peephole=False)
+
+    def test_xent_wanted_thresholds(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU", "1")
+        assert helpers.softmax_xent_wanted(512, 512)
+        assert not helpers.softmax_xent_wanted(4, 64)      # narrow vocab
+        monkeypatch.setenv("DL4J_FUSED_XENT", "0")
+        assert not helpers.softmax_xent_wanted(512, 512)   # forced off
+        monkeypatch.delenv("DL4J_TPU")
+        monkeypatch.setenv("DL4J_FUSED_XENT", "1")
+        assert helpers.softmax_xent_wanted(4, 64)          # forced on
+
+    def test_attention_wanted(self, monkeypatch):
+        q = jnp.zeros((2, 2, 256, 64), jnp.float32)
+        assert not helpers.attention_wanted(q)
+        monkeypatch.setenv("DL4J_PALLAS_FLASH", "1")
+        assert helpers.attention_wanted(q)
+        assert not helpers.attention_wanted(
+            jnp.zeros((2, 2, 64, 64), jnp.float32))  # short T: dense
+
+
+# ---------------------------------------------------------------------------
+# Warm validation / self-test
+# ---------------------------------------------------------------------------
+
+class TestWarmValidation:
+    def test_self_test_covers_every_registered_helper(self):
+        st = helpers.kernel_self_test()
+        for h in (helpers.helper_for(op) for op in helpers.OPS):
+            assert st[h.test_name] == "ok"
+        assert st["interpret_mode"] is True
+        assert "disabled" not in st
+
+    def test_selftest_metrics_exposed(self):
+        from deeplearning4j_tpu import monitor
+        helpers.kernel_self_test()
+        snap = monitor.get_registry().snapshot()
+        ok = {s["labels"]["op"]: s["value"]
+              for s in snap["dl4j_pallas_selftest_ok"]["samples"]}
+        assert set(helpers.OPS) <= set(ok)
+        assert all(v == 1.0 for v in ok.values())
+        tiers = {s["labels"]["tier"]: s["value"]
+                 for s in snap["dl4j_pallas_tier_disabled"]["samples"]}
+        assert set(pk.ALL_TIERS) <= set(tiers)
+
+    def test_failing_helper_disables_only_its_tier(self, monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("mosaic rejected")
+        monkeypatch.setattr(pk, "fused_conv2d_bias_act", boom)
+        st = helpers.kernel_self_test()
+        assert st["conv2d_bias_act"].startswith("error")
+        assert st["lstm_step"] == "ok"
+        assert st["dropout"] == "ok"
+        assert "conv" in pk._disabled
+        assert "lstm" not in pk._disabled and "flash" not in pk._disabled
+
+    def test_ensure_validated_cheap_off_tpu(self):
+        res = helpers.ensure_validated()
+        assert "skipped" in res
+        assert helpers.ensure_validated() is res   # cached
+
+    def test_ensure_validated_runs_eligible_tiers(self, monkeypatch):
+        monkeypatch.setenv("DL4J_PALLAS_DROPOUT", "1")
+        res = helpers.ensure_validated()
+        assert res["dropout"] == "ok"
+        assert "conv2d_bias_act" not in res        # only eligible tiers run
+
+
+# ---------------------------------------------------------------------------
+# Fallback equivalence through the public fit()/output() path
+# ---------------------------------------------------------------------------
+
+def _fit_conv_net(monkeypatch, env, steps=3):
+    """Train a tiny conv net; returns (flat params, output) — fresh model
+    per call, same seed/data."""
+    helpers.reset_validation()
+    for k, v in env.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.params import flatten
+    conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+            .updater("sgd").list()
+            .layer(L.ConvolutionLayer(n_out=4, kernel=(3, 3),
+                                      activation="relu",
+                                      convolution_mode="same"))
+            .layer(L.SubsamplingLayer())
+            .layer(L.DenseLayer(n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_out=10, activation="softmax",
+                                 loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 1, 8, 8)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+    for _ in range(steps):
+        net.fit(x, y)
+    out = np.asarray(net.output(x))
+    return np.asarray(flatten(net.net_params)), out
+
+
+def _fit_lstm_net(monkeypatch, env, steps=3):
+    helpers.reset_validation()
+    for k, v in env.items():
+        if v is None:
+            monkeypatch.delenv(k, raising=False)
+        else:
+            monkeypatch.setenv(k, v)
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.params import flatten
+    conf = (NeuralNetConfiguration.builder().seed(11).learning_rate(0.05)
+            .updater("sgd").list()
+            .layer(L.GravesLSTM(n_in=6, n_out=16))
+            .layer(L.RnnOutputLayer(n_in=16, n_out=5, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 7, 6)).astype(np.float32)
+    y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, (8, 7))]
+    for _ in range(steps):
+        net.fit(x, y)
+    out = np.asarray(net.output(x))
+    return np.asarray(flatten(net.net_params)), out
+
+
+class TestFallbackEquivalence:
+    """Disabling any tier must reproduce byte-identical fit()/output()
+    results through the dense fallback (the helper refactor cannot
+    perturb the builtin path), and the forced-fused leg must agree to
+    kernel-parity tolerance."""
+
+    def test_conv_net_tier_disable_is_byte_identical(self, monkeypatch):
+        p_base, o_base = _fit_conv_net(monkeypatch, {})
+        p_off, o_off = _fit_conv_net(monkeypatch, {"DL4J_PALLAS": "0"})
+        p_tier, o_tier = _fit_conv_net(monkeypatch,
+                                       {"DL4J_PALLAS_CONV": "0"})
+        assert np.array_equal(p_base, p_off)
+        assert np.array_equal(o_base, o_off)
+        assert np.array_equal(p_base, p_tier)
+        assert np.array_equal(o_base, o_tier)
+
+    def test_conv_net_fused_matches_dense(self, monkeypatch):
+        p_base, o_base = _fit_conv_net(monkeypatch, {})
+        p_fused, o_fused = _fit_conv_net(monkeypatch,
+                                         {"DL4J_PALLAS_CONV": "1"})
+        np.testing.assert_allclose(p_fused, p_base, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(o_fused, o_base, rtol=1e-5, atol=1e-5)
+
+    def test_lstm_net_tier_disable_is_byte_identical(self, monkeypatch):
+        p_base, o_base = _fit_lstm_net(monkeypatch, {})
+        p_off, o_off = _fit_lstm_net(monkeypatch, {"DL4J_PALLAS": "0"})
+        p_tier, o_tier = _fit_lstm_net(monkeypatch,
+                                       {"DL4J_PALLAS_LSTM": "0"})
+        assert np.array_equal(p_base, p_off)
+        assert np.array_equal(o_base, o_off)
+        assert np.array_equal(p_base, p_tier)
+        assert np.array_equal(o_base, o_tier)
+
+    def test_lstm_net_fused_matches_dense(self, monkeypatch):
+        p_base, o_base = _fit_lstm_net(monkeypatch, {})
+        p_fused, o_fused = _fit_lstm_net(monkeypatch,
+                                         {"DL4J_PALLAS_LSTM": "1"})
+        np.testing.assert_allclose(p_fused, p_base, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(o_fused, o_base, rtol=1e-4, atol=1e-5)
+
+    def test_xent_tier_disable_is_byte_identical(self, monkeypatch):
+        """The migrated xent tier keeps its fallback-equivalence too:
+        forcing the tier off through the helper layer reproduces the
+        dense logsumexp scores bit-for-bit."""
+        from deeplearning4j_tpu.ops import losses
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(64, 512)), jnp.float32)
+        y = jnp.asarray(np.eye(512, dtype=np.float32)[
+            rng.integers(0, 512, 64)])
+        monkeypatch.setenv("DL4J_PALLAS", "0")
+        a = np.asarray(losses.mcxent(y, logits, "softmax"))
+        monkeypatch.delenv("DL4J_PALLAS")
+        monkeypatch.setenv("DL4J_PALLAS_XENT", "0")
+        b = np.asarray(losses.mcxent(y, logits, "softmax"))
+        assert np.array_equal(a, b)

@@ -80,6 +80,39 @@ def test_flash_supported_gate():
     assert pk.flash_attention_supported(q_64)
     q_tiny_d = jnp.zeros((2, 2, 256, 16))
     assert not pk.flash_attention_supported(q_tiny_d)
+    # ragged/bucketed T that isn't a 128-multiple is zero-padded inside
+    # flash_attention (masked), so the gate accepts it now
+    assert pk.flash_attention_supported(jnp.zeros((2, 2, 200, 64)))
+    assert pk.flash_attention_supported(jnp.zeros((2, 2, 130, 128)))
+
+
+@pytest.mark.parametrize("T,causal,pad_from", [
+    (200, False, None), (200, True, 180), (130, True, None),
+    (384 + 64, False, 300)])
+def test_flash_ragged_T_padding_matches_dense(T, causal, pad_from):
+    """Sequence lengths that don't tile into 128-row blocks pad (masked)
+    inside flash_attention — bucketed ladders that aren't 128-multiples
+    keep the flash path, forward AND gradient."""
+    D = 64
+    q, k, v = _qkv(B=2, H=2, T=T, D=D, seed=7)
+    km = _mask(B=2, T=T, pad_from=pad_from)
+    out = pk.flash_attention(q, k, v, km, causal)
+    ref = pk._dense_reference(q, k, v, km, causal, 1.0 / (D ** 0.5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, km, causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            pk._dense_reference(q, k, v, km, causal, 1.0 / (D ** 0.5)) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
 
 
 @pytest.mark.parametrize("D", [64, 96])
@@ -305,3 +338,270 @@ class TestKernelSelfTest:
         assert st["flash_attention"].startswith("error")
         assert st["softmax_xent"] == "ok"
         assert "flash" in st["disabled"] and "xent" not in st["disabled"]
+
+
+# ===========================================================================
+# Fused conv2d + bias + activation (the CudnnConvolutionHelper analog)
+# ===========================================================================
+
+def _conv_ref(x, w, b, pad, mode, act):
+    from deeplearning4j_tpu.ops import activations as act_ops
+    from deeplearning4j_tpu.ops import convolution as conv_ops
+    return act_ops.get(act)(
+        conv_ops.conv2d(x, w, b, (1, 1), pad, (1, 1), mode))
+
+
+class TestFusedConv:
+    """Numerics-parity grid: fused vs the dense XLA chain, forward AND
+    gradient (jax.grad) at <= 1e-5, over shape/pad-mode/activation."""
+
+    @pytest.mark.parametrize("shape,kernel,pad,mode", [
+        ((2, 3, 10, 10), (3, 3), (0, 0), "truncate"),
+        ((2, 3, 10, 10), (3, 3), (1, 1), "truncate"),
+        ((1, 1, 28, 28), (5, 5), (0, 0), "truncate"),
+        ((2, 4, 9, 7), (3, 3), (0, 0), "same"),
+        ((2, 2, 8, 8), (2, 2), (0, 0), "same"),  # even kernel: SAME pads high
+    ])
+    @pytest.mark.parametrize("act", ["identity", "relu", "tanh"])
+    def test_forward_and_grad_parity(self, shape, kernel, pad, mode, act):
+        rng = np.random.default_rng(11)
+        N, Cin, H, W = shape
+        Cout = 6
+        x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(Cout, Cin) + kernel) * 0.2,
+                        jnp.float32)
+        b = jnp.asarray(rng.normal(size=(Cout,)), jnp.float32)
+        assert pk.conv_fused_supported(x.shape, w.shape, x.dtype,
+                                       activation=act, pad=pad,
+                                       border_mode=mode)
+        fused = pk.fused_conv2d_bias_act(x, w, b, pad=pad, border_mode=mode,
+                                         activation=act)
+        ref = _conv_ref(x, w, b, pad, mode, act)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+        def lf(x, w, b):
+            return jnp.sum(pk.fused_conv2d_bias_act(
+                x, w, b, pad=pad, border_mode=mode, activation=act) ** 2)
+
+        def lr(x, w, b):
+            return jnp.sum(_conv_ref(x, w, b, pad, mode, act) ** 2)
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(x, w, b)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_bf16_smoke(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 3, 8, 8)), jnp.bfloat16)
+        w = jnp.asarray(rng.normal(size=(4, 3, 3, 3)) * 0.2, jnp.bfloat16)
+        b = jnp.asarray(rng.normal(size=(4,)), jnp.bfloat16)
+        fused = pk.fused_conv2d_bias_act(x, w, b, border_mode="same",
+                                         activation="relu")
+        ref = _conv_ref(x, w, b, (0, 0), "same", "relu")
+        assert fused.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(fused, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_supported_predicate_edges(self):
+        f32 = jnp.float32
+        ok = pk.conv_fused_supported((2, 3, 10, 10), (6, 3, 3, 3), f32)
+        assert ok
+        # strided / dilated convs keep the dense path
+        assert not pk.conv_fused_supported((2, 3, 10, 10), (6, 3, 3, 3),
+                                           f32, stride=(2, 2))
+        assert not pk.conv_fused_supported((2, 3, 10, 10), (6, 3, 3, 3),
+                                           f32, dilation=(2, 2))
+        # cross-feature activation: not fusable elementwise
+        assert not pk.conv_fused_supported((2, 3, 10, 10), (6, 3, 3, 3),
+                                           f32, activation="softmax")
+        # f64 (CPU gradient checks) keeps the dense path
+        assert not pk.conv_fused_supported((2, 3, 10, 10), (6, 3, 3, 3),
+                                           jnp.float64)
+        # VMEM budget: a 512-channel 128x128 image blows the window
+        assert not pk.conv_fused_supported((1, 512, 128, 128),
+                                           (512, 512, 3, 3), f32)
+        # degenerate output extent
+        assert not pk.conv_fused_supported((1, 1, 2, 2), (1, 1, 5, 5), f32)
+
+
+# ===========================================================================
+# Fused LSTM cell (the cudnnRNN analog inside lstm_scan)
+# ===========================================================================
+
+def _lstm_fixture(N=4, H=16, nin=8, seed=5, dtype=jnp.float32):
+    from deeplearning4j_tpu.ops import recurrent as rnn_ops
+    rng = np.random.default_rng(seed)
+    params = {
+        "W": jnp.asarray(rng.normal(size=(nin, 4 * H)) * 0.3, dtype),
+        "RW": jnp.asarray(rng.normal(size=(H, 4 * H)) * 0.3, dtype),
+        "b": jnp.asarray(rng.normal(size=(4 * H,)) * 0.1, dtype),
+        "pI": jnp.asarray(rng.normal(size=(H,)) * 0.1, dtype),
+        "pF": jnp.asarray(rng.normal(size=(H,)) * 0.1, dtype),
+        "pO": jnp.asarray(rng.normal(size=(H,)) * 0.1, dtype),
+    }
+    state = rnn_ops.LSTMState(
+        jnp.asarray(rng.normal(size=(N, H)), dtype),
+        jnp.asarray(rng.normal(size=(N, H)), dtype))
+    return rng, params, state
+
+
+class TestFusedLSTMStep:
+    def test_step_forward_and_grad_parity(self):
+        from deeplearning4j_tpu.ops import recurrent as rnn_ops
+        rng, params, st = _lstm_fixture()
+        N, H = st.c.shape
+        zx = jnp.asarray(rng.normal(size=(N, 4 * H)), jnp.float32)
+        p3 = jnp.stack([params["pI"], params["pF"], params["pO"]])
+        c_f, h_f = pk.fused_lstm_step(zx, st.h, st.c, params["RW"], p3)
+        ref_state, ref_h = rnn_ops._lstm_cell_pre(params, zx, st)
+        np.testing.assert_allclose(np.asarray(c_f), np.asarray(ref_state.c),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_f), np.asarray(ref_h),
+                                   rtol=1e-5, atol=1e-5)
+
+        def lf(zx, h, c, rw, p3):
+            cn, hn = pk.fused_lstm_step(zx, h, c, rw, p3)
+            return jnp.sum(cn ** 2) + jnp.sum(hn ** 2)
+
+        def lr(zx, h, c, rw, p3):
+            pr = dict(params, RW=rw, pI=p3[0], pF=p3[1], pO=p3[2])
+            s2, h2 = rnn_ops._lstm_cell_pre(
+                pr, zx, rnn_ops.LSTMState(c, h))
+            return jnp.sum(s2.c ** 2) + jnp.sum(h2 ** 2)
+
+        gf = jax.grad(lf, argnums=(0, 1, 2, 3, 4))(
+            zx, st.h, st.c, params["RW"], p3)
+        gr = jax.grad(lr, argnums=(0, 1, 2, 3, 4))(
+            zx, st.h, st.c, params["RW"], p3)
+        for a, r in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("masked", [False, True])
+    def test_scan_fused_vs_dense_parity(self, masked, monkeypatch):
+        """lstm_scan with the lstm tier forced fused vs forced dense:
+        full-sequence outputs, final state AND parameter gradients agree
+        at <= 1e-5 (mask variants included)."""
+        from deeplearning4j_tpu.ops import recurrent as rnn_ops
+        N, T, nin, H = 3, 7, 8, 16
+        rng, params, _ = _lstm_fixture(N=N, H=H, nin=nin, seed=9)
+        x = jnp.asarray(rng.normal(size=(N, T, nin)), jnp.float32)
+        mask = None
+        if masked:
+            m = np.ones((N, T), np.float32)
+            m[0, 4:] = 0.0
+            m[2, 2:] = 0.0
+            mask = jnp.asarray(m)
+
+        def run(forced):
+            monkeypatch.setenv("DL4J_PALLAS_LSTM", forced)
+            hs, fin = rnn_ops.lstm_scan(params, x, None, mask)
+            return hs, fin
+
+        def grads(forced):
+            monkeypatch.setenv("DL4J_PALLAS_LSTM", forced)
+
+            def loss(p):
+                hs, _ = rnn_ops.lstm_scan(p, x, None, mask)
+                return jnp.sum(hs ** 2)
+            return jax.grad(loss)(params)
+
+        hs_f, fin_f = run("1")
+        hs_d, fin_d = run("0")
+        np.testing.assert_allclose(np.asarray(hs_f), np.asarray(hs_d),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fin_f.c), np.asarray(fin_d.c),
+                                   rtol=1e-5, atol=1e-5)
+        gf, gd = grads("1"), grads("0")
+        for k in gf:
+            np.testing.assert_allclose(np.asarray(gf[k]), np.asarray(gd[k]),
+                                       rtol=1e-5, atol=1e-5, err_msg=k)
+
+    def test_supported_predicate_edges(self):
+        assert pk.lstm_fused_supported(8, 64, jnp.float32)
+        assert not pk.lstm_fused_supported(8, 63, jnp.float32)   # ragged H
+        assert not pk.lstm_fused_supported(8, 4, jnp.float32)    # tiny H
+        assert not pk.lstm_fused_supported(8, 64, jnp.float64)   # gradcheck
+        assert not pk.lstm_fused_supported(100000, 1024, jnp.float32)  # VMEM
+
+
+# ===========================================================================
+# In-kernel threshold dropout
+# ===========================================================================
+
+class TestThresholdDropout:
+    def test_bit_exact_vs_xla_reference(self):
+        """The kernel and the dense XLA reference share the counter-hash
+        math — outputs are BIT-identical, over shapes that exercise the
+        row padding."""
+        rng = np.random.default_rng(3)
+        key = jax.random.PRNGKey(17)
+        for shape, rate in (((64, 130), 0.8), ((7, 33, 21), 0.5),
+                            ((5000,), 0.3), ((2, 3, 8, 9), 0.9)):
+            x = jnp.asarray(rng.normal(size=shape), jnp.float32)
+            fused = pk.fused_threshold_dropout(x, rate, key)
+            ref = pk.threshold_dropout_reference(x, rate, key)
+            assert fused.shape == x.shape
+            assert bool(jnp.all(fused == ref)), (shape, rate)
+
+    def test_grad_parity(self):
+        rng = np.random.default_rng(4)
+        key = jax.random.PRNGKey(5)
+        x = jnp.asarray(rng.normal(size=(64, 128)), jnp.float32)
+
+        def lf(x):
+            return jnp.sum(pk.fused_threshold_dropout(x, 0.7, key) ** 2)
+
+        def lr(x):
+            return jnp.sum(pk.threshold_dropout_reference(x, 0.7, key) ** 2)
+
+        gf = jax.grad(lf)(x)
+        gr = jax.grad(lr)(x)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-5, atol=1e-5)
+        # the gradient is the same masked scaling: zero exactly where the
+        # forward dropped, (2x/rate)/rate elsewhere
+        out = pk.fused_threshold_dropout(x, 0.7, key)
+        assert bool(jnp.all((np.asarray(out) == 0) == (np.asarray(gf) == 0)))
+
+    def test_keep_rate_and_scaling(self):
+        rng = np.random.default_rng(6)
+        x = jnp.asarray(np.abs(rng.normal(size=(512, 128))) + 1.0,
+                        jnp.float32)
+        for rate in (0.3, 0.5, 0.8):
+            out = pk.fused_threshold_dropout(x, rate, jax.random.PRNGKey(1))
+            frac = float(jnp.mean(out != 0))
+            assert abs(frac - rate) < 0.01, (rate, frac)
+            kept = np.asarray(out)[np.asarray(out) != 0]
+            orig = np.asarray(x)[np.asarray(out) != 0]
+            np.testing.assert_allclose(kept, orig / rate, rtol=1e-6)
+
+    def test_seed_sensitivity_and_determinism(self):
+        x = jnp.ones((256, 128), jnp.float32)
+        a = pk.fused_threshold_dropout(x, 0.5, jax.random.PRNGKey(1))
+        b = pk.fused_threshold_dropout(x, 0.5, jax.random.PRNGKey(1))
+        c = pk.fused_threshold_dropout(x, 0.5, jax.random.PRNGKey(2))
+        assert bool(jnp.all(a == b))          # same key -> same mask
+        assert not bool(jnp.all(a == c))      # different key -> different
+
+    def test_no_mask_tensor_saved_for_backward(self):
+        """The O(HBM) point of the kernel: the vjp residual is the SEED,
+        not a mask — no x-shaped saved intermediate beyond x itself ever
+        flows fwd->bwd.  Proxy check: grad works under jit and the
+        backward recomputes (same kernel applied to the cotangent)."""
+        key = jax.random.PRNGKey(9)
+        x = jnp.ones((128, 128), jnp.float32)
+        grad_fn = jax.jit(jax.grad(
+            lambda x: jnp.sum(pk.fused_threshold_dropout(x, 0.5, key))))
+        g = grad_fn(x)
+        ref = pk.threshold_dropout_reference(jnp.ones_like(x), 0.5, key)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref))
+
+    def test_supported_predicate(self):
+        assert pk.dropout_fused_supported((64, 128), jnp.float32)
+        assert not pk.dropout_fused_supported((4, 4), jnp.float32)  # tiny
+        assert not pk.dropout_fused_supported((64, 128), jnp.int32)
