@@ -110,6 +110,87 @@ def machine_fingerprint(devices=None):
     return fp
 
 
+GATE_THRESHOLD = 0.15   # >15% below the stored best-of-N = regression
+
+
+def _fingerprint_key(fp):
+    """The comparability key for regression gating: two records gate
+    against each other only when they ran on the same host/backend
+    shape.  Volatile fields (kernel build, jax patch level) stay out so
+    a routine image bump doesn't orphan the whole history."""
+    parts = (fp.get("host", "?"), fp.get("platform", "?"),
+             fp.get("device_kind", "?"), str(fp.get("device_count", 1)),
+             str(fp.get("cpu_count", "?")))
+    return "|".join(parts)
+
+
+def gate_regressions(result, history_dir):
+    """Bench regression gating (ROADMAP item 5): persist each config's
+    best-of-N value history under ``bench_history/`` keyed by machine
+    fingerprint, and FAIL LOUDLY — record flag here, nonzero exit in
+    ``main()`` — when a config lands >15% below its stored baseline on
+    the SAME fingerprint (different machine = different entry, no
+    cross-machine noise).  ``DL4J_BENCH_NO_GATE=1`` records but never
+    fails (the escape hatch for a known slowdown or machine change);
+    dry-run configs are all skipped so the gate is a recorded no-op."""
+    disabled = os.environ.get("DL4J_BENCH_NO_GATE") == "1"
+    keep_n = 10
+    gate = {"dir": history_dir, "threshold_pct": int(GATE_THRESHOLD * 100),
+            "keep_n": keep_n, "disabled": disabled, "checked": 0,
+            "regressions": [], "failed": False}
+    fp_key = _fingerprint_key(result.get("machine", {}))
+    try:
+        os.makedirs(history_dir, exist_ok=True)
+        for name, cfg in (result.get("configs") or {}).items():
+            value = cfg.get("value") if isinstance(cfg, dict) else None
+            unit = cfg.get("unit") if isinstance(cfg, dict) else None
+            if not isinstance(value, (int, float)) or value <= 0 or not unit:
+                continue   # skipped / errored / dry-run configs don't gate
+            path = os.path.join(history_dir, f"{name}.json")
+            hist = {"entries": {}}
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        hist = json.load(f)
+                except Exception:
+                    hist = {"entries": {}}   # corrupt history never blocks
+            entry = hist["entries"].get(fp_key)
+            if entry is not None and entry.get("unit") == unit \
+                    and entry.get("values"):
+                baseline = max(entry["values"])
+                gate["checked"] += 1
+                if value < baseline * (1.0 - GATE_THRESHOLD):
+                    gate["regressions"].append({
+                        "config": name, "value": value,
+                        "baseline_best_of_n": baseline, "unit": unit,
+                        "drop_pct": round((1 - value / baseline) * 100, 1),
+                        "fingerprint": fp_key,
+                    })
+            elif entry is not None and entry.get("unit") != unit:
+                # a config changed what it measures: restart its history
+                entry = None
+            if entry is None:
+                entry = {"unit": unit, "values": []}
+            entry["values"] = (entry["values"] + [value])[-keep_n:]
+            entry["unit"] = unit
+            entry["updated"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            hist["entries"][fp_key] = entry
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(hist, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+    except Exception as e:   # the gate must never kill the record itself
+        gate["error"] = f"{type(e).__name__}: {e}"
+    gate["failed"] = bool(gate["regressions"]) and not disabled
+    result["bench_gate"] = gate
+    if gate["regressions"]:
+        log(f"bench gate: {len(gate['regressions'])} regression(s) "
+            f"{'(gate disabled)' if disabled else '— FAILING'}: "
+            + ", ".join(f"{r['config']} -{r['drop_pct']}%"
+                        for r in gate["regressions"]))
+    return gate
+
+
 def compiled_step(raw_step, args):
     """AOT-compile a train step once; returns (callable, flops or None).
     Compile wall-time is recorded in ``compiled_step.last_compile_sec``
@@ -1258,6 +1339,203 @@ def bench_serving():
     }
 
 
+def bench_decode():
+    """Stateful-decode A/B (ROADMAP 3b): serving T autoregressive tokens
+    to K concurrent streams via the slot-pool decode path
+    (``server/decode.py`` — carries live on device, each token is ONE
+    pre-compiled gather→step→scatter call, O(1) in prefix length) vs
+    the re-run-prefix baseline (every new token re-runs ``output()``
+    over the whole consumed prefix — O(T), the only option without
+    carried state).  Reports per-token step time at growing prefix
+    checkpoints (the O(1) claim is that the stateful line is FLAT),
+    steady-state tokens/sec with window variance, the speedup at T=256,
+    and the compiled-program count, which the slot/bucket ladder must
+    bound."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.server.decode import DecodePool
+
+    F, H, K, T = 32, 160, 4, 256
+    CHECKPOINTS = (32, 64, 128, 256)
+    conf = (NeuralNetConfiguration.builder().seed(17).learning_rate(0.01)
+            .shape_bucketing(True)
+            .list()
+            .layer(L.GravesLSTM(n_in=F, n_out=H, activation="tanh"))
+            .layer(L.RnnOutputLayer(n_in=H, n_out=F, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(K, T, F)).astype(np.float32)
+
+    # --- leg A: re-run-prefix.  Serving token P+1 without carried state
+    # means output() over the full [K, P, F] prefix; per-token cost is
+    # one whole-prefix forward.  Shapes are warmed off-clock so the leg
+    # measures compute, not compiles (pow2 checkpoints = bucket rungs).
+    prefix_leg = {}
+    for p in CHECKPOINTS:
+        net.output(x[:, :p])  # warm this bucket rung
+        reps = [0.0] * 3
+        for i in range(len(reps)):
+            t0 = time.perf_counter()
+            out = net.output(x[:, :p])
+            np.asarray(out)
+            reps[i] = time.perf_counter() - t0
+        t_med = statistics.median(reps)
+        prefix_leg[str(p)] = {
+            "per_token_ms": round(t_med * 1e3, 3),
+            "tokens_per_sec": round(K / t_med, 1),
+        }
+    prefix_tps_256 = prefix_leg[str(T)]["tokens_per_sec"]
+
+    # --- leg B: stateful slot decode.  K sessions step token-by-token;
+    # each round submits one step per session and the pool coalesces
+    # them into one jitted dispatch (min_batch=K holds the batch until
+    # every stream joins — the continuous-batching steady state).
+    pool = DecodePool(net, name="bench", max_slots=K, max_wait_ms=5.0,
+                      min_batch=K)
+    sids = [pool.open_session() for _ in range(K)]
+    tok = {"t": 0}
+
+    def step_round():
+        t = tok["t"]
+        futs = [pool.submit_step(sid, x[i, t:t + 1])
+                for i, sid in enumerate(sids)]
+        for f in futs:
+            f.result(timeout=120)
+        tok["t"] += 1
+
+    step_round()  # compile off-clock (the one decode program)
+    bins = {}
+    prev = 1
+    for p in CHECKPOINTS:
+        n = p - prev
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step_round()
+        bins[str(p)] = {
+            "per_token_ms": round((time.perf_counter() - t0) / n * 1e3, 3),
+        }
+        prev = p
+    # steady state with window variance: the prefix only grows, so flat
+    # windows here ARE the O(1) evidence
+    times = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(32):
+            step_round()
+        times.append(time.perf_counter() - t0)
+    stats = window_stats(times, K, 32)
+    decode_programs = pool.stats().get("decode_programs", 0)
+    ladder = list(pool._ladder)
+    pool.stop()
+
+    per_tok = [bins[str(p)]["per_token_ms"] for p in CHECKPOINTS]
+    flat = max(per_tok) / max(min(per_tok), 1e-9)
+    decode_tps = stats["items_per_sec_median"]
+    speedup = decode_tps / max(prefix_tps_256, 1e-9)
+    return {
+        "metric": f"stateful slot-decode tokens/sec, {K} concurrent "
+                  f"sessions, T={T}",
+        "value": round(decode_tps, 1),
+        "unit": "tokens/sec",
+        "sessions": K,
+        "prefix_checkpoints": list(CHECKPOINTS),
+        "decode_per_token_ms_by_prefix": bins,
+        "decode_flat_ratio_max_over_min": round(flat, 3),
+        "decode_flat_in_prefix": flat <= 1.5,
+        "rerun_prefix": prefix_leg,
+        "speedup_vs_rerun_prefix_at_256": round(speedup, 2),
+        "meets_3x_target": speedup >= 3.0,
+        "decode_programs": decode_programs,
+        "slot_ladder": ladder,
+        "retraces_bounded_by_ladder": decode_programs <= max(1, len(ladder)),
+        **stats,
+    }
+
+
+def bench_sharded_serving(n_chips):
+    """Sharded-inference A/B (ROADMAP 3a): the same wide-MLP ``output()``
+    replica-style vs under ``conf.sharding(data=1, fsdp=n_chips)`` — the
+    pjit'd output path with the plan's in/out shardings (params stay in
+    their fsdp layout, batch shards over the mesh, ONE host gather at
+    the response edge).  Reports rows/sec per leg with window variance
+    and cross-leg output parity; on one device the sharded conf degrades
+    to replica-style and the record says so."""
+    import jax
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    BATCH, FEAT, HID, CLASSES = 256, 512, 512, 64
+    fsdp_degree = max(1, n_chips)
+    rng = np.random.default_rng(29)
+    x = rng.normal(size=(BATCH, FEAT)).astype(np.float32)
+
+    def make_net(shard):
+        b = NeuralNetConfiguration.builder().seed(3).updater("adam") \
+            .learning_rate(1e-3)
+        if shard:
+            b.sharding(data=1, fsdp=fsdp_degree)
+        conf = (b.list()
+                .layer(L.DenseLayer(n_in=FEAT, n_out=HID,
+                                    activation="relu"))
+                .layer(L.DenseLayer(n_in=HID, n_out=HID,
+                                    activation="relu"))
+                .layer(L.OutputLayer(n_in=HID, n_out=CLASSES,
+                                     activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    legs = {}
+    outs = {}
+    for name, shard in (("replica", False), ("sharded", True)):
+        net = make_net(shard)
+        if shard:
+            # identical weights so the parity row is meaningful
+            import jax.numpy as jnp
+            ref = legs["replica"]["_net"]
+            net.net_params = jax.tree_util.tree_map(jnp.asarray,
+                                                    ref.net_params)
+            net._output_fn = None
+        net.output(x)  # compile off-clock
+
+        def run():
+            outs[name] = net.output(x)
+
+        times = timed_windows(
+            run, lambda: jax.block_until_ready(outs[name]), steps=10,
+            warmup=2)
+        leg = window_stats(times, BATCH, 10)
+        leg["_net"] = net
+        if shard:
+            leg["sharding_active"] = \
+                getattr(net, "_sharding_plan", None) is not None
+        legs[name] = leg
+    parity = float(np.max(np.abs(
+        np.asarray(jax.device_get(outs["replica"]))
+        - np.asarray(jax.device_get(outs["sharded"])))))
+    for leg in legs.values():
+        leg.pop("_net")
+    sh = legs["sharded"]
+    return {
+        "metric": f"wide-MLP output() rows/sec, replica vs sharded "
+                  f"serving (fsdp={fsdp_degree})",
+        "value": round(sh["items_per_sec_median"], 1),
+        "unit": "rows/sec (sharded leg)",
+        "fsdp_degree": fsdp_degree,
+        "sharding_active": sh.get("sharding_active", False),
+        "single_device_degrade": not sh.get("sharding_active", False),
+        "speedup_vs_replica": round(
+            sh["items_per_sec_median"]
+            / max(legs["replica"]["items_per_sec_median"], 1e-9), 3),
+        "output_abs_parity": parity,
+        "parity_within_1e6": parity <= 1e-6,
+        **legs,
+    }
+
+
 def probe_primary_backend(timeout_s=None):
     """Probe the primary (TPU/axon) backend in a SUBPROCESS with a hard
     timeout.  Backend init can hang forever in C code inside the PJRT
@@ -1451,6 +1729,10 @@ def main():
         log(traceback.format_exc())
     finally:
         _emit(result)
+    if (result.get("bench_gate") or {}).get("failed"):
+        # regression gate (ROADMAP 5): the record is out — now fail the
+        # process so CI / the nightly driver can't miss it
+        sys.exit(4)
 
 
 def _run_configs(result):
@@ -1511,8 +1793,10 @@ def _run_configs(result):
         ("bench_ragged", bench_ragged),
         ("bench_pipeline", bench_pipeline),
         ("bench_serving", bench_serving),
+        ("bench_decode", bench_decode),
         ("bench_resilience", bench_resilience),
         ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
+        ("bench_sharded_serving", lambda: bench_sharded_serving(n_chips)),
         ("bench_kernels", bench_kernels),
         ("vgg16", lambda: bench_vgg16(peak)),
         ("charrnn", bench_charrnn),
@@ -1541,7 +1825,8 @@ def _run_configs(result):
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
                  "bench_kernels", "bench_pipeline", "bench_serving",
-                 "bench_resilience", "bench_sharded", "charrnn", "word2vec",
+                 "bench_decode", "bench_resilience", "bench_sharded",
+                 "bench_sharded_serving", "charrnn", "word2vec",
                  "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
@@ -1615,6 +1900,10 @@ def _run_configs(result):
             monitor.get_registry().snapshot())
     except Exception as e:
         result["metrics_registry"] = {"error": f"{type(e).__name__}: {e}"}
+    # regression gate LAST: every config's record (incl. errors/skips)
+    # is already in place, so the gate sees exactly what gets emitted
+    gate_regressions(result, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_history"))
 
 
 if __name__ == "__main__":

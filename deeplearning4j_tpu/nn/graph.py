@@ -603,6 +603,10 @@ class ComputationGraph:
         self._sharding_plan = plan
         self._step_fn = None
         self._fused_fns = None
+        # inference entry points re-jit too: the output path carries the
+        # plan's in/out_shardings (sharded serving, ROADMAP 3a)
+        self._output_fn = None
+        self._rnn_step_fn = None
         if plan is not None and self.net_params is not None:
             fsdp.place_model(plan, self)
 
@@ -749,36 +753,100 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     # Stateful RNN inference (ref: ComputationGraph.rnnTimeStep :1569)
     # ------------------------------------------------------------------
+    def _rnn_step_raw(self):
+        """The pure carried decode step — the seam shared by
+        :meth:`rnn_time_step` and the serving decode pool
+        (``server/decode.py``): ``(params, base_state, carries, xs, ms)
+        -> (outs, new_carries)`` with ``carries`` a dict keyed by the
+        recurrent vertices' names.  Explicit carries keep the traced
+        structure closed under iteration: one compiled program serves
+        every step of an autoregressive stream (see
+        MultiLayerNetwork._rnn_step_raw)."""
+        policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
+        def rnn_fn(params, state, carries, xs, ms):
+            pc, cc, xs_c, ms_c = policy.cast_to_compute(
+                (params, carries, xs, ms))
+            st = {}
+            for n, s in state.items():
+                s = {k: v for k, v in s.items() if k != "rnn_state"}
+                if n in cc:
+                    s["rnn_state"] = cc[n]
+                st[n] = s
+            ins = dict(zip(self.conf.network_inputs, xs_c))
+            masks = ({n: m for n, m in zip(self.conf.network_inputs, ms_c)
+                      if m is not None} if ms_c is not None else {})
+            acts, _, new_states, _ = self._forward_all(
+                pc, st, ins, masks, False, jax.random.PRNGKey(0))
+            outs = tuple(policy.cast_to_param(acts[n])
+                         for n in self.conf.network_outputs)
+            new_carries = {n: ns["rnn_state"]
+                           for n, ns in new_states.items()
+                           if isinstance(ns, dict) and "rnn_state" in ns}
+            return outs, policy.cast_to_param(new_carries)
+
+        return rnn_fn
+
+    def rnn_carry_template(self, n: int, feature_tails=None,
+                           dtype=jnp.float32):
+        """Zero-initialized carry dict (vertex name → carry pytree) for
+        ``n`` concurrent streams, discovered via ``jax.eval_shape`` over
+        the carried step.  ``feature_tails`` is one per-example shape
+        tail per network input (``(T, C)``); defaults from the conf's
+        declared input types."""
+        if self.net_params is None:
+            self.init()
+        if feature_tails is None:
+            if not self.conf.input_types:
+                raise ValueError("rnn_carry_template needs explicit "
+                                 "feature_tails= (no set_input_types())")
+            feature_tails = [(1, it.size) if it.kind == "rnn"
+                             else (it.size,)
+                             for it in self.conf.input_types]
+        xs = tuple(jax.ShapeDtypeStruct(
+            (int(n),) + tuple(int(d) for d in t), dtype)
+            for t in feature_tails)
+        base = {k: {kk: v for kk, v in s.items() if kk != "rnn_state"}
+                for k, s in self.net_state.items()}
+        _, spec = jax.eval_shape(self._rnn_step_raw(), self.net_params,
+                                 base, {}, xs, None)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
     def rnn_time_step(self, *inputs):
         """Single/multi-step stateful inference: each call consumes
         [N, T, C] sequences, returns the network outputs, and carries
         every recurrent vertex's hidden state to the next call.
 
-        The forward is jit-compiled and cached (token-by-token
-        autoregressive sampling must not pay op-by-op dispatch for the
-        whole graph every call); the first call without carried state and
-        the steady state with it trace once each."""
+        Every call re-dispatches ONE cached jitted step: the first call
+        materializes a zero carry template so the carry structure (and
+        therefore the trace) is identical with and without stored state
+        — token-by-token sampling pays neither op-by-op dispatch nor a
+        second steady-state retrace."""
         if self.net_params is None:
             self.init()
         self._check_trace_token()
         if getattr(self, "_rnn_step_fn", None) is None:
-            def rnn_fn(params, state, xs):
-                ins = dict(zip(self.conf.network_inputs, xs))
-                acts, _, new_states, _ = self._forward_all(
-                    params, state, ins, {}, False, jax.random.PRNGKey(0))
-                outs = tuple(acts[n] for n in self.conf.network_outputs)
-                carries = {n: ns["rnn_state"]
-                           for n, ns in new_states.items()
-                           if isinstance(ns, dict) and "rnn_state" in ns}
-                return outs, carries
-            self._rnn_step_fn = jax.jit(rnn_fn)
+            self._rnn_step_fn = jax.jit(self._rnn_step_raw())
         xs = tuple(jnp.asarray(x) for x in inputs)
-        outs, carries = self._rnn_step_fn(self.net_params, self.net_state, xs)
+        carries = {n: s["rnn_state"] for n, s in self.net_state.items()
+                   if "rnn_state" in s}
+        if not carries:
+            carries = self.rnn_carry_template(
+                xs[0].shape[0],
+                feature_tails=[tuple(x.shape[1:]) for x in xs],
+                dtype=xs[0].dtype)
+        self.compile_telemetry.record("rnn_time_step", (xs, carries))
+        outs, new_carries = self._rnn_step_fn(
+            self.net_params,
+            {n: {k: v for k, v in s.items() if k != "rnn_state"}
+             for n, s in self.net_state.items()},
+            carries, xs, None)
         merged = {}
         for name, old in self.net_state.items():
-            s = dict(old)
-            if name in carries:
-                s["rnn_state"] = carries[name]
+            s = {k: v for k, v in old.items() if k != "rnn_state"}
+            if name in new_carries:
+                s["rnn_state"] = new_carries[name]
             merged[name] = s
         self.net_state = merged
         return outs
@@ -794,6 +862,7 @@ class ComputationGraph:
         if self.net_params is None:
             self.init()
         self._check_trace_token()
+        self._ensure_sharding()
         if self._output_fn is None:
             policy = dtype_ops.resolve(self.conf.global_conf.precision)
 
@@ -807,11 +876,21 @@ class ComputationGraph:
                                                   False, jax.random.PRNGKey(0))
                 return tuple(policy.cast_to_param(acts[n])
                              for n in self.conf.network_outputs)
-            self._output_fn = jax.jit(out_fn)
+            out_plan = getattr(self, "_sharding_plan", None)
+            if out_plan is not None:
+                # sharded serving (ROADMAP 3a): pjit'd output with the
+                # plan's in/out shardings — see MultiLayerNetwork.output
+                from deeplearning4j_tpu.parallel import fsdp
+                self._output_fn = fsdp.jit_sharded_output(
+                    out_fn, out_plan, self.net_params)
+            else:
+                self._output_fn = jax.jit(out_fn)
         state = {n: {k: v for k, v in s.items() if k != "rnn_state"}
                  for n, s in self.net_state.items()}
         g = self.conf.global_conf
+        plan = getattr(self, "_sharding_plan", None)
         masks = unpad = bucket = None
+        ms_p = [None] * len(inputs)
         if g.shape_bucketing:
             xs_p, ms_p, pairs, n = [], [], [], None
             for x in inputs:
@@ -821,13 +900,25 @@ class ComputationGraph:
                 ms_p.append(mp)
                 pairs.append((t, b[1]))
             inputs = xs_p
-            if any(m is not None for m in ms_p):
-                # explicit H2D for the masks, like the inputs below — a
-                # numpy mask handed to the jitted fn transfers implicitly
-                masks = tuple(None if m is None else jnp.asarray(m)
-                              for m in ms_p)
             bucket = (b[0], tuple(tb for _, tb in pairs))
             unpad = (n, pairs)
+        if plan is not None:
+            # batch rows must divide the mesh's data degree; zero rows
+            # are exact at inference and sliced back off below
+            from deeplearning4j_tpu.parallel import fsdp
+            padded = [fsdp.pad_inference_rows(x, m, plan.n_data)
+                      for x, m in zip(inputs, ms_p)]
+            if any(nr is not None for _, _, nr in padded):
+                n0 = next(nr for _, _, nr in padded if nr is not None)
+                inputs = [x for x, _, _ in padded]
+                ms_p = [m for _, m, _ in padded]
+                if unpad is None:
+                    unpad = (n0, [])
+        if any(m is not None for m in ms_p):
+            # explicit H2D for the masks, like the inputs below — a
+            # numpy mask handed to the jitted fn transfers implicitly
+            masks = tuple(None if m is None else jnp.asarray(m)
+                          for m in ms_p)
         xs = tuple(jnp.asarray(x) for x in inputs)
         self.compile_telemetry.record("output", (xs, masks), bucket=bucket)
         outs = self._output_fn(self.net_params, state, xs, masks)

@@ -243,6 +243,7 @@ class MultiLayerNetwork:
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
             self._fused_fns = None
+            self._rnn_step_fn = None
             self.compile_telemetry.invalidate()
 
     def _ensure_sharding(self):
@@ -261,6 +262,10 @@ class MultiLayerNetwork:
         self._sharding_plan = plan
         self._step_fn = None
         self._fused_fns = None
+        # inference entry points re-jit too: the output path carries the
+        # plan's in/out_shardings (sharded serving, ROADMAP 3a)
+        self._output_fn = None
+        self._rnn_step_fn = None
         if plan is not None and self.net_params is not None:
             fsdp.place_model(plan, self)
 
@@ -431,6 +436,14 @@ class MultiLayerNetwork:
             out, _, _ = self._forward(pc, state, xc, fmc, False,
                                       jax.random.PRNGKey(0))
             return policy.cast_to_param(out)
+        plan = getattr(self, "_sharding_plan", None)
+        if plan is not None:
+            # sharded serving (ROADMAP 3a): a model that only fits
+            # sharded serves through the same plan the fit path uses —
+            # params stay in their fsdp layout, the batch shards over
+            # data(+fsdp), the output all-gathers on device
+            from deeplearning4j_tpu.parallel import fsdp
+            return fsdp.jit_sharded_output(output_fn, plan, self.net_params)
         return jax.jit(output_fn)
 
     # ------------------------------------------------------------------
@@ -914,13 +927,23 @@ class MultiLayerNetwork:
         if self.net_params is None:
             self.init()
         self._check_trace_token()
+        self._ensure_sharding()
         if self._output_fn is None:
             self._output_fn = self._build_output_fn()
+        plan = getattr(self, "_sharding_plan", None)
         unpad = bucket = None
         if self.conf.global_conf.shape_bucketing:
             x, mask, n, t, bucket = bucketing.bucket_inference_features(
                 x, mask, self.conf.global_conf)
             unpad = (n, t, bucket[1])
+        if plan is not None:
+            # data-sharded layout needs a batch divisible by the mesh's
+            # batch degree; zero rows are exact at inference and the
+            # unpad slice below removes them
+            from deeplearning4j_tpu.parallel import fsdp
+            x, mask, n_real = fsdp.pad_inference_rows(x, mask, plan.n_data)
+            if n_real is not None and unpad is None:
+                unpad = (n_real, None, None)
         self.compile_telemetry.record("output", (x, mask), bucket=bucket)
         out = self._output_fn(self.net_params,
                               [{k: v for k, v in s.items() if k != "rnn_state"}
@@ -1054,16 +1077,101 @@ class MultiLayerNetwork:
             merged.append(s)
         self.net_state = merged
 
-    def rnn_time_step(self, x):
-        """Stateful single/multi-step inference, carrying RNN state across
-        calls (ref: MultiLayerNetwork.rnnTimeStep :2383).  x: [N, T, C]."""
+    def _rnn_step_raw(self):
+        """The pure carried decode step — the seam shared by
+        :meth:`rnn_time_step` and the serving decode pool
+        (``server/decode.py``): ``(params, base_state, carries, x,
+        fmask) -> (out, new_carries)`` where ``carries`` is a per-layer
+        list of recurrent carry pytrees (``None`` for carry-free
+        layers).  Keeping the carry EXPLICIT in the signature (instead
+        of buried inside ``net_state``) is what makes the structure
+        closed under iteration, so ONE jitted trace serves every step
+        of an autoregressive stream (arXiv 2603.09555's compiled-carry
+        contract — no per-step retrace, no per-step re-dispatch of the
+        whole layer stack)."""
+        policy = dtype_ops.resolve(self.conf.global_conf.precision)
+
+        def rnn_fn(params, state, carries, x, fmask):
+            pc, cc, xc, fmc = policy.cast_to_compute(
+                (params, carries, x, fmask))
+            st = []
+            for s, c in zip(state, cc):
+                s = {k: v for k, v in s.items() if k != "rnn_state"}
+                if c is not None:
+                    s["rnn_state"] = c
+                st.append(s)
+            out, new_states, _ = self._forward(
+                pc, st, xc, fmc, False, jax.random.PRNGKey(0),
+                stateful_rnn=True)
+            new_carries = [ns.get("rnn_state")
+                           if isinstance(ns, dict) else None
+                           for ns in new_states]
+            return (policy.cast_to_param(out),
+                    policy.cast_to_param(new_carries))
+
+        return rnn_fn
+
+    def rnn_carry_template(self, n: int, feature_tail=None,
+                           dtype=jnp.float32):
+        """Zero-initialized per-layer carry pytree for ``n`` concurrent
+        streams — shapes discovered via ``jax.eval_shape`` over the
+        carried step (no compile, no device work), so ANY layer that
+        emits an ``rnn_state`` carry participates without a per-type
+        registry.  ``feature_tail`` is the per-example input shape tail
+        (``(T, C)``); defaults to one timestep of the conf's recurrent
+        input type."""
         if self.net_params is None:
             self.init()
+        if feature_tail is None:
+            it = self._input_type_chain_start()
+            if it.kind != "rnn":
+                raise ValueError(
+                    "rnn_carry_template needs a recurrent input type "
+                    "(or an explicit feature_tail=)")
+            feature_tail = (1, it.size)
+        x_sds = jax.ShapeDtypeStruct(
+            (int(n),) + tuple(int(d) for d in feature_tail), dtype)
+        base = [{k: v for k, v in s.items() if k != "rnn_state"}
+                for s in self.net_state]
+        _, spec = jax.eval_shape(
+            self._rnn_step_raw(), self.net_params, base,
+            [None] * len(self.layers), x_sds, None)
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), spec)
+
+    def rnn_time_step(self, x, mask=None):
+        """Stateful single/multi-step inference, carrying RNN state across
+        calls (ref: MultiLayerNetwork.rnnTimeStep :2383).  x: [N, T, C].
+
+        Every call is the SAME cached jitted step: the first call
+        materializes a zero carry template (so the carry structure is
+        identical with and without stored state) and each subsequent
+        call re-dispatches the one compiled program — per-token cost is
+        O(1) in how much history the stream has consumed."""
+        if self.net_params is None:
+            self.init()
+        self._check_trace_token()
+        if getattr(self, "_rnn_step_fn", None) is None:
+            self._rnn_step_fn = jax.jit(self._rnn_step_raw())
         x = jnp.asarray(x)
-        out, new_states, _ = self._forward(self.net_params, self.net_state, x,
-                                           None, False, jax.random.PRNGKey(0),
-                                           stateful_rnn=True)
-        self._merge_rnn_state(new_states)
+        m = None if mask is None else jnp.asarray(mask)
+        carries = [s.get("rnn_state") for s in self.net_state]
+        if all(c is None for c in carries):
+            carries = self.rnn_carry_template(
+                x.shape[0], feature_tail=tuple(x.shape[1:]), dtype=x.dtype)
+        self.compile_telemetry.record("rnn_time_step", (x, m, carries))
+        out, new_carries = self._rnn_step_fn(
+            self.net_params,
+            [{k: v for k, v in s.items() if k != "rnn_state"}
+             for s in self.net_state],
+            carries, x, m)
+        merged = []
+        for s, c in zip(self.net_state, new_carries):
+            s = {k: v for k, v in s.items() if k != "rnn_state"}
+            if c is not None:
+                s["rnn_state"] = c
+            merged.append(s)
+        self.net_state = merged
         return out
 
     def rnn_clear_previous_state(self):

@@ -4,6 +4,17 @@
 BlockingQueue, BatchedInferenceObservable merges concurrent requests up
 to ``batchLimit`` into a single ``output()`` call.)  One jitted forward
 on the TPU serves all callers; dynamic batching amortizes dispatch.
+
+Sharded serving (ROADMAP 3a): when the model's conf declares a
+``sharding(...)`` plan, ``model.output()`` runs as a pjit'd program with
+the plan's in/out shardings — params stay in their fsdp layout (a model
+that only fits sharded never materializes whole on one device), the
+merged batch shards over the mesh's data axis, and the output replicates
+on device.  This front-end stays plan-agnostic except for two edges: the
+merged batch is lifted to a multiple of the mesh's data degree (one
+all-gather-free dispatch instead of a pad-per-request), and the ONLY
+host transfer is the explicit ``jax.device_get`` on the final output —
+the response edge.
 """
 
 from __future__ import annotations
@@ -12,6 +23,7 @@ import queue
 import threading
 from typing import List, Optional
 
+import jax
 import numpy as np
 
 
@@ -31,6 +43,11 @@ class ParallelInference:
                  inference_mode: str = "batched", workers: int = 1):
         self.model = model
         self.batch_limit = batch_limit
+        n_data = self._plan_data_degree()
+        if n_data > 1 and batch_limit % n_data:
+            # merged batches divide the mesh's data axis: round the merge
+            # target UP so a full batch dispatches without pad rows
+            self.batch_limit = batch_limit + n_data - batch_limit % n_data
         self.inference_mode = inference_mode
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = threading.Event()
@@ -38,6 +55,17 @@ class ParallelInference:
                          for _ in range(max(1, workers))]
         for t in self._threads:
             t.start()
+
+    def _plan_data_degree(self) -> int:
+        """The mesh's batch degree under the model's sharding plan (1
+        when serving unsharded) — resolved lazily so construction before
+        ``init()`` still works."""
+        try:
+            self.model._ensure_sharding()
+            plan = getattr(self.model, "_sharding_plan", None)
+            return int(plan.n_data) if plan is not None else 1
+        except Exception:
+            return 1
 
     def _worker(self):
         while not self._shutdown.is_set():
@@ -57,7 +85,13 @@ class ParallelInference:
                     total += nxt.x.shape[0]
             try:
                 x = np.concatenate([r.x for r in batch]) if len(batch) > 1 else batch[0].x
-                out = np.asarray(self.model.output(x))
+                out = self.model.output(x)
+                if isinstance(out, tuple):   # multi-output graph: first head
+                    out = out[0]
+                # the response edge: the one explicit device→host gather
+                # (sharded outputs all-gathered on device by the pjit'd
+                # program, so this is a single replicated pull)
+                out = np.asarray(jax.device_get(out))
                 off = 0
                 for r in batch:
                     n = r.x.shape[0]

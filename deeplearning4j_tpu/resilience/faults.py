@@ -7,6 +7,7 @@ Production code is instrumented with named **sites**::
     batcher.compute      MicroBatcher, before the jitted inference call
     checkpoint.write     CheckpointListener, before a checkpoint save
     gateway.predict      gateway entry point, on each predict request
+    decode.step          DecodePool batcher, before each decode dispatch
 
 Each instrumented point calls :func:`check(site)`; with nothing armed
 that is a single attribute read.  A :class:`FaultPlan` armed at a site
@@ -48,7 +49,7 @@ from deeplearning4j_tpu.resilience.errors import TransientError
 
 # The instrumented sites (docs/RESILIENCE.md keeps the prose catalog).
 SITES = ("reader.next_raw", "cache.load", "batcher.compute",
-         "checkpoint.write", "gateway.predict")
+         "checkpoint.write", "gateway.predict", "decode.step")
 
 ENV_VAR = "DL4J_FAULT_PLAN"
 

@@ -46,13 +46,14 @@ from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
 
 
 class _Pending:
-    __slots__ = ("x", "future", "t_enqueue", "deadline")
+    __slots__ = ("x", "future", "t_enqueue", "deadline", "tenant")
 
-    def __init__(self, x, future, t_enqueue, deadline=None):
+    def __init__(self, x, future, t_enqueue, deadline=None, tenant=None):
         self.x = x
         self.future = future
         self.t_enqueue = t_enqueue
         self.deadline = deadline  # absolute time.monotonic(), or None
+        self.tenant = tenant      # fair-share admission attribution
 
 
 class ServingMetrics:
@@ -80,9 +81,14 @@ class ServingMetrics:
         self.total = reg.histogram(
             "dl4j_serving_total_seconds",
             "request enqueue → result", ("model",)).labels(**lbl)
-        self._c_requests = reg.counter(
+        # requests carry a tenant label for fair-share attribution; the
+        # family is incremented per request at dispatch (not per batch)
+        # so per-tenant series sum to the model's total without double
+        # counting
+        self._f_requests = reg.counter(
             "dl4j_serving_requests_total", "predict requests served",
-            ("model",)).labels(**lbl)
+            ("model", "tenant"))
+        self._model = lbl["model"]
         self._c_rows = reg.counter(
             "dl4j_serving_rows_total", "rows served", ("model",)).labels(**lbl)
         self._c_batches = reg.counter(
@@ -98,6 +104,10 @@ class ServingMetrics:
         with self._lock:
             self.shed[reason] = self.shed.get(reason, 0) + 1
 
+    def record_request(self, tenant=None) -> None:
+        self._f_requests.labels(model=self._model,
+                                tenant=tenant or "-").inc()
+
     def record_batch(self, n_requests: int, n_rows: int) -> None:
         with self._lock:
             self.requests += n_requests
@@ -105,7 +115,6 @@ class ServingMetrics:
             self.batches += 1
             self.batch_size_hist[n_rows] = \
                 self.batch_size_hist.get(n_rows, 0) + 1
-        self._c_requests.inc(n_requests)
         self._c_rows.inc(n_rows)
         self._c_batches.inc()
 
@@ -196,7 +205,8 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     # Client side
     # ------------------------------------------------------------------
-    def submit(self, features, timeout_ms: Optional[float] = None) -> Future:
+    def submit(self, features, timeout_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue a ``[k, ...]`` row batch; the future resolves to the
         ``[k, ...]`` output slice for exactly those rows.
 
@@ -204,14 +214,15 @@ class MicroBatcher:
         while the request is still queued, the request is SHED before
         compute (the future fails with :class:`DeadlineExceededError`)
         instead of burning a jitted call on an answer nobody is waiting
-        for."""
+        for.  ``tenant`` attributes the queued rows (and the served
+        request counter) for the gateway's fair-share admission."""
         x = np.asarray(features)
         if x.ndim < 1 or x.shape[0] == 0:
             raise ValueError("submit() needs a non-empty [k, ...] row batch")
         fut = Future()
         deadline = (None if timeout_ms is None
                     else time.monotonic() + float(timeout_ms) / 1e3)
-        p = _Pending(x, fut, time.perf_counter(), deadline)
+        p = _Pending(x, fut, time.perf_counter(), deadline, tenant)
         with self._cond:
             if not self._running:
                 raise RuntimeError("MicroBatcher is stopped")
@@ -227,17 +238,29 @@ class MicroBatcher:
         return fut
 
     def predict(self, features, timeout: Optional[float] = None,
-                timeout_ms: Optional[float] = None):
+                timeout_ms: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Blocking convenience wrapper around :meth:`submit`.
         ``timeout`` (seconds) bounds the client-side wait; ``timeout_ms``
         is the server-side deadline budget (queued past it = shed)."""
-        return self.submit(features, timeout_ms=timeout_ms).result(timeout)
+        return self.submit(features, timeout_ms=timeout_ms,
+                           tenant=tenant).result(timeout)
 
     def queue_rows(self) -> int:
         """Rows currently waiting for dispatch — the admission-control
         signal the gateway checks against its queue limit."""
         with self._cond:
             return sum(len(p.x) for p in self._queue)
+
+    def queue_rows_by_tenant(self) -> dict:
+        """Queued rows attributed per tenant — the fair-share admission
+        signal (requests without a tenant pool under ``"-"``)."""
+        with self._cond:
+            out: dict = {}
+            for p in self._queue:
+                t = p.tenant or "-"
+                out[t] = out.get(t, 0) + len(p.x)
+            return out
 
     @property
     def thread_alive(self) -> bool:
@@ -340,6 +363,7 @@ class MicroBatcher:
                 self.metrics.queue.record(t_dispatch - p.t_enqueue)
                 self.metrics.compute.record(t1 - t0)
                 self.metrics.total.record(t1 - p.t_enqueue)
+                self.metrics.record_request(p.tenant)
             self.metrics.record_batch(len(group), n)
         except Exception as e:
             for p in group:

@@ -1,0 +1,870 @@
+"""Stateful O(1) autoregressive decode: continuous batching over a
+device-resident session-slot cache.
+
+The reference's signature streaming-inference capability is
+``rnnTimeStep`` — per-layer recurrent state maps that make each step
+O(1) in prefix length (ref: MultiLayerNetwork.rnnTimeStep :2383,
+ComputationGraph.rnnTimeStep :1569).  That analog
+(``MultiLayerNetwork.rnn_time_step``) is host-side and single-stream:
+one client's carry lives in ``net_state`` and every concurrent stream
+would need its own model instance.  This module is the production form
+(ROADMAP item 3b; "Compiler-First State Space Duality and Portable O(1)
+Autoregressive Caching for Inference", arXiv 2603.09555 — compile the
+carried-state cache instead of re-tracing it):
+
+* **Session-slot pool** (:class:`DecodePool`): recurrent carries for up
+  to ``max_slots`` concurrent sessions live ON DEVICE as one pytree of
+  ``[S+1, ...]`` arrays (slot ``S`` is a scratch row for padding).  A
+  session owns a slot for its lifetime; its carry never round-trips to
+  the host between tokens.
+
+* **One pre-compiled step**: each dispatch is a single jitted call —
+  gather the active slots' carries (``pool[idx]``), run the engines'
+  carried step (``_rnn_step_raw``, the seam shared with
+  ``rnn_time_step``), scatter the updated carries back
+  (``pool.at[idx].set``) — with the pool buffer DONATED, so the cache
+  is updated in place.  Freshly-opened sessions zero their gathered
+  carry in-trace (the ``fresh`` mask) so slot reuse needs no host-side
+  pool mutation and no extra compiled program.
+
+* **Continuous batching** (:class:`_DecodeBatcher`): sessions join and
+  leave the running batch between steps — concurrent ``decode_step``
+  calls enqueue with a future, the batcher thread drains at most one
+  pending step per session, pads the joined set up to the slot
+  bucket-ladder (and each chunk's time axis up to the time ladder, with
+  masked pad steps carrying state through unchanged), and dispatches.
+  Retraces are bounded by ladder sizes, not by how sessions come and go.
+
+* **Resilience**: slot exhaustion → :class:`OverloadedError` (the
+  gateway's 503 + Retry-After), idle sessions expire after ``ttl_s``,
+  expired deadlines shed before compute, and a killed batcher thread
+  (fault site ``decode.step``) fails every in-flight session cleanly —
+  futures error, slots reclaim, the next submit restarts the thread.
+
+Metered as the ``dl4j_decode_*`` family (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.analysis import sanitizer
+from deeplearning4j_tpu.ops import bucketing
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError, OverloadedError)
+
+log = logging.getLogger(__name__)
+
+tree_map = jax.tree_util.tree_map
+
+
+class DecodeMetrics:
+    """Registry-backed telemetry for one decode pool (the
+    ``dl4j_decode_*`` family) plus plain counters for the stats RPC."""
+
+    def __init__(self, name: str = ""):
+        reg = monitor.get_registry()
+        self._name = name or "default"
+        lbl = {"model": self._name}
+        self.c_opened = reg.counter(
+            "dl4j_decode_sessions_opened_total", "decode sessions opened",
+            ("model",)).labels(**lbl)
+        self._f_closed = reg.counter(
+            "dl4j_decode_sessions_closed_total",
+            "decode sessions closed, by reason", ("model", "reason"))
+        self.g_active = reg.gauge(
+            "dl4j_decode_active_sessions", "decode sessions currently open",
+            ("model",)).labels(**lbl)
+        self.g_capacity = reg.gauge(
+            "dl4j_decode_slot_capacity", "decode slot-pool capacity",
+            ("model",)).labels(**lbl)
+        self._f_steps = reg.counter(
+            "dl4j_decode_steps_total", "decode session-steps served",
+            ("model", "tenant"))
+        self.c_tokens = reg.counter(
+            "dl4j_decode_tokens_total", "timesteps decoded",
+            ("model",)).labels(**lbl)
+        self.c_batches = reg.counter(
+            "dl4j_decode_batches_total",
+            "continuous-batching decode dispatches", ("model",)).labels(**lbl)
+        self.h_step = reg.histogram(
+            "dl4j_decode_step_seconds",
+            "one gather→step→scatter jitted decode call",
+            ("model",)).labels(**lbl)
+        self.h_queue = reg.histogram(
+            "dl4j_decode_queue_seconds", "decode step enqueue → dispatch",
+            ("model",)).labels(**lbl)
+        self._c_shed = reg.counter(
+            "dl4j_resilience_shed_total",
+            "requests shed instead of served", labels=("reason",))
+        self._lock = threading.Lock()
+        self.steps = 0
+        self.tokens = 0
+        self.batches = 0
+        self.batch_size_hist: Dict[int, int] = {}
+
+    def record_step(self, tenant: Optional[str]) -> None:
+        self._f_steps.labels(model=self._name, tenant=tenant or "-").inc()
+
+    def record_closed(self, reason: str) -> None:
+        self._f_closed.labels(model=self._name, reason=reason).inc()
+
+    def record_shed(self, reason: str) -> None:
+        self._c_shed.labels(reason=reason).inc()
+
+    def record_batch(self, n_steps: int, n_tokens: int) -> None:
+        with self._lock:
+            self.steps += n_steps
+            self.tokens += n_tokens
+            self.batches += 1
+            self.batch_size_hist[n_steps] = \
+                self.batch_size_hist.get(n_steps, 0) + 1
+        self.c_tokens.inc(n_tokens)
+        self.c_batches.inc()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "steps": self.steps,
+                "tokens": self.tokens,
+                "batches": self.batches,
+                "steps_per_batch_mean":
+                    round(self.steps / self.batches, 2) if self.batches
+                    else 0.0,
+                "batch_size_hist": {str(k): v for k, v in
+                                    sorted(self.batch_size_hist.items())},
+            }
+
+
+class DecodeSession:
+    __slots__ = ("sid", "slot", "tenant", "created_at", "last_used",
+                 "steps", "started")
+
+    def __init__(self, sid: str, slot: int, tenant: Optional[str]):
+        self.sid = sid
+        self.slot = slot
+        self.tenant = tenant
+        self.created_at = time.monotonic()
+        self.last_used = self.created_at
+        self.steps = 0
+        # False until the first dispatched step: the pool step zeroes
+        # gathered carries for fresh rows in-trace, so a reused slot's
+        # stale carry is never observed
+        self.started = False
+
+
+class _PendingStep:
+    __slots__ = ("session", "xs", "masks", "future", "t_enqueue",
+                 "deadline", "tenant")
+
+    def __init__(self, session, xs, masks, future, deadline, tenant):
+        self.session = session
+        self.xs = xs          # tuple of per-input [T, ...] host arrays
+        self.masks = masks    # tuple of per-input [T] masks or None
+        self.future = future
+        self.t_enqueue = time.perf_counter()
+        self.deadline = deadline  # absolute time.monotonic(), or None
+        self.tenant = tenant
+
+
+def _pool_step_raw(model, is_graph: bool):
+    """The ONE compiled decode program: gather the active slots' carries,
+    run the engines' carried step, scatter the carries back.  ``fresh``
+    zeroes a gathered carry in-trace (a slot newly claimed by a session
+    must not inherit the previous tenant's state), so slot churn needs
+    no host-side pool writes and no second compiled program."""
+    rnn_raw = model._rnn_step_raw()
+
+    def pool_step(params, state, pool, idx, fresh, xs, fms):
+        def take(a):
+            g = a[idx]
+            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+            return g * (1.0 - f).astype(g.dtype)
+
+        carries = tree_map(take, pool)
+        if is_graph:
+            outs, new_c = rnn_raw(params, state, carries, xs, fms)
+        else:
+            out, new_c = rnn_raw(params, state, carries, xs[0], fms[0])
+            outs = (out,)
+        new_pool = tree_map(lambda p, c: p.at[idx].set(c.astype(p.dtype)),
+                            pool, new_c)
+        return outs, new_pool
+
+    return pool_step
+
+
+class DecodePool:
+    """Device-resident slot-pool decode state for ONE model instance,
+    with its continuous-batching dispatch thread.
+
+    ``max_slots`` bounds concurrent sessions (exhaustion raises
+    :class:`OverloadedError` after expiring idle sessions past
+    ``ttl_s``).  ``slot_ladder`` buckets the per-dispatch joined-session
+    count (powers of two up to ``max_slots`` by default) so compiled
+    programs are bounded by the ladder, not by how many sessions happen
+    to join a batch; chunk time axes bucket up to the model conf's time
+    ladder the same way (masked pad steps carry state unchanged —
+    exact)."""
+
+    SCRATCH_DTYPE = np.float32
+
+    def __init__(self, model, name: str = "", max_slots: int = 32,
+                 ttl_s: float = 600.0,
+                 slot_ladder: Optional[Sequence[int]] = None,
+                 max_wait_ms: float = 2.0, min_batch: int = 1):
+        self.model = model
+        self.name = name
+        self.max_slots = max(1, int(max_slots))
+        self.ttl_s = float(ttl_s)
+        self._ladder = bucketing.warmup_ladder(slot_ladder, self.max_slots)
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.min_batch = max(1, min(int(min_batch), self.max_slots))
+        self._is_graph = hasattr(model, "_forward_all")
+        self.n_inputs = (len(model.conf.network_inputs) if self._is_graph
+                         else 1)
+        self.metrics = DecodeMetrics(name)
+        self.metrics.g_capacity.set(self.max_slots)
+        self._cond = threading.Condition()
+        self._queue: List[_PendingStep] = []
+        self._inflight: List[_PendingStep] = []
+        self._sessions: Dict[str, DecodeSession] = {}
+        self._free: List[int] = list(range(self.max_slots))
+        self._running = True
+        self._dead = False
+        self.deaths = 0
+        self.restarts = 0
+        # device state — touched ONLY by the batcher thread after init
+        # (donated buffers: a concurrent host-side .at[].set would race
+        # the in-place update)
+        self._pool = None
+        self._tails: Optional[Tuple] = None
+        self._step_jit = None
+        self._thread = self._spawn_thread()
+
+    # ------------------------------------------------------------------
+    # Session lifecycle
+    # ------------------------------------------------------------------
+    def open_session(self, tenant: Optional[str] = None,
+                     retry_after_s: float = 1.0) -> str:
+        """Claim a slot; raises :class:`OverloadedError` when every slot
+        is held by a live (non-expired) session."""
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("DecodePool is stopped")
+            self._sweep_locked()
+            if not self._free:
+                self.metrics.record_shed("decode_slots_full")
+                raise OverloadedError(
+                    f"decode slots exhausted ({self.max_slots} sessions "
+                    "active)", retry_after_s=retry_after_s)
+            slot = self._free.pop()
+            sid = uuid.uuid4().hex[:16]
+            self._sessions[sid] = DecodeSession(sid, slot, tenant)
+            self.metrics.c_opened.inc()
+            self.metrics.g_active.set(len(self._sessions))
+            return sid
+
+    def close_session(self, sid: str, reason: str = "closed") -> bool:
+        with self._cond:
+            closed = self._close_locked(sid, reason)
+        return closed
+
+    def _close_locked(self, sid: str, reason: str) -> bool:
+        s = self._sessions.pop(sid, None)
+        if s is None:
+            return False
+        self._free.append(s.slot)
+        stranded = [p for p in self._queue if p.session.sid == sid]
+        self._queue = [p for p in self._queue if p.session.sid != sid]
+        for p in stranded:
+            if not p.future.done():
+                p.future.set_exception(
+                    RuntimeError(f"decode session {sid} closed ({reason}) "
+                                 "with steps still queued"))
+        self.metrics.record_closed(reason)
+        self.metrics.g_active.set(len(self._sessions))
+        return True
+
+    def _sweep_locked(self, now: Optional[float] = None) -> int:
+        if self.ttl_s <= 0:
+            return 0
+        now = time.monotonic() if now is None else now
+        expired = [sid for sid, s in self._sessions.items()
+                   if now - s.last_used > self.ttl_s]
+        for sid in expired:
+            self._close_locked(sid, reason="ttl")
+        return len(expired)
+
+    def sweep(self) -> int:
+        """Expire idle sessions past ``ttl_s`` (also runs on every
+        ``open_session`` and between batches)."""
+        with self._cond:
+            return self._sweep_locked()
+
+    @property
+    def active_sessions(self) -> int:
+        with self._cond:
+            return len(self._sessions)
+
+    def session_ids(self) -> List[str]:
+        with self._cond:
+            return list(self._sessions)
+
+    # ------------------------------------------------------------------
+    # Client side
+    # ------------------------------------------------------------------
+    def submit_step(self, sid: str, xs, masks=None,
+                    timeout_ms: Optional[float] = None,
+                    tenant: Optional[str] = None) -> Future:
+        """Enqueue one decode step for a session; the future resolves to
+        the tuple of per-output ``[T, ...]`` arrays for that session's
+        rows.  ``xs`` is one ``[T, ...]`` array per network input."""
+        xs = self._normalize_inputs(xs)
+        masks = self._normalize_masks(masks, xs)
+        fut = Future()
+        deadline = (None if timeout_ms is None
+                    else time.monotonic() + float(timeout_ms) / 1e3)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("DecodePool is stopped")
+            s = self._sessions.get(sid)
+            if s is None:
+                raise KeyError(f"unknown or expired decode session {sid!r}")
+            if self._dead or not self._thread.is_alive():
+                self._dead = False
+                self.restarts += 1
+                self._thread = self._spawn_thread()
+            p = _PendingStep(s, xs, masks, fut, deadline,
+                             tenant if tenant is not None else s.tenant)
+            self._queue.append(p)
+            self._cond.notify_all()
+        return fut
+
+    def step(self, sid: str, xs, masks=None, timeout: Optional[float] = 60.0,
+             timeout_ms: Optional[float] = None,
+             tenant: Optional[str] = None):
+        """Blocking convenience wrapper around :meth:`submit_step`."""
+        return self.submit_step(sid, xs, masks, timeout_ms=timeout_ms,
+                                tenant=tenant).result(timeout)
+
+    def _normalize_inputs(self, xs) -> Tuple[np.ndarray, ...]:
+        """Per-input ``[T, C]`` chunk arrays.  Single-input models take
+        the array itself (a 1-D vector is one timestep); multi-input
+        graphs take one array per network input."""
+        if self.n_inputs == 1:
+            arrs = [xs]
+        else:
+            if not isinstance(xs, (list, tuple)) \
+                    or len(xs) != self.n_inputs:
+                raise ValueError(f"decode step needs {self.n_inputs} "
+                                 "input arrays (one per network input)")
+            arrs = list(xs)
+        out = []
+        for a in arrs:
+            a = np.asarray(a, np.float32)
+            if a.ndim == 1:   # a single timestep's feature vector
+                a = a[None]
+            out.append(a)
+        return tuple(out)
+
+    def _normalize_masks(self, masks, xs) -> Tuple[Optional[np.ndarray], ...]:
+        if masks is None:
+            return tuple(None for _ in xs)
+        ms = [masks] if self.n_inputs == 1 else list(masks)
+        if len(ms) != len(xs):
+            raise ValueError("one mask (or None) per network input")
+        return tuple(None if m is None else np.asarray(m, np.float32).ravel()
+                     for m in ms)
+
+    def queue_rows(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def queue_rows_by_tenant(self) -> Dict[str, int]:
+        with self._cond:
+            out: Dict[str, int] = {}
+            for p in self._queue:
+                t = p.tenant or "-"
+                out[t] = out.get(t, 0) + 1
+            return out
+
+    @property
+    def thread_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        with self._cond:
+            leftovers, self._queue = self._queue, []
+            sids = list(self._sessions)
+            for sid in sids:
+                self._close_locked(sid, reason="shutdown")
+        for p in leftovers:
+            if not p.future.done():
+                p.future.set_exception(RuntimeError("DecodePool stopped"))
+
+    def stats(self) -> dict:
+        with self._cond:
+            sessions = {sid: {"slot": s.slot, "tenant": s.tenant,
+                              "steps": s.steps,
+                              "idle_s": round(time.monotonic() -
+                                              s.last_used, 3)}
+                        for sid, s in self._sessions.items()}
+            free = len(self._free)
+            queued = len(self._queue)
+        out = {
+            "slots": self.max_slots,
+            "slots_free": free,
+            "slot_ladder": list(self._ladder),
+            "ttl_s": self.ttl_s,
+            "queued_steps": queued,
+            "deaths": self.deaths,
+            "restarts": self.restarts,
+            "sessions": sessions,
+            **self.metrics.snapshot(),
+        }
+        tel = getattr(self.model, "compile_telemetry", None)
+        if tel is not None:
+            out["decode_programs"] = tel.snapshot()["by_kind"].get(
+                "decode_step", 0)
+        return out
+
+    # ------------------------------------------------------------------
+    # Warmup
+    # ------------------------------------------------------------------
+    def warmup(self, feature_tails, t_steps: int = 1,
+               dtype=np.float32) -> dict:
+        """Pre-compile the decode program for every slot-ladder rung so
+        first sessions never pay a cold XLA compile.  Warmup steps ride
+        the normal batcher queue on synthetic scratch-slot sessions
+        (slot = the scratch row, ``fresh`` carries zeroed in-trace), so
+        no real session state is touched and no dispatch races the
+        batcher thread.  ``feature_tails`` is one per-example ``(T, C)``
+        tail per input (a bare tail is broadcast); ``t_steps`` warms
+        that chunk length's time bucket."""
+        tails = self._broadcast_tails(feature_tails, t_steps)
+        xs = tuple(np.zeros(t, dtype) for t in tails)
+        masks = tuple(None for _ in tails)
+        t0 = time.perf_counter()
+        for rung in self._ladder:
+            futs = []
+            with self._cond:
+                if not self._running:
+                    break
+                for i in range(rung):
+                    fut = Future()
+                    s = DecodeSession(f"warmup-{rung}-{i}", self.max_slots,
+                                      None)
+                    s.started = True   # gather the (zero) scratch row
+                    self._queue.append(
+                        _PendingStep(s, xs, masks, fut, None, None))
+                    futs.append(fut)
+                self._cond.notify_all()
+            for fut in futs:
+                fut.result(timeout=600)
+        return {"slot_ladder": list(self._ladder),
+                "warmup_sec": round(time.perf_counter() - t0, 3)}
+
+    def _broadcast_tails(self, feature_tails, t_steps: int):
+        dims = list(feature_tails)
+        if not dims or not isinstance(dims[0], (tuple, list)):
+            dims = [tuple(dims)] * self.n_inputs
+        out = []
+        for t in dims:
+            t = tuple(int(d) for d in t)
+            if len(t) == 1:
+                t = (int(t_steps),) + t
+            out.append(t)
+        return out
+
+    # ------------------------------------------------------------------
+    # Batcher thread
+    # ------------------------------------------------------------------
+    def _spawn_thread(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._loop_guarded, daemon=True,
+            name=f"decode-batcher:{self.name or hex(id(self))}")
+        t.start()
+        return t
+
+    def _loop_guarded(self) -> None:
+        """Batcher body + crash handler: a ``BaseException`` escaping
+        the loop (an armed ``mode="kill"`` fault at ``decode.step``, a
+        fatal interpreter error) fails every in-flight and queued step,
+        closes every session (their device carries may be invalid — the
+        pool buffer is donated into the step) and reclaims the slots;
+        the next submit restarts the thread."""
+        try:
+            self._loop()
+        except BaseException as e:
+            log.error("decode batcher %r thread died: %s: %s",
+                      self.name, type(e).__name__, e)
+        finally:
+            with self._cond:
+                died = self._running   # normal stop() exits are not deaths
+                stranded = self._inflight + self._queue
+                self._inflight = []
+                if died:
+                    self._queue = []
+                    self.deaths += 1
+                    self._dead = True
+                    self._pool = None
+                    self._step_jit = None
+                    for sid in list(self._sessions):
+                        self._close_locked(sid, reason="batcher_died")
+            if died:
+                for p in stranded:
+                    if not p.future.done():
+                        p.future.set_exception(RuntimeError(
+                            "decode batcher thread died; session state "
+                            "lost — reopen the session and replay"))
+
+    def _loop(self) -> None:
+        while True:
+            taken = self._take_batch()
+            if not taken:
+                if not self._running:
+                    return
+                with self._cond:
+                    self._sweep_locked()
+                continue
+            taken = self._shed_expired(taken)
+            if not taken:
+                continue
+            groups: Dict[Tuple, List[_PendingStep]] = {}
+            for p in taken:
+                key = tuple(a.shape for a in p.xs)
+                groups.setdefault(key, []).append(p)
+            for group in groups.values():
+                with self._cond:
+                    self._inflight = list(group)
+                self._dispatch(group)
+                with self._cond:
+                    self._inflight = []
+
+    def _take_batch(self) -> List[_PendingStep]:
+        """Drain at most ONE pending step per session (a session's steps
+        are a sequential stream — two steps of the same stream in one
+        gather/scatter would collide on its slot), leaving the rest
+        queued in order.  With ``min_batch > 1`` the drain waits up to
+        ``max_wait_s`` for more sessions to join."""
+        with self._cond:
+            while self._running and not self._queue:
+                self._cond.wait(0.1)
+                self._sweep_locked()   # idle servers still expire TTLs
+            if not self._queue:
+                return []
+            deadline = time.perf_counter() + self.max_wait_s
+            while True:
+                taken: List[_PendingStep] = []
+                seen = set()
+                rest: List[_PendingStep] = []
+                for p in self._queue:
+                    sid = p.session.sid
+                    if sid in seen or len(taken) >= self.max_slots:
+                        rest.append(p)
+                    else:
+                        seen.add(sid)
+                        taken.append(p)
+                if len(taken) >= self.min_batch or not self._running:
+                    self._queue = rest
+                    return taken
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    self._queue = rest
+                    return taken
+                self._cond.wait(remaining)
+
+    def _shed_expired(self, taken):
+        now = time.monotonic()
+        keep = []
+        for p in taken:
+            if p.deadline is not None and now >= p.deadline:
+                self.metrics.record_shed("deadline")
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceededError(
+                        "decode step deadline expired while queued "
+                        f"({(now - p.deadline) * 1e3:.1f} ms past budget)"))
+            else:
+                keep.append(p)
+        return keep
+
+    # ------------------------------------------------------------------
+    # The dispatch: gather → step → scatter, one jitted call
+    # ------------------------------------------------------------------
+    def _ensure_device_state(self, tails, dtype) -> None:
+        if self._pool is not None:
+            return
+        n = self.max_slots + 1   # + scratch row for ladder padding
+        if self._is_graph:
+            tmpl = self.model.rnn_carry_template(
+                n, feature_tails=tails, dtype=dtype)
+        else:
+            tmpl = self.model.rnn_carry_template(
+                n, feature_tail=tails[0], dtype=dtype)
+        self._pool = tmpl
+        self._tails = tuple(tuple(t[1:]) for t in tails)
+        self._step_jit = jax.jit(  # dl4j: noqa[DL4J104] one jit per pool over a fixed is_graph, cached in self._step_jit for the pool's lifetime
+            _pool_step_raw(self.model, self._is_graph),
+            donate_argnums=(2,))
+
+    def _base_state(self):
+        st = self.model.net_state
+        if self._is_graph:
+            return {n: {k: v for k, v in s.items() if k != "rnn_state"}
+                    for n, s in st.items()}
+        return [{k: v for k, v in s.items() if k != "rnn_state"}
+                for s in st]
+
+    def _dispatch(self, group: List[_PendingStep]) -> None:
+        t_dispatch = time.perf_counter()
+        compute_entered = False
+        try:
+            faults.check("decode.step")
+            g = self.model.conf.global_conf
+            K = len(group)
+            Kb = bucketing.bucket_size(K, self._ladder)
+            scratch = self.max_slots
+            tails = [tuple(a.shape) for a in group[0].xs]
+            feat_tails = tuple(tuple(t[1:]) for t in tails)
+            if self._tails is not None and feat_tails != self._tails:
+                raise ValueError(
+                    f"decode feature shape {feat_tails} != the pool's "
+                    f"{self._tails} (one pool serves one input layout)")
+            with monitor.span("serve/decode", phase="gather_pad"):
+                self._ensure_device_state(tails, group[0].xs[0].dtype)
+                idx = np.full((Kb,), scratch, np.int32)
+                # pad rows run fresh (zero carries): the scratch row's
+                # contents never feed a computation
+                fresh = np.ones((Kb,), np.float32)
+                xs_h, fms_h, pairs = [], [], []
+                for i, tail in enumerate(tails):
+                    seq = len(tail) >= 2
+                    T = int(tail[0])
+                    Tb = (bucketing.bucket_size(T, g.bucket_time_sizes)
+                          if seq else T)
+                    pairs.append((T, Tb))
+                    x = np.zeros((Kb, Tb) + tuple(tail[1:]), np.float32)
+                    fm = np.zeros((Kb, Tb), np.float32) if seq else None
+                    for r, p in enumerate(group):
+                        x[r, :T] = p.xs[i]
+                        if fm is not None:
+                            fm[r, :T] = (1.0 if p.masks[i] is None
+                                         else p.masks[i][:T])
+                    xs_h.append(x)
+                    fms_h.append(fm)
+                for r, p in enumerate(group):
+                    idx[r] = p.session.slot
+                    fresh[r] = 0.0 if p.session.started else 1.0
+                # explicit H2D before the guarded call (sanitizer
+                # transfer-guard contract)
+                idx_d = jnp.asarray(idx)
+                fresh_d = jnp.asarray(fresh)
+                xs_d = tuple(jnp.asarray(x) for x in xs_h)
+                fms_d = tuple(None if m is None else jnp.asarray(m)
+                              for m in fms_h)
+            tel = getattr(self.model, "compile_telemetry", None)
+            compiling = False
+            if tel is not None:
+                compiling = tel.record("decode_step",
+                                       (idx_d, fresh_d, xs_d, fms_d))
+            t0 = time.perf_counter()
+            compute_entered = True
+            with monitor.span("serve/decode", phase="compute"), \
+                    sanitizer.guard_step(compiling=compiling):
+                outs, self._pool = self._step_jit(
+                    self.model.net_params, self._base_state(), self._pool,
+                    idx_d, fresh_d, xs_d, fms_d)
+                outs = tuple(np.asarray(jax.device_get(o)) for o in outs)
+            t1 = time.perf_counter()
+            T = next((t for t, _ in pairs), 1)
+            sliced = []
+            for o in outs:
+                o = o[:K]
+                for t, tb in pairs:   # mirror _unpad_graph_output
+                    if tb != t and o.ndim >= 3 and o.shape[1] == tb:
+                        o = o[:, :t]
+                        break
+                sliced.append(o)
+            now = time.monotonic()
+            for r, p in enumerate(group):
+                p.session.started = True
+                p.session.steps += 1
+                p.session.last_used = now
+                p.future.set_result(tuple(o[r] for o in sliced))
+                self.metrics.record_step(p.tenant)
+                self.metrics.h_queue.observe(t_dispatch - p.t_enqueue)
+                self.metrics.h_step.observe(t1 - t0)
+            self.metrics.record_batch(K, K * T)
+        except Exception as e:
+            for p in group:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            if compute_entered:
+                # the pool buffer was donated into a call that failed —
+                # its contents are unreliable.  Fail CLOSED: drop the
+                # device state and every session holding carries in it.
+                with self._cond:
+                    self._pool = None
+                    self._step_jit = None
+                    for sid in list(self._sessions):
+                        self._close_locked(sid, reason="error")
+
+
+class DecodeManager:
+    """Gateway-facing orchestration: session ids → per-model
+    :class:`DecodePool`\\ s, sharing the gateway's :class:`ModelCache`.
+
+    A blue/green model flip (``server/model_cache.py``) does not disturb
+    a pool with live sessions — their carries were computed under the
+    old weights; the pool adopts the new model instance once it has
+    drained to zero sessions."""
+
+    def __init__(self, model_cache, max_slots: int = 32,
+                 ttl_s: float = 600.0, max_wait_ms: float = 2.0,
+                 min_batch: int = 1, retry_after_s: float = 1.0):
+        self.model_cache = model_cache
+        self.max_slots = max(1, int(max_slots))
+        self.ttl_s = float(ttl_s)
+        self.max_wait_ms = float(max_wait_ms)
+        self.min_batch = int(min_batch)
+        self.retry_after_s = float(retry_after_s)
+        self._lock = threading.Lock()
+        self._pools: Dict[str, DecodePool] = {}
+        self._by_sid: Dict[str, DecodePool] = {}
+
+    def _pool_for(self, model_path: str) -> DecodePool:
+        import os
+        key = os.path.abspath(str(model_path))
+        model = self.model_cache.get(key)
+        retired = None
+        with self._lock:
+            pool = self._pools.get(key)
+            if pool is not None and pool.model is not model \
+                    and pool.active_sessions == 0 and pool.queue_rows() == 0:
+                # rolled-out model: adopt the new instance once drained
+                retired = pool
+                pool = None
+            if pool is None:
+                pool = DecodePool(
+                    model, name=os.path.basename(key),
+                    max_slots=self.max_slots, ttl_s=self.ttl_s,
+                    max_wait_ms=self.max_wait_ms, min_batch=self.min_batch)
+                self._pools[key] = pool
+        if retired is not None:
+            retired.stop(timeout=5.0)
+        return pool
+
+    def open_session(self, model_path: str,
+                     tenant: Optional[str] = None) -> dict:
+        pool = self._pool_for(model_path)
+        sid = pool.open_session(tenant=tenant,
+                                retry_after_s=self.retry_after_s)
+        with self._lock:
+            self._by_sid[sid] = pool
+        return {"session_id": sid, "model": pool.name,
+                "slots": pool.max_slots,
+                "slots_free": pool.max_slots - pool.active_sessions}
+
+    def _pool_of(self, session_id: str) -> DecodePool:
+        with self._lock:
+            pool = self._by_sid.get(session_id)
+        if pool is None:
+            raise KeyError(
+                f"unknown or expired decode session {session_id!r}")
+        return pool
+
+    def decode_step(self, session_id: str, x, mask=None,
+                    timeout_ms: Optional[float] = None,
+                    tenant: Optional[str] = None,
+                    timeout: Optional[float] = 60.0):
+        pool = self._pool_of(session_id)
+        try:
+            return pool.step(session_id, x, masks=mask, timeout=timeout,
+                             timeout_ms=timeout_ms, tenant=tenant)
+        except KeyError:
+            with self._lock:
+                self._by_sid.pop(session_id, None)
+            raise
+
+    def close_session(self, session_id: str) -> bool:
+        with self._lock:
+            pool = self._by_sid.pop(session_id, None)
+        if pool is None:
+            return False
+        return pool.close_session(session_id)
+
+    def queue_rows(self) -> int:
+        with self._lock:
+            pools = list(self._pools.values())
+        return sum(p.queue_rows() for p in pools)
+
+    def queue_rows_by_tenant(self) -> Dict[str, int]:
+        with self._lock:
+            pools = list(self._pools.values())
+        out: Dict[str, int] = {}
+        for p in pools:
+            for t, n in p.queue_rows_by_tenant().items():
+                out[t] = out.get(t, 0) + n
+        return out
+
+    def batchers_alive(self) -> bool:
+        with self._lock:
+            pools = [p for p in self._pools.values()
+                     if p.active_sessions > 0 or p.queue_rows() > 0]
+        return all(p.thread_alive for p in pools)
+
+    def sweep(self) -> int:
+        with self._lock:
+            pools = list(self._pools.values())
+        n = sum(p.sweep() for p in pools)
+        self._gc_sids()
+        return n
+
+    def _gc_sids(self) -> None:
+        with self._lock:
+            live = {sid for sid, pool in self._by_sid.items()
+                    if sid in pool.session_ids()}
+            self._by_sid = {sid: p for sid, p in self._by_sid.items()
+                            if sid in live}
+
+    def stats(self) -> dict:
+        with self._lock:
+            items = list(self._pools.items())
+        return {key: pool.stats() for key, pool in items}
+
+    def invalidate(self, model_path: Optional[str] = None) -> int:
+        """Stop pool(s) — sessions fail, slots free (the cache-
+        invalidation RPC semantics)."""
+        import os
+        with self._lock:
+            if model_path is None:
+                dropped = list(self._pools.values())
+                self._pools.clear()
+            else:
+                key = os.path.abspath(str(model_path))
+                p = self._pools.pop(key, None)
+                dropped = [p] if p is not None else []
+            self._by_sid = {sid: p for sid, p in self._by_sid.items()
+                            if p not in dropped}
+        for p in dropped:
+            p.stop(timeout=5.0)
+        return len(dropped)
+
+    def close(self) -> None:
+        self.invalidate(None)

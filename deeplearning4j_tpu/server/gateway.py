@@ -71,13 +71,18 @@ class DeepLearning4jEntryPoint:
                  max_batch: int = 32, max_wait_ms: float = 5.0,
                  min_batch: int = 1, coalesce: bool = True,
                  max_queue_rows: int = 1024, retry_after_s: float = 1.0,
-                 min_ready_models: int = 0):
+                 min_ready_models: int = 0,
+                 tenant_quota_rows: Optional[int] = None,
+                 decode_slots: int = 32, decode_ttl_s: float = 600.0,
+                 decode_max_wait_ms: float = 2.0,
+                 blue_green: bool = False):
         if model_cache is None:
             model_cache = ModelCache(
                 load_retry=RetryPolicy(max_attempts=3, base_delay_ms=25,
                                        name="cache.load"),
                 load_breaker=CircuitBreaker(cooldown_s=10.0,
-                                            name="cache.load"))
+                                            name="cache.load"),
+                blue_green=blue_green)
         self.model_cache = model_cache
         self.max_batch = max(1, int(max_batch))
         self.max_wait_ms = float(max_wait_ms)
@@ -86,6 +91,15 @@ class DeepLearning4jEntryPoint:
         self.max_queue_rows = max(1, int(max_queue_rows))
         self.retry_after_s = max(0.0, float(retry_after_s))
         self.min_ready_models = max(0, int(min_ready_models))
+        # per-tenant fair share: one tenant may hold at most this many
+        # queued rows (predict + decode) — None disables the per-tenant
+        # check, the global max_queue_rows bound always applies
+        self.tenant_quota_rows = (None if tenant_quota_rows is None
+                                  else max(1, int(tenant_quota_rows)))
+        from deeplearning4j_tpu.server.decode import DecodeManager
+        self.decode = DecodeManager(
+            self.model_cache, max_slots=decode_slots, ttl_s=decode_ttl_s,
+            max_wait_ms=decode_max_wait_ms, retry_after_s=self.retry_after_s)
         self._t_start = time.time()
         self._batchers: dict = {}
         self._batcher_lock = threading.Lock()
@@ -164,7 +178,8 @@ class DeepLearning4jEntryPoint:
                 features=None, top_k: Optional[int] = None,
                 argmax_only: bool = False,
                 coalesce: Optional[bool] = None,
-                deadline_ms: Optional[float] = None) -> dict:
+                deadline_ms: Optional[float] = None,
+                tenant: Optional[str] = None) -> dict:
         """Run inference with the cached, bucket-warmed model.
 
         Exactly one input source: ``data_dir`` (exported minibatch
@@ -196,13 +211,13 @@ class DeepLearning4jEntryPoint:
             if use_batcher:
                 # admission BEFORE the (possibly breaker-guarded) model
                 # load: an overloaded server sheds cheap and early
-                self._admit(len(x))
+                self._admit(len(x), tenant=tenant)
             model = self.model_cache.get(
                 model_path, warmup_dims=tuple(x.shape[1:]),
                 max_batch=self.max_batch)
             if use_batcher:
                 out = self._batcher_for(model_path, model).predict(
-                    x, timeout_ms=deadline_ms)
+                    x, timeout_ms=deadline_ms, tenant=tenant)
             else:
                 out = self._infer_fn(model)(x)
             return self._format_predictions(out, top_k, argmax_only)
@@ -230,10 +245,12 @@ class DeepLearning4jEntryPoint:
             feature_dims, max_batch=int(max_batch or self.max_batch))
 
     def invalidate(self, model_path: Optional[str] = None) -> dict:
-        """Drop cached model(s) — and their batchers — so the next
-        request reloads from disk (explicit cache-invalidation RPC; a
-        changed file mtime invalidates implicitly)."""
+        """Drop cached model(s) — and their batchers and decode pools
+        (open sessions fail) — so the next request reloads from disk
+        (explicit cache-invalidation RPC; a changed file mtime
+        invalidates implicitly)."""
         n = self.model_cache.invalidate(model_path)
+        self.decode.invalidate(model_path)
         with self._batcher_lock:
             keys = ([os.path.abspath(str(model_path))]
                     if model_path is not None else list(self._batchers))
@@ -244,23 +261,92 @@ class DeepLearning4jEntryPoint:
         return {"invalidated": n}
 
     # ------------------------------------------------------------------
+    # Stateful decode sessions (server/decode.py — ROADMAP 3b)
+    # ------------------------------------------------------------------
+    def open_session(self, model_path: str,
+                     tenant: Optional[str] = None) -> dict:
+        """Open a stateful decode session: the model's recurrent carry
+        for this stream lives on device in the model's slot pool, so
+        every subsequent :meth:`decode_step` is O(1) in how much of the
+        stream has already been consumed.  503 + Retry-After when every
+        slot is held by a live session."""
+        return self.decode.open_session(model_path, tenant=tenant)
+
+    def decode_step(self, session_id: str, features,
+                    mask=None, tenant: Optional[str] = None,
+                    deadline_ms: Optional[float] = None,
+                    top_k: Optional[int] = None,
+                    argmax_only: bool = False) -> dict:
+        """Feed one ``[T, C]`` chunk (``T=1`` token-by-token; longer
+        chunks are the prefill path) to a session and return the
+        ``[T, ...]`` outputs.  Concurrent sessions' steps coalesce into
+        one jitted slot-pool dispatch (continuous batching); admission
+        control and per-tenant fair share apply exactly as for
+        ``predict`` (one step = one queue row, matching the decode
+        queue's accounting)."""
+        self._admit(1, tenant=tenant)
+        outs = self.decode.decode_step(
+            session_id, features, mask=mask, timeout_ms=deadline_ms,
+            tenant=tenant)
+        result = self._format_predictions(outs[0], top_k, argmax_only)
+        if len(outs) > 1:
+            result["outputs"] = [np.asarray(o).tolist() for o in outs]
+        result["session_id"] = session_id
+        return result
+
+    def close_session(self, session_id: str) -> dict:
+        """Release a decode session's slot (its device carry is
+        reclaimed for the next session)."""
+        return {"closed": self.decode.close_session(session_id)}
+
+    def decode_stats(self) -> dict:
+        """Per-model decode-pool observability: slots, sessions, step
+        counts, the continuous-batching histogram and the bounded
+        compiled-program count."""
+        return self.decode.stats()
+
+    # ------------------------------------------------------------------
     # Health / readiness (docs/RESILIENCE.md)
     # ------------------------------------------------------------------
-    def _admit(self, n_rows: int) -> None:
+    def _admit(self, n_rows: int, tenant: Optional[str] = None) -> None:
         """Bounded-queue admission control: reject (don't queue) when
-        the rows already waiting across batchers plus this request
-        exceed ``max_queue_rows``."""
+        the rows already waiting across batchers and decode pools plus
+        this request exceed ``max_queue_rows`` — and, with
+        ``tenant_quota_rows`` set, when THIS tenant's queued rows would
+        exceed its fair share (one tenant flooding the queue gets 503 +
+        Retry-After while everyone else keeps being served)."""
         depth = self._queued_rows()
         if depth + n_rows > self.max_queue_rows:
             self._c_shed.labels(reason="queue_full").inc()
             raise OverloadedError(
                 f"queue full ({depth} rows waiting, limit "
                 f"{self.max_queue_rows})", retry_after_s=self.retry_after_s)
+        if self.tenant_quota_rows is not None:
+            t = tenant or "-"
+            held = self._tenant_queued_rows().get(t, 0)
+            if held + n_rows > self.tenant_quota_rows:
+                self._c_shed.labels(reason="tenant_quota").inc()
+                raise OverloadedError(
+                    f"tenant {t!r} over fair-share quota ({held} rows "
+                    f"queued, limit {self.tenant_quota_rows})",
+                    retry_after_s=self.retry_after_s)
 
     def _queued_rows(self) -> int:
         with self._batcher_lock:
             batchers = [b for _, b in self._batchers.values()]
-        return sum(b.queue_rows() for b in batchers)
+        return sum(b.queue_rows() for b in batchers) \
+            + self.decode.queue_rows()
+
+    def _tenant_queued_rows(self) -> dict:
+        with self._batcher_lock:
+            batchers = [b for _, b in self._batchers.values()]
+        out: dict = {}
+        for b in batchers:
+            for t, n in b.queue_rows_by_tenant().items():
+                out[t] = out.get(t, 0) + n
+        for t, n in self.decode.queue_rows_by_tenant().items():
+            out[t] = out.get(t, 0) + n
+        return out
 
     def healthz(self) -> dict:
         """Liveness: the process is up and the RPC loop answers.  Stays
@@ -284,6 +370,10 @@ class DeepLearning4jEntryPoint:
                    if m.get("warmup") is not None)
         checks = {
             "batchers_alive": all(b.thread_alive for _, b in batchers),
+            # decode pools with live sessions must have a live dispatch
+            # thread too — a dead decode batcher strands every open
+            # session, which is exactly what an LB should drain over
+            "decode_alive": self.decode.batchers_alive(),
             "queue_below_limit": queued < self.max_queue_rows,
             "breaker_closed": (breaker is None
                                or breaker.state != CircuitBreaker.OPEN),
@@ -312,6 +402,7 @@ class DeepLearning4jEntryPoint:
             if tel is not None:
                 s["compile_telemetry"] = tel.snapshot()
             out["serving"][key] = s
+        out["decode"] = self.decode.stats()
         out["registry"] = monitor.get_registry().snapshot()
         return out
 
@@ -332,12 +423,14 @@ class DeepLearning4jEntryPoint:
                 "body": monitor.render_prometheus(snap)}
 
     def close(self) -> None:
-        """Stop all batcher threads (server shutdown)."""
+        """Stop all batcher threads and decode pools (server
+        shutdown; open decode sessions fail cleanly)."""
         with self._batcher_lock:
             dropped = list(self._batchers.values())
             self._batchers.clear()
         for _, batcher in dropped:
             batcher.stop()
+        self.decode.close()
 
     # ------------------------------------------------------------------
     # Internals

@@ -51,24 +51,38 @@ class ModelCache:
 
     def __init__(self, capacity: int = 4,
                  loader: Optional[Callable] = None,
-                 load_retry=None, load_breaker=None):
+                 load_retry=None, load_breaker=None,
+                 blue_green: bool = False):
         """``load_retry`` (a ``resilience.RetryPolicy``) retries
         transient load failures; ``load_breaker`` (a
         ``resilience.CircuitBreaker``) fails fast once loads keep
         failing, so a broken checkpoint path can't pile threads up
         behind the cache lock.  Both default to off; the serving
         gateway arms them on its cache (``/readyz`` reports the breaker
-        state)."""
+        state).
+
+        ``blue_green=True`` turns a stale-mtime reload into a ROLLOUT
+        (ROADMAP 3c): the old version keeps serving while a background
+        thread loads the republished checkpoint and jit-warms it
+        through ``warmup_inference`` (reusing the dims the old entry
+        was warmed with), then the entry flips atomically — no request
+        ever blocks on the new version's load/compile, and ``readyz``
+        stays ready throughout because the old model remains resident
+        and warm."""
         self.capacity = max(1, int(capacity))
         self._loader = loader or default_loader
         self.load_retry = load_retry
         self.load_breaker = load_breaker
+        self.blue_green = bool(blue_green)
         self._lock = threading.RLock()
         self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._rollouts: dict = {}   # key → {"mtime": target mtime_ns}
         self.hits = 0
         self.misses = 0
         self.stale_reloads = 0
         self.evictions = 0
+        self.rollouts = 0
+        self.rollout_failures = 0
         # mirrored into the process registry (aggregated over caches) so
         # hit rates land in the same /metrics scrape as latencies
         reg = monitor.get_registry()
@@ -76,6 +90,16 @@ class ModelCache:
             k: reg.counter(f"dl4j_model_cache_{k}_total",
                            f"model cache {k.replace('_', ' ')}")
             for k in ("hits", "misses", "stale_reloads", "evictions")}
+        self._c_rollouts = reg.counter(
+            "dl4j_model_cache_rollouts_total",
+            "blue/green model version flips completed")
+        self._c_rollout_failures = reg.counter(
+            "dl4j_model_cache_rollout_failures_total",
+            "background rollout loads/warms that failed "
+            "(the old version kept serving)")
+        self._g_warming = reg.gauge(
+            "dl4j_model_cache_warming",
+            "blue/green background warms in flight")
         self._g_resident = reg.gauge("dl4j_model_cache_resident",
                                      "models resident across caches")
 
@@ -99,9 +123,15 @@ class ModelCache:
         with self._lock:
             e = self._entries.get(key)
             if e is not None and e["mtime"] != mtime:
-                self._count("stale_reloads")
-                del self._entries[key]
-                e = None
+                if self.blue_green:
+                    # rollout: OLD keeps serving; the new version loads
+                    # and warms on a background thread and flips when
+                    # ready (idempotent while one warm is in flight)
+                    self._start_rollout_locked(key, mtime, shape_bucketing)
+                else:
+                    self._count("stale_reloads")
+                    del self._entries[key]
+                    e = None
             if e is not None:
                 self._count("hits")
                 self._entries.move_to_end(key)
@@ -122,7 +152,61 @@ class ModelCache:
                     and hasattr(e["model"], "warmup_inference"):
                 e["warmup"] = e["model"].warmup_inference(
                     warmup_dims, max_batch=max_batch)
+                # remembered so a blue/green warm can replay the same
+                # serving ladder on the replacement version
+                e["warmup_dims"] = tuple(warmup_dims)
+                e["warmup_max_batch"] = int(max_batch)
             return e["model"]
+
+    def _start_rollout_locked(self, key: str, mtime: int,
+                              shape_bucketing) -> None:
+        roll = self._rollouts.get(key)
+        if roll is not None and roll.get("mtime") == mtime:
+            return   # this version is already warming
+        self._rollouts[key] = {"mtime": mtime, "started_at": time.time()}
+        self._g_warming.set(len(self._rollouts))
+        old = self._entries.get(key) or {}
+        warm_dims = old.get("warmup_dims")
+        warm_mb = old.get("warmup_max_batch", 32)
+        t = threading.Thread(
+            target=self._rollout, daemon=True,
+            name=f"model-rollout:{os.path.basename(key)}",
+            args=(key, mtime, shape_bucketing, warm_dims, warm_mb))
+        t.start()
+
+    def _rollout(self, key, mtime, shape_bucketing, warm_dims, warm_mb):
+        """Background leg of a blue/green flip: load + warm OUTSIDE the
+        cache lock (requests keep hitting the old entry), then swap the
+        entry atomically.  Failure keeps the old version serving and
+        counts ``dl4j_model_cache_rollout_failures_total``."""
+        try:
+            model = self._load(key)
+            if shape_bucketing is not None:
+                model.conf.global_conf.shape_bucketing = \
+                    bool(shape_bucketing)
+            warm = None
+            if warm_dims is not None and hasattr(model, "warmup_inference"):
+                warm = model.warmup_inference(warm_dims, max_batch=warm_mb)
+            new_mtime = os.stat(key).st_mtime_ns
+            with self._lock:
+                e = {"mtime": new_mtime, "model": model, "warmup": warm,
+                     "loaded_at": time.time()}
+                if warm_dims is not None:
+                    e["warmup_dims"] = tuple(warm_dims)
+                    e["warmup_max_batch"] = int(warm_mb)
+                self._entries[key] = e
+                self._entries.move_to_end(key)
+                self._count("stale_reloads")
+                self.rollouts += 1
+            self._c_rollouts.inc()
+        except Exception:
+            with self._lock:
+                self.rollout_failures += 1
+            self._c_rollout_failures.inc()
+        finally:
+            with self._lock:
+                self._rollouts.pop(key, None)
+                self._g_warming.set(len(self._rollouts))
 
     def _load(self, key: str):
         """One checkpoint load through the resilience stack: the
@@ -176,7 +260,8 @@ class ModelCache:
             models = {
                 k: {"mtime_ns": e["mtime"],
                     "loaded_at": e["loaded_at"],
-                    "warmup": e["warmup"]}
+                    "warmup": e["warmup"],
+                    "warming": k in self._rollouts}
                 for k, e in self._entries.items()
             }
             out = {
@@ -186,6 +271,9 @@ class ModelCache:
                 "misses": self.misses,
                 "stale_reloads": self.stale_reloads,
                 "evictions": self.evictions,
+                "rollouts": self.rollouts,
+                "rollout_failures": self.rollout_failures,
+                "warming": len(self._rollouts),
                 "models": models,
             }
         if self.load_breaker is not None:

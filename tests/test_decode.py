@@ -1,0 +1,740 @@
+"""Stateful continuous-batching decode (server/decode.py, ROADMAP 3b)
+and sharded serving (3a): slot-pool session parity against full-sequence
+``output()`` (MLN and CG, masks + bucketing), the compiled-carry
+``rnn_time_step`` seam, session TTL / slot exhaustion / batcher-kill
+resilience, gateway decode RPCs + per-tenant fair share, blue/green
+model rollout, and pjit-sharded inference parity with a subprocess
+single-device degrade."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.network import (GlobalConf,
+                                                NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.serialization import write_model
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import OverloadedError
+from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+from deeplearning4j_tpu.server.decode import DecodeManager, DecodePool
+from deeplearning4j_tpu.server.model_cache import ModelCache
+
+F, H, C = 5, 12, 4
+
+
+def _lstm_mln(seed=7, bucketing=True):
+    b = NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+    if bucketing:
+        b.shape_bucketing(True)
+    conf = (b.list()
+            .layer(L.GravesLSTM(n_in=F, n_out=H, activation="tanh"))
+            .layer(L.RnnOutputLayer(n_in=H, n_out=C, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lstm_cg(seed=9, bucketing=True):
+    g = GlobalConf(seed=seed, learning_rate=0.05, weight_init="xavier",
+                   shape_bucketing=bool(bucketing))
+    b = (GraphBuilder(g)
+         .add_inputs("in")
+         .add_layer("lstm", L.GravesLSTM(n_in=F, n_out=H,
+                                         activation="tanh"), "in")
+         .add_layer("out", L.RnnOutputLayer(n_in=H, n_out=C,
+                                            activation="softmax",
+                                            loss="mcxent"), "lstm")
+         .set_outputs("out"))
+    return ComputationGraph(b.build()).init()
+
+
+def _seq(n, t, seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(n, t, F)).astype(np.float32)
+
+
+def _counter(name, **labels):
+    fam = monitor.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    for s in fam.samples():
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# Decode-pool parity: session decode == full-sequence output()
+# ---------------------------------------------------------------------------
+def test_mln_decode_parity_token_by_token():
+    net = _lstm_mln()
+    T = 9
+    x = _seq(2, T, seed=1)
+    full = np.asarray(net.output(x))
+    pool = DecodePool(net, max_slots=4, max_wait_ms=0.5)
+    try:
+        sids = [pool.open_session() for _ in range(2)]
+        outs = {0: [], 1: []}
+        for t in range(T):
+            for i, sid in enumerate(sids):
+                (o,) = pool.step(sid, x[i, t:t + 1])
+                outs[i].append(o)
+        for i in range(2):
+            got = np.concatenate(outs[i], axis=0)
+            np.testing.assert_allclose(got, full[i], atol=1e-5, rtol=1e-4)
+        st = pool.stats()
+        assert st["decode_programs"] <= len(st["slot_ladder"])
+    finally:
+        pool.stop()
+
+
+def test_mln_decode_parity_chunks_and_masks():
+    """Prefill chunks (T=3, padded to the time bucket with masked pad
+    steps) mixed with single-token steps, under a real per-step mask —
+    masked steps must carry state through unchanged, matching the
+    full-sequence masked output at every unmasked position."""
+    net = _lstm_mln()
+    T = 8
+    x = _seq(1, T, seed=2)
+    mask = np.ones((1, T), np.float32)
+    mask[0, 5:] = 0.0   # tail masked out
+    full = np.asarray(net.output(x, mask))
+    pool = DecodePool(net, max_slots=2, max_wait_ms=0.5)
+    try:
+        sid = pool.open_session()
+        got = []
+        (o,) = pool.step(sid, x[0, :3], masks=mask[0, :3])   # prefill chunk
+        got.append(o)
+        for t in range(3, T):
+            (o,) = pool.step(sid, x[0, t:t + 1], masks=mask[0, t:t + 1])
+            got.append(o)
+        got = np.concatenate(got, axis=0)
+        np.testing.assert_allclose(got[:5], full[0, :5], atol=1e-5,
+                                   rtol=1e-4)
+    finally:
+        pool.stop()
+
+
+def test_cg_decode_parity_token_by_token():
+    net = _lstm_cg()
+    T = 7
+    x = _seq(2, T, seed=3)
+    (full,) = net.output(x)
+    full = np.asarray(full)
+    pool = DecodePool(net, max_slots=4, max_wait_ms=0.5)
+    try:
+        sids = [pool.open_session() for _ in range(2)]
+        outs = {0: [], 1: []}
+        for t in range(T):
+            for i, sid in enumerate(sids):
+                (o,) = pool.step(sid, x[i, t:t + 1])
+                outs[i].append(o)
+        for i in range(2):
+            got = np.concatenate(outs[i], axis=0)
+            np.testing.assert_allclose(got, full[i], atol=1e-5, rtol=1e-4)
+        assert pool.stats()["decode_programs"] <= \
+            len(pool.stats()["slot_ladder"])
+    finally:
+        pool.stop()
+
+
+def test_decode_continuous_batching_sessions_join_and_leave():
+    """Sessions joining and leaving between steps must not retrace past
+    the slot ladder, reuse freed slots with clean (zeroed) carries, and
+    keep every stream's numerics independent."""
+    net = _lstm_mln()
+    T = 6
+    x = _seq(3, T, seed=4)
+    full = np.asarray(net.output(x))
+    pool = DecodePool(net, max_slots=2, max_wait_ms=0.5)
+    try:
+        # stream 0 alone, then stream 1 joins, then 0 leaves, 2 joins
+        s0 = pool.open_session()
+        for t in range(2):
+            pool.step(s0, x[0, t:t + 1])
+        s1 = pool.open_session()
+        o1 = []
+        for t in range(2, 4):
+            pool.step(s0, x[0, t:t + 1])
+            (o,) = pool.step(s1, x[1, t - 2:t - 1])
+            o1.append(o)
+        pool.close_session(s0)
+        s2 = pool.open_session()   # reuses stream 0's slot
+        assert pool.active_sessions == 2
+        o2 = []
+        for t in range(T):
+            (o,) = pool.step(s2, x[2, t:t + 1])
+            o2.append(o)
+        got2 = np.concatenate(o2, axis=0)
+        # a reused slot must NOT inherit the previous session's carry
+        np.testing.assert_allclose(got2, full[2], atol=1e-5, rtol=1e-4)
+        st = pool.stats()
+        assert st["decode_programs"] <= len(st["slot_ladder"])
+    finally:
+        pool.stop()
+
+
+def test_decode_warmup_precompiles_ladder():
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=4, max_wait_ms=0.5)
+    try:
+        info = pool.warmup((1, F))
+        assert info["slot_ladder"] == list(pool._ladder)
+        warmed = pool.stats()["decode_programs"]
+        assert 1 <= warmed <= len(pool._ladder)
+        # real sessions after warmup never compile a new program
+        x = _seq(2, 4, seed=5)
+        sids = [pool.open_session() for _ in range(2)]
+        for t in range(4):
+            for i, sid in enumerate(sids):
+                pool.step(sid, x[i, t:t + 1])
+        assert pool.stats()["decode_programs"] == warmed
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# rnn_time_step: ONE compiled carried step (the shared seam)
+# ---------------------------------------------------------------------------
+def test_mln_rnn_time_step_single_trace_with_masks_and_bucketing():
+    net = _lstm_mln()
+    T = 8
+    x = _seq(2, T, seed=6)
+    mask = np.ones((2, T), np.float32)
+    mask[1, 6:] = 0.0
+    full = np.asarray(net.output(x, mask))
+    net.rnn_clear_previous_state()
+    got = np.concatenate(
+        [np.asarray(net.rnn_time_step(x[:, t:t + 1], mask[:, t:t + 1]))
+         for t in range(T)], axis=1)
+    np.testing.assert_allclose(got[0], full[0], atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(got[1, :6], full[1, :6], atol=1e-5,
+                               rtol=1e-4)
+    tel = net.compile_telemetry.snapshot()
+    # first call (template zero carry) and every later call share ONE
+    # compiled program — O(1) per token, no steady-state second trace
+    assert tel["by_kind"].get("rnn_time_step") == 1, tel["by_kind"]
+
+
+def test_cg_rnn_time_step_single_trace():
+    net = _lstm_cg()
+    T = 6
+    x = _seq(1, T, seed=7)
+    (full,) = net.output(x)
+    net.rnn_clear_previous_state()
+    got = np.concatenate(
+        [np.asarray(net.rnn_time_step(x[:, t:t + 1])[0]) for t in range(T)],
+        axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), atol=1e-5, rtol=1e-4)
+    tel = net.compile_telemetry.snapshot()
+    assert tel["by_kind"].get("rnn_time_step") == 1, tel["by_kind"]
+
+
+# ---------------------------------------------------------------------------
+# Robustness: TTL, slot exhaustion, batcher kill, deadlines
+# ---------------------------------------------------------------------------
+def test_session_ttl_eviction():
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=2, ttl_s=0.15, max_wait_ms=0.5)
+    try:
+        closed0 = _counter("dl4j_decode_sessions_closed_total",
+                           model="default", reason="ttl")
+        sid = pool.open_session()
+        pool.step(sid, _seq(1, 1, seed=8)[0])
+        deadline = time.monotonic() + 5.0
+        # the batcher thread sweeps while idle — no client call needed
+        while pool.active_sessions and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pool.active_sessions == 0
+        assert _counter("dl4j_decode_sessions_closed_total",
+                        model="default", reason="ttl") == closed0 + 1
+        with pytest.raises(KeyError):
+            pool.submit_step(sid, _seq(1, 1)[0])
+        # the slot was reclaimed
+        assert pool.open_session()
+    finally:
+        pool.stop()
+
+
+def test_slot_exhaustion_raises_overloaded():
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=2, ttl_s=600.0)
+    try:
+        pool.open_session()
+        pool.open_session()
+        with pytest.raises(OverloadedError) as ei:
+            pool.open_session(retry_after_s=3.0)
+        assert ei.value.retry_after_s == 3.0
+    finally:
+        pool.stop()
+
+
+def test_decode_batcher_kill_fails_cleanly_and_recovers():
+    """Fault site ``decode.step`` (mode=kill): in-flight sessions fail
+    with a clear error instead of hanging, every slot reclaims (the
+    donated pool buffer is unreliable after a mid-step death), and the
+    next submit restarts the thread with a fresh device pool."""
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=2, max_wait_ms=0.5)
+    try:
+        sid = pool.open_session()
+        pool.step(sid, _seq(1, 1, seed=9)[0])
+        faults.arm({"site": "decode.step", "mode": "kill",
+                    "probability": 1.0, "max_injections": 1})
+        fut = pool.submit_step(sid, _seq(1, 1, seed=10)[0])
+        with pytest.raises(RuntimeError, match="batcher thread died"):
+            fut.result(timeout=30)   # bounded: no client hang
+        assert pool.deaths == 1
+        assert pool.active_sessions == 0   # sessions closed, slots freed
+        # recovery: a fresh session steps through a restarted thread
+        sid2 = pool.open_session()
+        (o,) = pool.step(sid2, _seq(1, 1, seed=11)[0])
+        assert o.shape == (1, C)
+        assert pool.restarts == 1
+    finally:
+        faults.reset()
+        pool.stop()
+
+
+def test_decode_deadline_shed_before_compute():
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=2, max_wait_ms=0.5)
+    try:
+        sid = pool.open_session()
+        pool.step(sid, _seq(1, 1)[0])   # compile off-clock
+        faults.arm({"site": "decode.step", "mode": "latency",
+                    "latency_ms": 300, "probability": 1.0,
+                    "max_injections": 1})
+        slow = pool.submit_step(sid, _seq(1, 1)[0])
+        time.sleep(0.05)   # let the slow dispatch pick the first step up
+        fut = pool.submit_step(sid, _seq(1, 1)[0], timeout_ms=1.0)
+        from deeplearning4j_tpu.resilience.errors import (
+            DeadlineExceededError)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+        slow.result(timeout=30)   # the in-flight one still lands
+    finally:
+        faults.reset()
+        pool.stop()
+
+
+def test_decode_pool_stop_fails_queued_and_sessions():
+    net = _lstm_mln()
+    pool = DecodePool(net, max_slots=2, max_wait_ms=0.5)
+    sid = pool.open_session()
+    pool.step(sid, _seq(1, 1)[0])
+    pool.stop()
+    with pytest.raises(RuntimeError):
+        pool.submit_step(sid, _seq(1, 1)[0])
+    assert pool.active_sessions == 0
+
+
+# ---------------------------------------------------------------------------
+# Gateway RPCs: open/step/close, 503s, readyz, tenant fair share
+# ---------------------------------------------------------------------------
+def test_gateway_decode_rpcs_end_to_end(tmp_path):
+    path = str(tmp_path / "lstm.zip")
+    write_model(_lstm_mln(), path)
+    ref = _lstm_mln()
+    ep = DeepLearning4jEntryPoint(decode_slots=2)
+    server = Server(ep, port=0).start()
+    base = f"http://{server.host}:{server.port}"
+    try:
+        code, body, _ = _post(base + "/", {
+            "method": "open_session", "params": {"model_path": path}})
+        assert code == 200, body
+        sid = body["result"]["session_id"]
+        assert body["result"]["slots"] == 2
+        T = 5
+        x = _seq(1, T, seed=12)
+        full = np.asarray(ref.output(x))
+        got = []
+        for t in range(T):
+            code, body, _ = _post(base + "/", {
+                "method": "decode_step",
+                "params": {"session_id": sid,
+                           "features": x[0, t:t + 1].tolist()}})
+            assert code == 200, body
+            got.append(np.asarray(body["result"]["predictions"],
+                                  np.float32))
+        got = np.concatenate(got, axis=0)
+        np.testing.assert_allclose(got, full[0], atol=1e-4, rtol=1e-3)
+        # observability: stats RPC carries the pool, readyz stays ready
+        code, body, _ = _post(base + "/", {"method": "decode_stats",
+                                           "params": {}})
+        assert code == 200
+        (pool_stats,) = body["result"].values()
+        assert pool_stats["steps"] == T
+        code, body, _ = _get(base + "/readyz")
+        assert body["checks"]["decode_alive"] is True
+        code, body, _ = _post(base + "/", {
+            "method": "close_session", "params": {"session_id": sid}})
+        assert code == 200 and body["result"]["closed"] is True
+    finally:
+        server.stop()
+
+
+def test_gateway_decode_slot_exhaustion_503_retry_after(tmp_path):
+    path = str(tmp_path / "lstm.zip")
+    write_model(_lstm_mln(), path)
+    ep = DeepLearning4jEntryPoint(decode_slots=1, retry_after_s=2.0)
+    server = Server(ep, port=0).start()
+    base = f"http://{server.host}:{server.port}"
+    try:
+        code, body, _ = _post(base + "/", {
+            "method": "open_session", "params": {"model_path": path}})
+        assert code == 200
+        code, body, headers = _post(base + "/", {
+            "method": "open_session", "params": {"model_path": path}})
+        assert code == 503
+        assert headers.get("Retry-After") == "2"
+        assert "retry_after_s" in body
+    finally:
+        server.stop()
+
+
+def test_tenant_fair_share_admission(tmp_path):
+    """One tenant flooding the queue gets 503 `tenant_quota` while other
+    tenants keep being served (the global queue bound stays generous)."""
+    path = str(tmp_path / "m.zip")
+    b = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+         .shape_bucketing(True))
+    conf = (b.list()
+            .layer(L.DenseLayer(n_in=F, n_out=8, activation="relu"))
+            .layer(L.OutputLayer(n_in=8, n_out=C, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    write_model(MultiLayerNetwork(conf).init(), path)
+    ep = DeepLearning4jEntryPoint(max_batch=1, max_wait_ms=1.0,
+                                  max_queue_rows=1024,
+                                  tenant_quota_rows=2, retry_after_s=1.0)
+    server = Server(ep, port=0).start()
+    url = f"http://{server.host}:{server.port}/"
+    try:
+        code, _, _ = _post(url, {"method": "predict", "params": {
+            "model_path": path, "features": [[0.0] * F],
+            "tenant": "warm"}})
+        assert code == 200
+        req0 = _counter("dl4j_serving_requests_total", tenant="hog")
+        faults.arm({"site": "batcher.compute", "mode": "latency",
+                    "latency_ms": 80, "probability": 1.0})
+        results = []
+        lock = threading.Lock()
+
+        def client(tenant):
+            code, body, headers = _post(url, {"method": "predict",
+                                              "params": {
+                                                  "model_path": path,
+                                                  "features": [[0.0] * F],
+                                                  "tenant": tenant}})
+            with lock:
+                results.append((tenant, code, headers, body))
+        threads = [threading.Thread(target=client, args=("hog",))
+                   for _ in range(8)]
+        threads.append(threading.Thread(target=client, args=("small",)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "client hang"
+        hog_codes = [c for tn, c, _, _ in results if tn == "hog"]
+        assert hog_codes.count(503) >= 1, hog_codes
+        for tn, c, headers, body in results:
+            if c == 503:
+                assert tn == "hog"
+                assert "quota" in body["error"]
+                assert headers.get("Retry-After") == "1"
+        # the small tenant was never shed
+        assert [c for tn, c, _, _ in results if tn == "small"] == [200]
+        # per-tenant attribution on the requests family
+        assert _counter("dl4j_serving_requests_total",
+                        tenant="hog") > req0
+        assert _counter("dl4j_serving_requests_total", tenant="small") >= 1
+    finally:
+        faults.reset()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Blue/green rollout (model_cache.py, ROADMAP 3c)
+# ---------------------------------------------------------------------------
+def test_blue_green_rollout_flips_atomically(tmp_path):
+    path = str(tmp_path / "m.zip")
+    write_model(_lstm_mln(seed=1), path)
+    cache = ModelCache(blue_green=True)
+    m1 = cache.get(path, warmup_dims=(1, F))
+    # republish a different version (force a different mtime)
+    time.sleep(0.01)
+    write_model(_lstm_mln(seed=2), path)
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    # the very next get returns the OLD model instantly (no stall) and
+    # kicks the background warm
+    m_during = cache.get(path)
+    assert m_during is m1
+    deadline = time.monotonic() + 60
+    while cache.stats()["warming"] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    st = cache.stats()
+    assert st["rollouts"] == 1 and st["warming"] == 0, st
+    m2 = cache.get(path)
+    assert m2 is not m1
+    # the replacement re-warmed with the same serving dims
+    entry = st["models"][os.path.abspath(path)]
+    assert entry["warmup"] is not None
+    # readyz honesty: the model stayed resident through the whole warm
+    assert st["size"] >= 1
+
+
+def test_blue_green_rollout_failure_keeps_old_serving(tmp_path):
+    path = str(tmp_path / "m.zip")
+    write_model(_lstm_mln(seed=1), path)
+    cache = ModelCache(blue_green=True)
+    m1 = cache.get(path)
+    time.sleep(0.01)
+    with open(path, "wb") as f:
+        f.write(b"corrupt, not a model zip")
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    assert cache.get(path) is m1
+    deadline = time.monotonic() + 60
+    while cache.stats()["warming"] and time.monotonic() < deadline:
+        time.sleep(0.05)
+    st = cache.stats()
+    assert st["rollout_failures"] == 1 and st["rollouts"] == 0, st
+    assert cache.get(path) is m1   # old version still serving
+
+
+def test_decode_manager_adopts_new_model_after_drain(tmp_path):
+    path = str(tmp_path / "m.zip")
+    write_model(_lstm_mln(seed=1), path)
+    cache = ModelCache()
+    mgr = DecodeManager(cache, max_slots=2, max_wait_ms=0.5)
+    try:
+        info = mgr.open_session(path)
+        sid = info["session_id"]
+        mgr.decode_step(sid, _seq(1, 1)[0])
+        pool1 = mgr._pool_of(sid)
+        # republish: the pool with a live session keeps the old model
+        time.sleep(0.01)
+        write_model(_lstm_mln(seed=2), path)
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        cache.get(path)   # stale reload → new instance in the cache
+        assert mgr._pool_for(path) is pool1   # session still live
+        mgr.close_session(sid)
+        pool2 = mgr._pool_for(path)           # drained → adopt new model
+        assert pool2 is not pool1
+        assert pool2.model is cache.get(path)
+    finally:
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Sharded serving (parallel/fsdp.jit_sharded_output, ROADMAP 3a)
+# ---------------------------------------------------------------------------
+def _wide_mlp(shard, seed=3, data=1, fsdp=8):
+    b = NeuralNetConfiguration.builder().seed(seed).learning_rate(0.1)
+    if shard:
+        b.sharding(data=data, fsdp=fsdp)
+    conf = (b.list()
+            .layer(L.DenseLayer(n_in=16, n_out=32, activation="relu"))
+            .layer(L.OutputLayer(n_in=32, n_out=C, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_sharded_output_parity_with_replica():
+    """pjit'd output under the 8-virtual-device plan == replica output
+    at 1e-6 — params sharded over fsdp, batch over data, one replicated
+    result at the edge."""
+    import jax
+    import jax.numpy as jnp
+    ref = _wide_mlp(False)
+    net = _wide_mlp(True)
+    net.net_params = jax.tree_util.tree_map(jnp.asarray, ref.net_params)
+    x = np.random.default_rng(13).normal(size=(8, 16)).astype(np.float32)
+    a = np.asarray(jax.device_get(ref.output(x)))
+    b = np.asarray(jax.device_get(net.output(x)))
+    assert getattr(net, "_sharding_plan", None) is not None
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_sharded_output_pads_indivisible_batch():
+    """A batch that doesn't divide the mesh's data degree pads with zero
+    rows (exact at inference) and slices back — same values, same rank."""
+    import jax
+    import jax.numpy as jnp
+    ref = _wide_mlp(False)
+    net = _wide_mlp(True, data=2, fsdp=4)
+    net.net_params = jax.tree_util.tree_map(jnp.asarray, ref.net_params)
+    x = np.random.default_rng(14).normal(size=(5, 16)).astype(np.float32)
+    a = np.asarray(jax.device_get(ref.output(x)))
+    b = np.asarray(jax.device_get(net.output(x)))
+    assert b.shape == a.shape == (5, C)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_parallel_inference_through_sharded_output():
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+    ref = _wide_mlp(False)
+    net = _wide_mlp(True)
+    net.net_params = jax.tree_util.tree_map(jnp.asarray, ref.net_params)
+    pi = ParallelInference(net, batch_limit=6)   # lifted to 8 (data mult.)
+    try:
+        assert pi.batch_limit % 8 == 0
+        x = np.random.default_rng(15).normal(size=(3, 16)).astype(np.float32)
+        got = pi.output(x)
+        want = np.asarray(jax.device_get(ref.output(x)))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    finally:
+        pi.shutdown()
+
+
+def test_sharded_single_device_degrade_subprocess():
+    """With one visible device the sharded conf degrades to the plain
+    replica output path — same numerics as an unsharded net."""
+    code = r"""
+import json, os
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+
+def build(shard):
+    b = NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+    if shard:
+        b.sharding(data=1, fsdp=8)
+    return (b.list()
+            .layer(L.DenseLayer(n_in=16, n_out=32, activation="relu"))
+            .layer(L.OutputLayer(n_in=32, n_out=4, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+ref = MultiLayerNetwork(build(False)).init()
+net = MultiLayerNetwork(build(True)).init()
+net.net_params = jax.tree_util.tree_map(jnp.asarray, ref.net_params)
+x = np.random.default_rng(0).normal(size=(5, 16)).astype(np.float32)
+a = np.asarray(jax.device_get(ref.output(x)))
+b = np.asarray(jax.device_get(net.output(x)))
+print(json.dumps({
+    "devices": jax.device_count(),
+    "plan_active": getattr(net, "_sharding_plan", None) is not None,
+    "max_abs_diff": float(np.max(np.abs(a - b))),
+}))
+"""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=300, cwd=os.path.dirname(
+                           os.path.dirname(os.path.abspath(__file__))),
+                       env=env)
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["devices"] == 1
+    assert out["plan_active"] is False      # graceful degrade
+    assert out["max_abs_diff"] == 0.0       # byte-identical replica path
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 subprocess smoke: a decode-armed server serves sessions
+# ---------------------------------------------------------------------------
+_DECODE_SMOKE = r"""
+import json, tempfile, os
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import urllib.request
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.serialization import write_model
+from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+        .shape_bucketing(True).list()
+        .layer(L.GravesLSTM(n_in=5, n_out=12, activation="tanh"))
+        .layer(L.RnnOutputLayer(n_in=12, n_out=4, activation="softmax",
+                                loss="mcxent"))
+        .build())
+path = os.path.join(tempfile.mkdtemp(), "lstm.zip")
+write_model(MultiLayerNetwork(conf).init(), path)
+server = Server(DeepLearning4jEntryPoint(decode_slots=2), port=0).start()
+base = f"http://{server.host}:{server.port}"
+
+def post(method, params):
+    req = urllib.request.Request(
+        base + "/", data=json.dumps({"method": method,
+                                     "params": params}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+out = {}
+sid = post("open_session", {"model_path": path})["result"]["session_id"]
+x = np.random.default_rng(0).normal(size=(3, 5)).astype(np.float32)
+steps = [post("decode_step", {"session_id": sid,
+                              "features": x[t:t+1].tolist()})
+         for t in range(3)]
+out["steps_ok"] = all("result" in s for s in steps)
+out["shapes"] = [s["result"]["shape"] for s in steps]
+with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+    out["readyz"] = json.loads(r.read())["checks"]["decode_alive"]
+out["closed"] = post("close_session",
+                     {"session_id": sid})["result"]["closed"]
+with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+    out["healthz"] = r.status
+server.stop()
+print(json.dumps(out))
+"""
+
+
+def test_decode_armed_server_smoke_subprocess():
+    p = subprocess.run([sys.executable, "-c", _DECODE_SMOKE],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["steps_ok"] is True
+    assert out["shapes"] == [[1, 4]] * 3
+    assert out["readyz"] is True
+    assert out["closed"] is True
+    assert out["healthz"] == 200
