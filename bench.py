@@ -111,6 +111,8 @@ def machine_fingerprint(devices=None):
 
 
 GATE_THRESHOLD = 0.15   # >15% below the stored best-of-N = regression
+NEAR_MISS_THRESHOLD = 0.10   # drops past this (but under the gate)
+# are recorded as near-misses — the tuning signal for the 15% line
 
 
 def _fingerprint_key(fp):
@@ -136,8 +138,10 @@ def gate_regressions(result, history_dir):
     disabled = os.environ.get("DL4J_BENCH_NO_GATE") == "1"
     keep_n = 10
     gate = {"dir": history_dir, "threshold_pct": int(GATE_THRESHOLD * 100),
+            "near_miss_threshold_pct": int(NEAR_MISS_THRESHOLD * 100),
             "keep_n": keep_n, "disabled": disabled, "checked": 0,
-            "regressions": [], "failed": False}
+            "regressions": [], "margins": [], "near_misses": [],
+            "failed": False}
     fp_key = _fingerprint_key(result.get("machine", {}))
     try:
         os.makedirs(history_dir, exist_ok=True)
@@ -159,12 +163,34 @@ def gate_regressions(result, history_dir):
                     and entry.get("values"):
                 baseline = max(entry["values"])
                 gate["checked"] += 1
+                # the margin is recorded on EVERY checked config — pass
+                # or fail — so the threshold can be tuned from the
+                # distribution of real runs instead of anecdotes
+                # (ROADMAP 5: does CPU-fallback noise crowd the line?)
+                pct_vs_best = round((value / baseline - 1.0) * 100, 1)
+                gate["margins"].append({
+                    "config": name, "value": value, "unit": unit,
+                    "baseline_best_of_n": baseline,
+                    "pct_vs_best": pct_vs_best,
+                    "history_len": len(entry["values"]),
+                    "fingerprint": fp_key,
+                })
                 if value < baseline * (1.0 - GATE_THRESHOLD):
                     gate["regressions"].append({
                         "config": name, "value": value,
                         "baseline_best_of_n": baseline, "unit": unit,
                         "drop_pct": round((1 - value / baseline) * 100, 1),
                         "fingerprint": fp_key,
+                    })
+                elif value < baseline * (1.0 - NEAR_MISS_THRESHOLD):
+                    # inside the gate but close to it: the population
+                    # that decides whether 15% is too tight or too loose
+                    gate["near_misses"].append({
+                        "config": name,
+                        "drop_pct": round((1 - value / baseline) * 100, 1),
+                        "gate_headroom_pct": round(
+                            GATE_THRESHOLD * 100
+                            - (1 - value / baseline) * 100, 1),
                     })
             elif entry is not None and entry.get("unit") != unit:
                 # a config changed what it measures: restart its history
@@ -1306,24 +1332,79 @@ def bench_serving():
         return leg
 
     legs = {"per_request": run_leg(False), "coalesced": run_leg(True)}
-    # span-overhead A/B (monitor/tracing.py): the same coalesced leg
-    # with span timing hard-disabled — instrumentation must cost ≤ 5%
-    # of serving throughput or it can't stay always-on
+    # instrumentation-overhead A/Bs: the coalesced workload with (a)
+    # span timing and (b) the event journal hard-disabled (the
+    # DL4J_SPANS=0 / DL4J_JOURNAL=0 kill-switch paths — journal emits
+    # become no-ops, not queued).  Each must cost ≤ 5% of serving
+    # throughput or it can't stay always-on.  Methodology: PAIRED
+    # adjacent on/off bursts (order alternating) against one warmed
+    # entry point, overhead = 1 - median of per-pair rate ratios.
+    # Sequential whole-leg comparison confounds a ~5% effect with
+    # machine drift on a loaded 1-core host; pairing cancels the drift
+    # because both legs of a pair run ~0.1s apart.
+    from deeplearning4j_tpu.monitor import events as _events
     from deeplearning4j_tpu.monitor import tracing as _tracing
-    _tracing.set_enabled(False)
-    try:
-        legs["coalesced_spans_off"] = run_leg(True)
-    finally:
-        _tracing.set_enabled(None)
-    span_overhead = 1.0 - (
-        legs["coalesced"]["requests_per_sec"]
-        / max(legs["coalesced_spans_off"]["requests_per_sec"], 1e-9))
+
+    def overhead_ab(set_off, pairs=10):
+        ep_j = DeepLearning4jEntryPoint(max_batch=MAX_BATCH,
+                                        max_wait_ms=2.0,
+                                        min_batch=CONCURRENCY)
+        ep_j.predict(model_path, features=client_rows[0][0])
+
+        def one_burst():
+            threads = [threading.Thread(target=lambda rs: [
+                ep_j.predict(model_path, features=r) for r in rs],
+                args=(rows,)) for rows in client_rows]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return CONCURRENCY * REQS / (time.perf_counter() - t0)
+
+        def off_burst():
+            set_off(True)
+            try:
+                return one_burst()
+            finally:
+                set_off(False)
+        one_burst()
+        ratios, on_rates, off_rates = [], [], []
+        try:
+            for i in range(pairs):
+                if i % 2:
+                    off = off_burst()
+                    on = one_burst()
+                else:
+                    on = one_burst()
+                    off = off_burst()
+                on_rates.append(on)
+                off_rates.append(off)
+                ratios.append(on / max(off, 1e-9))
+        finally:
+            ep_j.close()
+        overhead = 1.0 - statistics.median(ratios)
+        return overhead, {
+            "on_req_per_sec_best": round(max(on_rates), 1),
+            "off_req_per_sec_best": round(max(off_rates), 1),
+            "on_req_per_sec_median": round(statistics.median(on_rates), 1),
+            "off_req_per_sec_median": round(statistics.median(off_rates), 1),
+            "pair_ratio_median": round(statistics.median(ratios), 4),
+            "pairs": len(ratios),
+        }
+
+    span_overhead, legs["spans_ab"] = overhead_ab(
+        lambda off: _tracing.set_enabled(False if off else None))
+    journal_overhead, legs["journal_ab"] = overhead_ab(
+        lambda off: _events.set_enabled(False if off else None))
     speedup = (legs["coalesced"]["requests_per_sec"]
                / max(legs["per_request"]["requests_per_sec"], 1e-9))
     ladder = legs["coalesced"]["warmed_buckets"]
     return {
         "span_overhead_pct": round(span_overhead * 100.0, 2),
         "span_overhead_within_5pct": span_overhead <= 0.05,
+        "journal_overhead_pct": round(journal_overhead * 100.0, 2),
+        "journal_overhead_within_5pct": journal_overhead <= 0.05,
         "metric": f"serving predict requests/sec, {CONCURRENCY} concurrent "
                   "clients, dynamic micro-batching",
         "value": legs["coalesced"]["requests_per_sec"],

@@ -731,6 +731,56 @@ class Project:
                         out.append((f.path, node, pattern, True))
         return out
 
+    # -- journal event call sites (for the event drift rules) -----------
+    #: dotted lowercase event-type names, e.g. "span.close" — the shape
+    #: that distinguishes journal emits from other string-first calls
+    EVENT_NAME_RE = re.compile(r"[a-z0-9_]+(?:\.[a-z0-9_]+)+\Z")
+
+    def event_call_sites(self) -> List[Tuple[str, ast.Call, str]]:
+        """Every ``*.emit("type.name", ...)`` / ``emit("type.name")``
+        call with a literal dotted event-type first argument:
+        ``(path, call_node, name)`` — the code side of the journal
+        event taxonomy (monitor/events.py)."""
+        out = []
+        for f in self.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                func = node.func
+                name = (func.attr if isinstance(func, ast.Attribute)
+                        else func.id if isinstance(func, ast.Name)
+                        else None)
+                if name != "emit":
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str) \
+                        and self.EVENT_NAME_RE.match(arg.value):
+                    out.append((f.path, node, arg.value))
+        return out
+
+    def event_type_constants(self) -> List[Tuple[str, ast.AST, str]]:
+        """Entries of module-level ``EVENT_TYPES`` tuples/lists — the
+        declared taxonomy (one per name, with its declaring node)."""
+        out = []
+        for f in self.files:
+            if f.tree is None:
+                continue
+            for node in f.tree.body:
+                if not isinstance(node, ast.Assign) \
+                        or len(node.targets) != 1 \
+                        or not isinstance(node.targets[0], ast.Name) \
+                        or node.targets[0].id != "EVENT_TYPES" \
+                        or not isinstance(node.value, (ast.Tuple, ast.List)):
+                    continue
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        out.append((f.path, elt, elt.value))
+        return out
+
 
 # ----------------------------------------------------------------------
 # Runner

@@ -12,6 +12,8 @@ rule with :data:`deeplearning4j_tpu.analysis.core.RULES`.
 | DL4J203 | bare-lock-acquire     | error    | acquire without finally      |
 | DL4J301 | metric-undocumented   | error    | code metric not in docs      |
 | DL4J302 | metric-doc-stale      | error    | doc metric not in code       |
+| DL4J303 | event-undocumented    | error    | journal event not in docs    |
+| DL4J304 | event-doc-stale       | error    | doc event not in code        |
 
 Rationale and worked examples: docs/ANALYSIS.md.
 """

@@ -1,6 +1,7 @@
 """Observability-drift rules (DL4J3xx): the `dl4j_*` metric names at
 registry call sites and the catalog in ``docs/OBSERVABILITY.md`` must
-be the same set, in both directions.
+be the same set, in both directions — and so must the journal event
+taxonomy (``monitor/events.py``) and its doc catalog.
 
 The doc catalog is the operator contract — dashboards and alerts are
 built against it.  A metric registered in code but missing from the
@@ -15,6 +16,13 @@ pattern ``dl4j_model_cache_[a-z0-9_]+_total``) and doc brace rows
 (``dl4j_sharding_params_{sharded,replicated}`` expands to each
 alternative).  Test files are exempt from the undocumented-metric
 direction — ad-hoc names registered by a test are not operator surface.
+
+DL4J303/304 apply the same contract to journal event types: every
+literal ``emit("some.event", ...)`` call site and every ``EVENT_TYPES``
+entry must appear in the docs "Event taxonomy" table (first cell,
+backticked), and every taxonomy row must be backed by a declared or
+emitted type — an event renamed in code but not in docs is a flight
+recorder whose dumps nobody can grep for.
 """
 
 from __future__ import annotations
@@ -131,4 +139,101 @@ class StaleMetricDoc(Rule):
                 message=(f"documented metric `{name}` has no registry "
                          "call site in the scanned code — stale catalog "
                          "row"),
+                symbol="<catalog>")
+
+
+# ----------------------------------------------------------------------
+# Journal event taxonomy drift (DL4J303/304)
+# ----------------------------------------------------------------------
+_EVENT_DOC_RE = re.compile(r"`([a-z0-9_]+(?:\.[a-z0-9_]+)+)`")
+_HEADING_RE = re.compile(r"^\s{0,3}#")
+
+
+def doc_event_names(doc_text: str) -> List[Tuple[str, int]]:
+    """(name, line) for the backticked dotted event-type name in the
+    FIRST cell of each table row under an "Event taxonomy" heading.
+    Scoped to that section so prose elsewhere (``conf.sharding()``,
+    module paths) can't masquerade as taxonomy entries."""
+    out: List[Tuple[str, int]] = []
+    in_section = False
+    for lineno, line in enumerate(doc_text.splitlines(), 1):
+        if _HEADING_RE.match(line):
+            in_section = "event taxonomy" in line.lower()
+            continue
+        if not in_section or not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        first = cells[1] if len(cells) > 1 else ""
+        m = _EVENT_DOC_RE.search(first)
+        if m:
+            out.append((m.group(1), lineno))
+    return out
+
+
+@register
+class UndocumentedEvent(Rule):
+    id = "DL4J303"
+    name = "event-undocumented"
+    severity = ERROR
+    doc = ("A journal event type emitted at an `emit(...)` call site "
+           "(or declared in `EVENT_TYPES`) does not appear in the "
+           "docs/OBSERVABILITY.md \"Event taxonomy\" catalog — a dump "
+           "or /trace stream carrying it is unreadable by contract.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        doc_names, doc_text = _doc_entries(project)
+        if not doc_text:
+            return
+        documented: Set[str] = {n for n, _ in doc_event_names(doc_text)}
+        for path, node, name in project.event_call_sites():
+            if is_test_path(path):
+                continue
+            if name not in documented:
+                yield self.finding(
+                    project, node, path,
+                    f"journal event `{name}` is emitted here but "
+                    "missing from the docs/OBSERVABILITY.md event "
+                    "taxonomy")
+        for path, node, name in project.event_type_constants():
+            if is_test_path(path):
+                continue
+            if name not in documented:
+                yield self.finding(
+                    project, node, path,
+                    f"declared event type `{name}` (EVENT_TYPES) is "
+                    "missing from the docs/OBSERVABILITY.md event "
+                    "taxonomy")
+
+
+@register
+class StaleEventDoc(Rule):
+    id = "DL4J304"
+    name = "event-doc-stale"
+    severity = ERROR
+    doc = ("An event-type row in the docs/OBSERVABILITY.md \"Event "
+           "taxonomy\" table is neither declared in `EVENT_TYPES` nor "
+           "emitted anywhere — grep/alerting built on it matches "
+           "nothing.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        doc_names, doc_text = _doc_entries(project)
+        if not doc_text:
+            return
+        in_code: Set[str] = {n for p, _, n in project.event_call_sites()
+                             if not is_test_path(p)}
+        in_code |= {n for p, _, n in project.event_type_constants()
+                    if not is_test_path(p)}
+        if not in_code:
+            return  # no journal in the scanned code: nothing to drift
+        doc_rel = os.path.relpath(project.docs_path) \
+            if project.docs_path else "docs/OBSERVABILITY.md"
+        for name, lineno in doc_event_names(doc_text):
+            if name in in_code:
+                continue
+            yield Finding(
+                rule=self.id, severity=self.severity, path=doc_rel,
+                line=lineno, col=0,
+                message=(f"documented event type `{name}` is neither "
+                         "declared in EVENT_TYPES nor emitted in the "
+                         "scanned code — stale taxonomy row"),
                 symbol="<catalog>")

@@ -67,8 +67,18 @@ def _violation(mode: str) -> None:
             "dl4j_sanitizer_violations_total",
             "sanitizer modes tripped (guarded transfer, NaN, retrace "
             "budget)", labels=("mode",)).labels(mode=mode).inc()
+        from deeplearning4j_tpu.monitor import events
+        events.emit("sanitizer.violation", severity="error", mode=mode)
     except Exception:
         pass  # the sanitizer must never die on telemetry
+
+
+def _flight_dump(reason: str, extra=None) -> None:
+    try:
+        from deeplearning4j_tpu.monitor import flight
+        flight.dump(reason, extra=extra)
+    except Exception:
+        pass  # the recorder must never worsen the crash
 
 
 def _env_modes() -> frozenset:
@@ -170,6 +180,7 @@ def armed_fit(net):
         ok = True
     except FloatingPointError:
         _violation("nans")
+        _flight_dump("nan_in_step")
         raise
     finally:
         for key, value in saved.items():
@@ -183,6 +194,8 @@ def armed_fit(net):
         delta = telemetry.retraces - start_retraces
         if delta > budget:
             _violation("retrace")
+            _flight_dump("retrace_budget",
+                         extra={"retraces": delta, "budget": budget})
             raise SanitizerError(
                 f"retrace budget exceeded: {delta} retraces in one "
                 f"fit() against a budget of {budget} — shapes are not "

@@ -18,12 +18,25 @@ the system doing" and "where does a step spend its time".
 
     text = monitor.render_prometheus(monitor.get_registry().snapshot())
 
+A third surface rides the same package: the **structured event
+journal** (``monitor/events.py`` — a bounded ring of typed events with
+request/session correlation IDs carried by contextvars) and the
+**flight recorder** (``monitor/flight.py`` — crash handlers dump the
+journal tail plus a registry snapshot to a timestamped JSON file;
+``GET /trace`` / the ``trace_dump`` RPC serve the live journal and its
+Chrome trace-event export).
+
 Env knobs: ``DL4J_PROFILE=<dir>`` wraps every fit in
 ``jax.profiler.start_trace``; ``DL4J_TRACE_ANNOTATIONS=1`` mirrors
-spans into XLA profiler dumps; ``DL4J_SPANS=0`` disables span timing.
-Full metric catalog: docs/OBSERVABILITY.md.
+spans into XLA profiler dumps; ``DL4J_SPANS=0`` disables span timing;
+``DL4J_JOURNAL=0`` disables the event journal; ``DL4J_FLIGHT_DIR``
+places flight-recorder dumps.  Full catalog: docs/OBSERVABILITY.md.
 """
 
+from deeplearning4j_tpu.monitor import events, flight  # noqa: F401
+from deeplearning4j_tpu.monitor.events import (  # noqa: F401
+    EventJournal, chrome_trace, get_journal, new_request_id,
+    request_scope)
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry)
 from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401

@@ -30,6 +30,7 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, List, Optional
 
+from deeplearning4j_tpu.monitor import events
 from deeplearning4j_tpu.monitor.registry import (
     MetricsRegistry, get_registry)
 
@@ -104,6 +105,13 @@ def span(name: str, phase: Optional[str] = None,
     st = _stack()
     s = Span(name, phase, st[-1] if st else None)
     st.append(s)
+    # the journal sees every span close with its trace context
+    # (request_id / session_id / fit_id ride on the contextvars scope) —
+    # this is what lets "why was THIS predict slow" be answered from the
+    # event log.  Open events are verbose-only: close carries the
+    # duration, and doubling hot-path emits breaks the ≤5% budget.
+    if events.verbose():
+        events.emit("span.open", span=name, phase=phase or "")
     ann = None
     if _annotations_enabled():
         try:
@@ -130,6 +138,8 @@ def span(name: str, phase: Optional[str] = None,
             PHASE_METRIC, "span phase wall time (seconds)",
             labels=("span", "phase"),
         ).labels(span=name, phase=phase or "").observe(s.duration)
+        events.emit("span.close", span=name, phase=phase or "",
+                    duration_s=s.duration)
 
 
 @contextmanager

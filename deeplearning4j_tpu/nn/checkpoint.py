@@ -128,6 +128,7 @@ def _count_fallback() -> None:
         monitor.get_registry().counter(
             "dl4j_resilience_checkpoint_fallbacks_total",
             "corrupt/unloadable checkpoints skipped during resume").inc()
+        monitor.events.emit("checkpoint.fallback", severity="warn")
     except Exception:
         pass
 
@@ -207,6 +208,13 @@ class CheckpointListener(TrainingListener):
         _atomic_write_text(self.dir / "checkpoint_index.json",
                            json.dumps(meta))
         self._prune()
+        try:
+            from deeplearning4j_tpu import monitor
+            monitor.events.emit("checkpoint.write", path=path.name,
+                                iteration=iteration,
+                                epoch=epochs_completed)
+        except Exception:
+            pass
         return path
 
     def _update_manifest(self, meta: dict) -> None:
@@ -417,6 +425,21 @@ def maybe_auto_resume(model) -> Tuple[int, int]:
     log.info("resumed from %s (iteration %d, epoch %d + %d batches); "
              "skipping the already-trained prefix",
              meta.get("path"), meta["iteration"], skip_epochs, skip_batches)
+    # a resume means the PREVIOUS run died: journal the restore and dump
+    # the black box so whatever the journal still holds about the crash
+    # (plus the registry at restart) is preserved next to the new run
+    try:
+        from deeplearning4j_tpu.monitor import events, flight
+        events.emit("checkpoint.restored", severity="warn",
+                    path=str(meta.get("path")),
+                    iteration=int(meta["iteration"]),
+                    epoch=skip_epochs, batches=skip_batches)
+        flight.dump("resume_from_checkpoint", extra={
+            "path": str(meta.get("path")),
+            "iteration": int(meta["iteration"]),
+            "skip_epochs": skip_epochs, "skip_batches": skip_batches})
+    except Exception:
+        pass
     return skip_epochs, skip_batches
 
 

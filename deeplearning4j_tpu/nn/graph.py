@@ -20,6 +20,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.analysis import sanitizer
+from deeplearning4j_tpu.monitor import events
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.graph_conf import (
@@ -367,7 +368,11 @@ class ComputationGraph:
         if isinstance(data, MultiDataSet):
             batches = [data]
             with sanitizer.armed_fit(self), \
-                    monitor.profile_if_configured("fit"):
+                    monitor.profile_if_configured("fit"), \
+                    events.scope(fit_id=events.new_request_id(),
+                                 model=type(self).__name__):
+                events.emit("fit.start", epochs=epochs,
+                            iteration=self.iteration)
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue
@@ -381,6 +386,8 @@ class ComputationGraph:
                         self._fit_batch(mds)
                     epoch_hook("on_epoch_end")
                     self.epoch += 1
+                events.emit("fit.end", iteration=self.iteration,
+                            epoch=self.epoch)
             return self
         # iterator of DataSet or MultiDataSet — wrapped in the parallel
         # input pipeline so ETL + H2D overlap the jitted step (the MLN
@@ -431,9 +438,14 @@ class ComputationGraph:
 
         try:
             # DL4J_SANITIZE: debug-nans/rank checks for the duration,
-            # retrace-budget assertion on clean exit (analysis/sanitizer)
+            # retrace-budget assertion on clean exit (analysis/sanitizer);
+            # the events.scope correlates every span/event under one fit
             with sanitizer.armed_fit(self), \
-                    monitor.profile_if_configured("fit"):
+                    monitor.profile_if_configured("fit"), \
+                    events.scope(fit_id=events.new_request_id(),
+                                 model=type(self).__name__):
+                events.emit("fit.start", epochs=epochs,
+                            iteration=self.iteration)
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue  # resumed past this epoch entirely
@@ -464,6 +476,8 @@ class ComputationGraph:
                         self._fit_batch(item)
                     epoch_hook("on_epoch_end")
                     self.epoch += 1
+                events.emit("fit.end", iteration=self.iteration,
+                            epoch=self.epoch)
         finally:
             if isinstance(it, AsyncDataSetIterator):
                 it.close()

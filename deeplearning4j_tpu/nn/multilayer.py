@@ -25,6 +25,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.analysis import sanitizer
+from deeplearning4j_tpu.monitor import events
 from deeplearning4j_tpu.nn import params as param_util
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, Layer, LossLayer
@@ -535,9 +536,15 @@ class MultiLayerNetwork:
                     and self.conf.global_conf.iterations <= 1) else 1)
         try:
             # DL4J_SANITIZE: debug-nans/rank checks for the duration,
-            # retrace-budget assertion on clean exit (analysis/sanitizer)
+            # retrace-budget assertion on clean exit (analysis/sanitizer).
+            # The events.scope gives this fit a correlation ID so every
+            # fit/step span and checkpoint event journals under it.
             with sanitizer.armed_fit(self), \
-                    monitor.profile_if_configured("fit"):
+                    monitor.profile_if_configured("fit"), \
+                    events.scope(fit_id=events.new_request_id(),
+                                 model=type(self).__name__):
+                events.emit("fit.start", epochs=epochs,
+                            iteration=self.iteration)
                 for ep_i in range(epochs):
                     if ep_i < skip_epochs:
                         continue  # resumed past this epoch entirely
@@ -578,6 +585,8 @@ class MultiLayerNetwork:
                         if isinstance(lst, TrainingListener):
                             lst.on_epoch_end(self)
                     self.epoch += 1
+                events.emit("fit.end", iteration=self.iteration,
+                            epoch=self.epoch)
         finally:
             # release pipeline threads — a producer blocked on a full
             # queue mid-exception would otherwise leak (close() is

@@ -483,6 +483,12 @@ class CompileTelemetry:
             reg.counter("dl4j_compile_retraces_total",
                         "new jit-entry signatures (XLA retraces)",
                         labels=("kind",)).labels(kind=kind).inc()
+            # journal the retrace with the trace context (fit_id /
+            # request_id): a jit_call-dominated step can be attributed
+            # to the exact request/fit that paid the compile
+            from deeplearning4j_tpu.monitor import events
+            events.emit("compile.retrace", kind=kind,
+                        retraces=self.retraces)
         if bucket is not None:
             reg.counter("dl4j_bucket_hits_total",
                         "bucketed batches dispatched",

@@ -150,6 +150,10 @@ def _count_injection(site: str, mode: str) -> None:
             "dl4j_resilience_faults_injected_total",
             "faults injected by armed fault plans",
             labels=("site", "mode")).labels(site=site, mode=mode).inc()
+        # journaled with the trace context of the injected call — the
+        # flight-recorder dump of a chaos kill names the request it hit
+        monitor.events.emit("fault.injected", severity="warn",
+                            site=site, mode=mode)
     except Exception:
         pass  # chaos must not die on telemetry
 

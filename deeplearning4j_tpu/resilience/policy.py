@@ -232,6 +232,13 @@ class CircuitBreaker:
         self._state = to
         self._g_state.set(self._STATE_CODE[to])
         self._c_transitions.labels(breaker=self.name, to=to).inc()
+        try:
+            from deeplearning4j_tpu.monitor import events
+            events.emit("breaker.transition",
+                        severity="warn" if to != self.CLOSED else "info",
+                        breaker=self.name, to=to)
+        except Exception:
+            pass  # state machines must not die on telemetry
         if to == self.OPEN:
             self._opened_at = self._clock()
         if to == self.HALF_OPEN:

@@ -40,20 +40,29 @@ log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.analysis import sanitizer
+from deeplearning4j_tpu.monitor import events, flight
 from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
 
 
 class _Pending:
-    __slots__ = ("x", "future", "t_enqueue", "deadline", "tenant")
+    __slots__ = ("x", "future", "t_enqueue", "deadline", "tenant", "ctx")
 
-    def __init__(self, x, future, t_enqueue, deadline=None, tenant=None):
+    def __init__(self, x, future, t_enqueue, deadline=None, tenant=None,
+                 ctx=None):
         self.x = x
         self.future = future
         self.t_enqueue = t_enqueue
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.tenant = tenant      # fair-share admission attribution
+        # trace context captured at enqueue: the batcher thread re-
+        # attaches it to the events it emits on this request's behalf
+        self.ctx = ctx or {}
+
+    @property
+    def request_id(self):
+        return self.ctx.get("request_id")
 
 
 class ServingMetrics:
@@ -222,7 +231,9 @@ class MicroBatcher:
         fut = Future()
         deadline = (None if timeout_ms is None
                     else time.monotonic() + float(timeout_ms) / 1e3)
-        p = _Pending(x, fut, time.perf_counter(), deadline, tenant)
+        p = _Pending(x, fut, time.perf_counter(), deadline, tenant,
+                     ctx=events.current_context())
+        restarted = False
         with self._cond:
             if not self._running:
                 raise RuntimeError("MicroBatcher is stopped")
@@ -233,8 +244,17 @@ class MicroBatcher:
                 self.restarts += 1
                 self._c_restarts.inc()
                 self._thread = self._spawn_thread()
+                restarted = True
             self._queue.append(p)
             self._cond.notify_all()
+        if restarted:
+            events.emit("batcher.restarted", model=self._name)
+        # verbose-only: request.admitted (gateway) already witnessed
+        # this request microseconds ago on the same thread, and
+        # batch.dispatch's request_ids prove queue membership — a third
+        # always-on per-request emit breaks the ≤5% serving budget
+        if events.verbose():
+            events.emit("request.enqueued", rows=len(x), model=self._name)
         return fut
 
     def predict(self, features, timeout: Optional[float] = None,
@@ -324,6 +344,9 @@ class MicroBatcher:
             if p.deadline is not None and now >= p.deadline:
                 self.metrics.record_shed("deadline")
                 self._c_shed.labels(reason="deadline").inc()
+                events.emit("request.shed", severity="warn",
+                            reason="deadline", model=self._name,
+                            request_id=p.request_id, tenant=p.tenant)
                 if not p.future.done():
                     p.future.set_exception(DeadlineExceededError(
                         "request deadline expired while queued "
@@ -334,36 +357,57 @@ class MicroBatcher:
 
     def _run_group(self, group: List[_Pending]) -> None:
         t_dispatch = time.perf_counter()
+        # the ONE compute span for this batch is linked to the N
+        # coalesced request spans by carrying every joined request ID in
+        # the batcher thread's trace context — the journal answers
+        # "which requests rode the batch that failed/was slow"
+        rids = [p.request_id for p in group if p.request_id]
         try:
-            faults.check("batcher.compute")
-            with monitor.span("serve/batch", phase="concat_pad"):
-                xs = [p.x for p in group]
-                x = np.concatenate(xs) if len(xs) > 1 else xs[0]
-                n = len(x)
-                if self._pad:
-                    nb = bucketing.bucket_size(n, self._bucket_sizes)
-                    if nb != n:
-                        x = np.concatenate(
-                            [x, np.zeros((nb - n,) + x.shape[1:], x.dtype)])
-            t0 = time.perf_counter()
-            with monitor.span("serve/batch", phase="compute"), \
-                    sanitizer.guard_step():
-                # explicit device->host pull (jax.device_get), not an
-                # implicit np.asarray sync: the sanitizer's transfer
-                # guard allows explicit transfers, and a non-jax output
-                # (plain numpy infer_fn) passes through unchanged
-                out = np.asarray(jax.device_get(self._infer_fn(x)))[:n]
-            t1 = time.perf_counter()
+            with events.scope(model=self._name or None,
+                              request_ids=rids or None):
+                faults.check("batcher.compute")
+                with monitor.span("serve/batch", phase="concat_pad"):
+                    xs = [p.x for p in group]
+                    x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+                    n = len(x)
+                    if self._pad:
+                        nb = bucketing.bucket_size(n, self._bucket_sizes)
+                        if nb != n:
+                            x = np.concatenate(
+                                [x, np.zeros((nb - n,) + x.shape[1:],
+                                             x.dtype)])
+                events.emit("batch.dispatch", requests=len(group), rows=n)
+                t0 = time.perf_counter()
+                with monitor.span("serve/batch", phase="compute"), \
+                        sanitizer.guard_step():
+                    # explicit device->host pull (jax.device_get), not an
+                    # implicit np.asarray sync: the sanitizer's transfer
+                    # guard allows explicit transfers, and a non-jax
+                    # output (plain numpy infer_fn) passes through
+                    # unchanged
+                    out = np.asarray(jax.device_get(self._infer_fn(x)))[:n]
+                t1 = time.perf_counter()
             i = 0
             for p in group:
                 k = len(p.x)
                 p.future.set_result(out[i:i + k])
                 i += k
+            verbose = events.verbose()
             for p in group:
                 self.metrics.queue.record(t_dispatch - p.t_enqueue)
                 self.metrics.compute.record(t1 - t0)
                 self.metrics.total.record(t1 - p.t_enqueue)
                 self.metrics.record_request(p.tenant)
+                # per-request completion events are verbose-only: the
+                # response hop is already witnessed per request by
+                # rpc.response (HTTP) and per batch by the compute
+                # span.close carrying request_ids — a per-request emit
+                # on the batcher's critical path breaks the ≤5% budget
+                if verbose:
+                    events.emit("request.done", model=self._name,
+                                request_id=p.request_id, tenant=p.tenant,
+                                rows=len(p.x),
+                                total_s=round(t1 - p.t_enqueue, 6))
             self.metrics.record_batch(len(group), n)
         except Exception as e:
             for p in group:
@@ -377,12 +421,14 @@ class MicroBatcher:
         to strand every pending future in a forever-block; now the
         handler fails in-flight and queued requests with an error result
         and the next :meth:`submit` restarts the thread."""
+        death_err = None
         try:
             self._loop()
         except BaseException as e:
             # recorded here (not re-raised): the death is fully handled
             # below, and a daemon thread's unhandled-exception spew
             # would just double-report it
+            death_err = e
             log.error("micro-batcher %r thread died: %s: %s",
                       self._name, type(e).__name__, e)
         finally:
@@ -401,6 +447,20 @@ class MicroBatcher:
                         p.future.set_exception(RuntimeError(
                             "MicroBatcher thread died; request failed "
                             "(the batcher restarts on the next submit)"))
+                # black box: journal the death with the stranded request
+                # IDs, then dump the last-N events + registry snapshot
+                # so "what happened in the 2s before the batcher died"
+                # survives the thread
+                rids = [p.request_id for p in stranded if p.request_id]
+                events.emit(
+                    "batcher.died", severity="error", model=self._name,
+                    error=(f"{type(death_err).__name__}: {death_err}"
+                           if death_err is not None else "unknown"),
+                    stranded=len(stranded), request_ids=rids or None)
+                flight.dump("batcher_died", extra={
+                    "batcher": self._name,
+                    "stranded_request_ids": rids,
+                    "error": repr(death_err)})
 
     def _loop(self) -> None:
         while True:

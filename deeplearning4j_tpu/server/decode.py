@@ -59,6 +59,7 @@ import numpy as np
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.analysis import sanitizer
+from deeplearning4j_tpu.monitor import events, flight
 from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import (
@@ -77,9 +78,12 @@ class DecodeMetrics:
         reg = monitor.get_registry()
         self._name = name or "default"
         lbl = {"model": self._name}
-        self.c_opened = reg.counter(
-            "dl4j_decode_sessions_opened_total", "decode sessions opened",
-            ("model",)).labels(**lbl)
+        # request-path counters carry `tenant` (label parity with
+        # dl4j_serving_requests_total) so per-tenant decode attribution
+        # works straight off /metrics, without the journal
+        self._f_opened = reg.counter(
+            "dl4j_decode_sessions_opened_total",
+            "decode sessions opened, per tenant", ("model", "tenant"))
         self._f_closed = reg.counter(
             "dl4j_decode_sessions_closed_total",
             "decode sessions closed, by reason", ("model", "reason"))
@@ -92,9 +96,9 @@ class DecodeMetrics:
         self._f_steps = reg.counter(
             "dl4j_decode_steps_total", "decode session-steps served",
             ("model", "tenant"))
-        self.c_tokens = reg.counter(
-            "dl4j_decode_tokens_total", "timesteps decoded",
-            ("model",)).labels(**lbl)
+        self._f_tokens = reg.counter(
+            "dl4j_decode_tokens_total", "timesteps decoded, per tenant",
+            ("model", "tenant"))
         self.c_batches = reg.counter(
             "dl4j_decode_batches_total",
             "continuous-batching decode dispatches", ("model",)).labels(**lbl)
@@ -114,8 +118,19 @@ class DecodeMetrics:
         self.batches = 0
         self.batch_size_hist: Dict[int, int] = {}
 
-    def record_step(self, tenant: Optional[str]) -> None:
+    def record_opened(self, tenant: Optional[str]) -> None:
+        self._f_opened.labels(model=self._name, tenant=tenant or "-").inc()
+
+    def record_step(self, tenant: Optional[str], n_tokens: int = 0) -> None:
         self._f_steps.labels(model=self._name, tenant=tenant or "-").inc()
+        if n_tokens:
+            # tokens attribute per tenant at the step (the request
+            # path), not per batch — per-tenant series sum to the
+            # model's total without double counting
+            self._f_tokens.labels(model=self._name,
+                                  tenant=tenant or "-").inc(n_tokens)
+            with self._lock:
+                self.tokens += n_tokens
 
     def record_closed(self, reason: str) -> None:
         self._f_closed.labels(model=self._name, reason=reason).inc()
@@ -123,14 +138,12 @@ class DecodeMetrics:
     def record_shed(self, reason: str) -> None:
         self._c_shed.labels(reason=reason).inc()
 
-    def record_batch(self, n_steps: int, n_tokens: int) -> None:
+    def record_batch(self, n_steps: int) -> None:
         with self._lock:
             self.steps += n_steps
-            self.tokens += n_tokens
             self.batches += 1
             self.batch_size_hist[n_steps] = \
                 self.batch_size_hist.get(n_steps, 0) + 1
-        self.c_tokens.inc(n_tokens)
         self.c_batches.inc()
 
     def snapshot(self) -> dict:
@@ -166,9 +179,10 @@ class DecodeSession:
 
 class _PendingStep:
     __slots__ = ("session", "xs", "masks", "future", "t_enqueue",
-                 "deadline", "tenant")
+                 "deadline", "tenant", "ctx")
 
-    def __init__(self, session, xs, masks, future, deadline, tenant):
+    def __init__(self, session, xs, masks, future, deadline, tenant,
+                 ctx=None):
         self.session = session
         self.xs = xs          # tuple of per-input [T, ...] host arrays
         self.masks = masks    # tuple of per-input [T] masks or None
@@ -176,6 +190,13 @@ class _PendingStep:
         self.t_enqueue = time.perf_counter()
         self.deadline = deadline  # absolute time.monotonic(), or None
         self.tenant = tenant
+        # trace context captured at enqueue (request_id etc) — the
+        # batcher thread re-attaches it to this step's journal events
+        self.ctx = ctx or {}
+
+    @property
+    def request_id(self):
+        return self.ctx.get("request_id")
 
 
 def _pool_step_raw(model, is_graph: bool):
@@ -266,15 +287,19 @@ class DecodePool:
             self._sweep_locked()
             if not self._free:
                 self.metrics.record_shed("decode_slots_full")
+                events.emit("request.shed", severity="warn",
+                            reason="decode_slots_full", model=self.name)
                 raise OverloadedError(
                     f"decode slots exhausted ({self.max_slots} sessions "
                     "active)", retry_after_s=retry_after_s)
             slot = self._free.pop()
             sid = uuid.uuid4().hex[:16]
             self._sessions[sid] = DecodeSession(sid, slot, tenant)
-            self.metrics.c_opened.inc()
+            self.metrics.record_opened(tenant)
             self.metrics.g_active.set(len(self._sessions))
-            return sid
+        events.emit("decode.session_opened", model=self.name,
+                    session_id=sid, slot=slot, tenant=tenant)
+        return sid
 
     def close_session(self, sid: str, reason: str = "closed") -> bool:
         with self._cond:
@@ -295,6 +320,11 @@ class DecodePool:
                                  "with steps still queued"))
         self.metrics.record_closed(reason)
         self.metrics.g_active.set(len(self._sessions))
+        events.emit("decode.session_closed", model=self.name,
+                    session_id=sid, slot=s.slot, tenant=s.tenant,
+                    reason=reason, steps=s.steps,
+                    severity="warn" if reason in ("batcher_died", "error")
+                    else "info")
         return True
 
     def _sweep_locked(self, now: Optional[float] = None) -> int:
@@ -342,14 +372,19 @@ class DecodePool:
             s = self._sessions.get(sid)
             if s is None:
                 raise KeyError(f"unknown or expired decode session {sid!r}")
+            restarted = False
             if self._dead or not self._thread.is_alive():
                 self._dead = False
                 self.restarts += 1
                 self._thread = self._spawn_thread()
+                restarted = True
             p = _PendingStep(s, xs, masks, fut, deadline,
-                             tenant if tenant is not None else s.tenant)
+                             tenant if tenant is not None else s.tenant,
+                             ctx=events.current_context())
             self._queue.append(p)
             self._cond.notify_all()
+        if restarted:
+            events.emit("decode.restarted", model=self.name)
         return fut
 
     def step(self, sid: str, xs, masks=None, timeout: Optional[float] = 60.0,
@@ -509,9 +544,11 @@ class DecodePool:
         closes every session (their device carries may be invalid — the
         pool buffer is donated into the step) and reclaims the slots;
         the next submit restarts the thread."""
+        death_err = None
         try:
             self._loop()
         except BaseException as e:
+            death_err = e
             log.error("decode batcher %r thread died: %s: %s",
                       self.name, type(e).__name__, e)
         finally:
@@ -533,6 +570,20 @@ class DecodePool:
                         p.future.set_exception(RuntimeError(
                             "decode batcher thread died; session state "
                             "lost — reopen the session and replay"))
+                # black box: which sessions/tenants/requests were in
+                # flight when the decode thread died, then the dump
+                rids = [p.request_id for p in stranded if p.request_id]
+                sids = sorted({p.session.sid for p in stranded})
+                events.emit(
+                    "decode.died", severity="error", model=self.name,
+                    error=(f"{type(death_err).__name__}: {death_err}"
+                           if death_err is not None else "unknown"),
+                    stranded=len(stranded), session_ids=sids or None,
+                    request_ids=rids or None)
+                flight.dump("decode_batcher_died", extra={
+                    "pool": self.name, "stranded_request_ids": rids,
+                    "stranded_session_ids": sids,
+                    "error": repr(death_err)})
 
     def _loop(self) -> None:
         while True:
@@ -596,6 +647,10 @@ class DecodePool:
         for p in taken:
             if p.deadline is not None and now >= p.deadline:
                 self.metrics.record_shed("deadline")
+                events.emit("request.shed", severity="warn",
+                            reason="deadline", model=self.name,
+                            session_id=p.session.sid,
+                            request_id=p.request_id, tenant=p.tenant)
                 if not p.future.done():
                     p.future.set_exception(DeadlineExceededError(
                         "decode step deadline expired while queued "
@@ -632,6 +687,16 @@ class DecodePool:
                 for s in st]
 
     def _dispatch(self, group: List[_PendingStep]) -> None:
+        # the ONE compute dispatch is linked to the joined sessions'
+        # step requests: their request IDs ride the batcher thread's
+        # trace context, so the serve/decode spans (and any injected
+        # fault) journal with the coalesced correlation set
+        rids = [p.request_id for p in group if p.request_id]
+        with events.scope(model=self.name or None,
+                          request_ids=rids or None):
+            self._dispatch_traced(group)
+
+    def _dispatch_traced(self, group: List[_PendingStep]) -> None:
         t_dispatch = time.perf_counter()
         compute_entered = False
         try:
@@ -707,10 +772,18 @@ class DecodePool:
                 p.session.steps += 1
                 p.session.last_used = now
                 p.future.set_result(tuple(o[r] for o in sliced))
-                self.metrics.record_step(p.tenant)
+                self.metrics.record_step(p.tenant, n_tokens=T)
                 self.metrics.h_queue.observe(t_dispatch - p.t_enqueue)
                 self.metrics.h_step.observe(t1 - t0)
-            self.metrics.record_batch(K, K * T)
+                # every step event carries session ID + slot + tenant
+                # (and the request ID captured at enqueue) — per-stream
+                # attribution for "which tenant's sessions were in the
+                # batch that NaN'd"
+                events.emit("decode.step", model=self.name,
+                            session_id=p.session.sid, slot=p.session.slot,
+                            tenant=p.tenant, request_id=p.request_id,
+                            tokens=T, step=p.session.steps)
+            self.metrics.record_batch(K)
         except Exception as e:
             for p in group:
                 if not p.future.done():

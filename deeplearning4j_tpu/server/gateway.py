@@ -42,6 +42,7 @@ from typing import Optional
 import numpy as np
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import events, flight
 from deeplearning4j_tpu.resilience import (
     CircuitBreaker, CircuitOpenError, OverloadedError, RetryPolicy, faults)
 from deeplearning4j_tpu.resilience.errors import DeadlineExceededError
@@ -103,6 +104,7 @@ class DeepLearning4jEntryPoint:
         self._t_start = time.time()
         self._batchers: dict = {}
         self._batcher_lock = threading.Lock()
+        self._last_ready: Optional[bool] = None
         self._c_shed = monitor.get_registry().counter(
             "dl4j_resilience_shed_total",
             "requests shed instead of served", labels=("reason",))
@@ -198,6 +200,17 @@ class DeepLearning4jEntryPoint:
         returns class ids; ``top_k=K`` returns the K best class ids +
         probabilities per row — both avoid serializing the full
         ``[n, n_classes]`` probability matrix to JSON."""
+        # request-scoped tracing: reuse the request ID the HTTP server
+        # minted for this RPC (or mint one for direct in-process calls)
+        # so admission, the batcher queue and the coalesced compute all
+        # journal under the same correlation ID
+        with events.request_scope(tenant=tenant,
+                                  model=os.path.basename(str(model_path))):
+            return self._predict(model_path, data_dir, features, top_k,
+                                 argmax_only, coalesce, deadline_ms, tenant)
+
+    def _predict(self, model_path, data_dir, features, top_k,
+                 argmax_only, coalesce, deadline_ms, tenant) -> dict:
         faults.check("gateway.predict")
         if (data_dir is None) == (features is None):
             raise ValueError(
@@ -270,7 +283,9 @@ class DeepLearning4jEntryPoint:
         every subsequent :meth:`decode_step` is O(1) in how much of the
         stream has already been consumed.  503 + Retry-After when every
         slot is held by a live session."""
-        return self.decode.open_session(model_path, tenant=tenant)
+        with events.request_scope(
+                tenant=tenant, model=os.path.basename(str(model_path))):
+            return self.decode.open_session(model_path, tenant=tenant)
 
     def decode_step(self, session_id: str, features,
                     mask=None, tenant: Optional[str] = None,
@@ -284,10 +299,11 @@ class DeepLearning4jEntryPoint:
         control and per-tenant fair share apply exactly as for
         ``predict`` (one step = one queue row, matching the decode
         queue's accounting)."""
-        self._admit(1, tenant=tenant)
-        outs = self.decode.decode_step(
-            session_id, features, mask=mask, timeout_ms=deadline_ms,
-            tenant=tenant)
+        with events.request_scope(tenant=tenant, session_id=session_id):
+            self._admit(1, tenant=tenant)
+            outs = self.decode.decode_step(
+                session_id, features, mask=mask, timeout_ms=deadline_ms,
+                tenant=tenant)
         result = self._format_predictions(outs[0], top_k, argmax_only)
         if len(outs) > 1:
             result["outputs"] = [np.asarray(o).tolist() for o in outs]
@@ -318,6 +334,8 @@ class DeepLearning4jEntryPoint:
         depth = self._queued_rows()
         if depth + n_rows > self.max_queue_rows:
             self._c_shed.labels(reason="queue_full").inc()
+            events.emit("request.shed", severity="warn",
+                        reason="queue_full", rows=n_rows, queued=depth)
             raise OverloadedError(
                 f"queue full ({depth} rows waiting, limit "
                 f"{self.max_queue_rows})", retry_after_s=self.retry_after_s)
@@ -326,10 +344,13 @@ class DeepLearning4jEntryPoint:
             held = self._tenant_queued_rows().get(t, 0)
             if held + n_rows > self.tenant_quota_rows:
                 self._c_shed.labels(reason="tenant_quota").inc()
+                events.emit("request.shed", severity="warn",
+                            reason="tenant_quota", rows=n_rows, queued=held)
                 raise OverloadedError(
                     f"tenant {t!r} over fair-share quota ({held} rows "
                     f"queued, limit {self.tenant_quota_rows})",
                     retry_after_s=self.retry_after_s)
+        events.emit("request.admitted", rows=n_rows, queued=depth)
 
     def _queued_rows(self) -> int:
         with self._batcher_lock:
@@ -380,7 +401,18 @@ class DeepLearning4jEntryPoint:
             "models_warm": len(cache_stats["models"])
                            >= self.min_ready_models,
         }
-        return {"ready": all(checks.values()), "checks": checks,
+        ready = all(checks.values())
+        # a flip to not-ready is a crash-adjacent moment: journal it and
+        # snapshot the black box while the evidence is still in the ring
+        if self._last_ready is not None and ready != self._last_ready:
+            failing = sorted(k for k, v in checks.items() if not v)
+            events.emit("readyz.flip", severity="warn" if not ready
+                        else "info", ready=ready, failing=failing)
+            if not ready:
+                flight.dump("readyz_not_ready",
+                            extra={"checks": checks, "queued_rows": queued})
+        self._last_ready = ready
+        return {"ready": ready, "checks": checks,
                 "queued_rows": queued,
                 "models_resident": cache_stats["size"],
                 "models_warmed": warm}
@@ -421,6 +453,35 @@ class DeepLearning4jEntryPoint:
                              f"got {format!r}")
         return {"content_type": monitor.CONTENT_TYPE,
                 "body": monitor.render_prometheus(snap)}
+
+    def trace_dump(self, last_n: Optional[int] = None,
+                   format: str = "events", request_id: Optional[str] = None,
+                   dump: bool = False, reason: str = "manual") -> dict:
+        """Live access to the structured event journal (the flight
+        recorder's source).  ``format="events"`` (default) returns the
+        newest ``last_n`` journal events (optionally filtered to one
+        ``request_id`` — "what happened to THIS request");
+        ``format="chrome"`` returns the Chrome trace-event export under
+        ``trace`` (save ``.trace`` to a file and open it in Perfetto /
+        ``chrome://tracing`` to see a serving burst or a slow fit epoch
+        as real slices).  ``dump=True`` also writes a flight-recorder
+        file and returns its path."""
+        fmt = str(format).lower()
+        if fmt not in ("events", "chrome"):
+            raise ValueError(f"format must be events or chrome, got "
+                             f"{format!r}")
+        journal = events.get_journal()
+        evts = journal.tail(n=last_n, request_id=request_id)
+        out: dict = {"count": len(evts),
+                     "total_emitted": journal.total_emitted,
+                     "dropped": journal.dropped}
+        if dump:
+            out["path"] = flight.dump(reason, force=True)
+        if fmt == "chrome":
+            out["trace"] = events.chrome_trace(evts)
+        else:
+            out["events"] = evts
+        return out
 
     def close(self) -> None:
         """Stop all batcher threads and decode pools (server
@@ -534,12 +595,30 @@ class Server:
                 """The probe surfaces a stock scraper / load balancer /
                 ``curl`` hits without JSON-RPC framing: ``/metrics``
                 (Prometheus text), ``/healthz`` (liveness, always 200
-                while the process answers) and ``/readyz`` (readiness —
+                while the process answers), ``/readyz`` (readiness —
                 503 while shedding/unwarm/breaker-open, so an LB drains
-                this replica instead of feeding it)."""
-                path = self.path.split("?", 1)[0]
+                this replica instead of feeding it) and ``/trace`` (the
+                live event journal; ``?format=chrome`` returns the
+                Perfetto-loadable Chrome trace-event export directly,
+                ``?request_id=`` filters to one request's events)."""
+                path, _, query = self.path.partition("?")
                 try:
-                    if path == "/metrics":
+                    if path == "/trace":
+                        from urllib.parse import parse_qs
+                        q = {k: v[-1] for k, v in parse_qs(query).items()}
+                        fmt = q.get("format", "events")
+                        last_n = (int(q["last_n"]) if "last_n" in q
+                                  else None)
+                        r = ep.trace_dump(last_n=last_n, format=fmt,
+                                          request_id=q.get("request_id"))
+                        # chrome format serves the bare trace object so
+                        # the response body IS a Perfetto-loadable file
+                        body = r["trace"] if fmt == "chrome" else r
+                        server._count_request("GET /trace", 200)
+                        self._respond(
+                            200, json.dumps(body, default=str).encode(),
+                            "application/json")
+                    elif path == "/metrics":
                         m = ep.metrics()
                         server._count_request("GET /metrics", 200)
                         self._respond(200, m["body"].encode(),
@@ -566,17 +645,32 @@ class Server:
             def do_POST(self):
                 method = ""
                 headers = {}
+                # the gateway mints the trace/request ID: every event
+                # this RPC produces (admission, batcher queue, coalesced
+                # compute, decode step) journals under it, and the
+                # client gets it back for support-ticket correlation
+                rid = events.new_request_id()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")
                     method = req.get("method", "")
+                    params = req.get("params", {})
+                    if not isinstance(params, dict):
+                        raise ValueError("params must be an object")
                     if method.startswith("_") or not hasattr(ep, method):
                         raise AttributeError(f"no method {method!r}")
-                    result = getattr(ep, method)(**req.get("params", {}))
-                    payload = json.dumps({"result": result}).encode()
+                    with events.scope(request_id=rid, method=method,
+                                      tenant=params.get("tenant")):
+                        events.emit("rpc.request")
+                        result = getattr(ep, method)(**params)
+                        events.emit("rpc.response", code=200)
+                    payload = json.dumps({"result": result,
+                                          "request_id": rid},
+                                         default=str).encode()
                     code = 200
                 except Exception as e:
-                    err = {"error": f"{type(e).__name__}: {e}"}
+                    err = {"error": f"{type(e).__name__}: {e}",
+                           "request_id": rid}
                     # resilience errors carry their HTTP semantics:
                     # shed/short-circuited → 503 + Retry-After (back
                     # off, come back), expired deadline → 504
@@ -591,7 +685,11 @@ class Server:
                         code = 500
                         if server.debug:
                             err["traceback"] = traceback.format_exc()
+                    with events.scope(request_id=rid, method=method or "?"):
+                        events.emit("rpc.response", severity="warn",
+                                    code=code, error=type(e).__name__)
                     payload = json.dumps(err).encode()
+                headers["X-DL4J-Request-ID"] = rid
                 server._count_request(method or "?", code)
                 self._respond(code, payload, "application/json", headers)
 

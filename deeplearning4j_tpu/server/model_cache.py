@@ -30,6 +30,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import events
 from deeplearning4j_tpu.resilience import faults
 
 
@@ -145,8 +146,10 @@ class ModelCache:
                      "loaded_at": time.time()}
                 self._entries[key] = e
                 while len(self._entries) > self.capacity:
-                    self._entries.popitem(last=False)
+                    evicted, _ = self._entries.popitem(last=False)
                     self._count("evictions")
+                    events.emit("cache.evicted",
+                                model=os.path.basename(evicted))
             self._g_resident.set(len(self._entries))
             if warmup_dims is not None and e["warmup"] is None \
                     and hasattr(e["model"], "warmup_inference"):
@@ -199,10 +202,15 @@ class ModelCache:
                 self._count("stale_reloads")
                 self.rollouts += 1
             self._c_rollouts.inc()
-        except Exception:
+            events.emit("rollout.flip", model=os.path.basename(key),
+                        mtime_ns=new_mtime)
+        except Exception as ex:
             with self._lock:
                 self.rollout_failures += 1
             self._c_rollout_failures.inc()
+            events.emit("rollout.failed", severity="error",
+                        model=os.path.basename(key),
+                        error=f"{type(ex).__name__}: {ex}")
         finally:
             with self._lock:
                 self._rollouts.pop(key, None)
@@ -223,9 +231,20 @@ class ModelCache:
                 return attempt()
             return self.load_retry.call(attempt)
 
-        if self.load_breaker is None:
-            return with_retry()
-        return self.load_breaker.call(with_retry)
+        t0 = time.perf_counter()
+        try:
+            if self.load_breaker is None:
+                model = with_retry()
+            else:
+                model = self.load_breaker.call(with_retry)
+        except BaseException as e:
+            events.emit("cache.load", severity="error",
+                        model=os.path.basename(key), ok=False,
+                        error=f"{type(e).__name__}: {e}")
+            raise
+        events.emit("cache.load", model=os.path.basename(key), ok=True,
+                    duration_s=round(time.perf_counter() - t0, 6))
+        return model
 
     def peek(self, path):
         """The cached model if (and only if) it is resident and fresh —

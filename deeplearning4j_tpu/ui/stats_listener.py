@@ -181,5 +181,11 @@ class StatsListener(IterationListener):
                 params=params, gradients=grads, updates=updates,
                 perf=perf, memory=memory)
             self.router.put_update(report.to_record())
+            # the UI post joins the event timeline: a dashboard gap can
+            # be correlated against the fit/serve events around it (the
+            # listener's session_id is the UI-side correlation key)
+            monitor.events.emit("ui.stats_posted",
+                                ui_session=self.session_id,
+                                iteration=iteration)
             self._last_params = cur if self.collect_histograms else None
         self._last_time = now

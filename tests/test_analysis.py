@@ -336,6 +336,88 @@ def test_metric_drift_test_files_exempt_from_301(tmp_path):
     assert findings == []
 
 
+_EVENT_DOCS = """
+    # Observability
+
+    | Metric | Type | Labels | Meaning |
+    |---|---|---|---|
+    | `dl4j_good_total` | counter | — | unrelated metric row |
+
+    ## Tracing & flight recorder
+
+    ### Event taxonomy
+
+    | Event | Severity | Key fields | Emitted when |
+    |---|---|---|---|
+    | `request.done` | info | `request_id` | a request completed |
+    | `batcher.died` | error | `error` | declared-only, still valid |
+    | `ghost.event` | info | — | documented, never emitted |
+
+    ## Next section
+
+    Dotted names outside the taxonomy section — prose like
+    `conf.shape_bucketing` or this table — must NOT count as rows:
+
+    | `prose.outside_section` | not a taxonomy row |
+"""
+
+
+def test_event_drift_both_directions(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        EVENT_TYPES = ("request.done", "batcher.died")
+
+        def wire(journal):
+            journal.emit("request.done", request_id="r1")
+            journal.emit("rogue.event", oops=True)
+    """}, docs=_EVENT_DOCS, rules=["DL4J303", "DL4J304"])
+    by_rule = {f.rule: f for f in findings}
+    assert set(by_rule) == {"DL4J303", "DL4J304"}
+    assert "rogue.event" in by_rule["DL4J303"].message
+    assert "ghost.event" in by_rule["DL4J304"].message
+    # prose outside the taxonomy section never reaches the stale check
+    assert "prose.outside_section" not in by_rule["DL4J304"].message
+
+
+def test_event_drift_declared_but_unemitted_type_must_be_documented(
+        tmp_path):
+    # batcher.died is declared in EVENT_TYPES (not emitted) and
+    # documented — no finding in either direction for it; an
+    # UNdocumented declared type is a DL4J303 hit
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        EVENT_TYPES = ("request.done", "batcher.died", "secret.type")
+
+        def wire(journal):
+            journal.emit("request.done")
+    """}, docs=_EVENT_DOCS, rules=["DL4J303"])
+    assert len(findings) == 1
+    assert "secret.type" in findings[0].message
+
+
+def test_event_drift_test_files_and_plain_strings_exempt(tmp_path):
+    findings, _ = run_lint(tmp_path, {
+        "test_m.py": """
+            def probe(journal):
+                journal.emit("adhoc.test_event")
+        """,
+        "m.py": """
+            def other(queue):
+                # non-dotted first args are not event emits
+                queue.emit("not_an_event_name")
+                queue.emit(123)
+        """}, docs=_EVENT_DOCS, rules=["DL4J303"])
+    assert findings == []
+
+
+def test_event_doc_rule_silent_without_journal_code(tmp_path):
+    # a project with no emits and no EVENT_TYPES has nothing to drift:
+    # the taxonomy table alone must not fail DL4J304
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        def plain():
+            return 1
+    """}, docs=_EVENT_DOCS, rules=["DL4J304"])
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # Pragmas, baseline, CLI
 # ----------------------------------------------------------------------
