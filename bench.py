@@ -1536,6 +1536,121 @@ def bench_decode():
     }
 
 
+def bench_fleet():
+    """Fleet scaling A/B (ROADMAP 3 → the fleet tier): K closed-loop
+    decode clients streaming through the consistent-hash
+    ``SessionRouter`` against 1 vs 2 gateway replicas (in-process HTTP
+    servers — real wire hops, localhost transport).  Reports routed
+    tokens/sec per leg with window variance, p50/p99 routed step
+    latency, and the 2-vs-1 scaling ratio.  On a 1-core CPU box the
+    replicas share the core, so the scaling ratio mostly measures
+    router overhead; on real hardware (one chip per replica) it is the
+    horizontal-scale headline."""
+    import tempfile
+
+    from deeplearning4j_tpu.fleet import SessionRouter
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.serialization import write_model
+    from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+
+    F, H, K, STEPS = 16, 96, 4, 24
+    conf = (NeuralNetConfiguration.builder().seed(11).learning_rate(0.01)
+            .shape_bucketing(True)
+            .list()
+            .layer(L.GravesLSTM(n_in=F, n_out=H, activation="tanh"))
+            .layer(L.RnnOutputLayer(n_in=H, n_out=F, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    path = os.path.join(tempfile.mkdtemp(prefix="dl4j_bench_fleet_"),
+                        "lstm.zip")
+    write_model(MultiLayerNetwork(conf).init(), path)
+    rng = np.random.default_rng(31)
+    x = rng.normal(size=(K, STEPS, F)).astype(np.float32)
+
+    def leg(n_replicas):
+        servers = [Server(DeepLearning4jEntryPoint(
+            decode_slots=2 * K, max_wait_ms=1.0), port=0).start()
+            for _ in range(n_replicas)]
+        router = SessionRouter()
+        for i, s in enumerate(servers):
+            router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+        try:
+            sids = [router.open_session(path)["session_id"]
+                    for _ in range(K)]
+            lat_lock = threading.Lock()
+
+            def run_client(ci, sid, n_steps, lats=None):
+                for t in range(n_steps):
+                    t0 = time.perf_counter()
+                    router.decode_step(sid, x[ci, t % STEPS:
+                                              t % STEPS + 1].tolist())
+                    if lats is not None:
+                        dt = time.perf_counter() - t0
+                        with lat_lock:
+                            lats.append(dt)
+
+            def round_trip(n_steps, collect):
+                lats = [] if collect else None
+                threads = [threading.Thread(
+                    target=run_client, args=(i, sid, n_steps, lats))
+                    for i, sid in enumerate(sids)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=600)
+                return time.perf_counter() - t0, lats
+
+            round_trip(2, collect=False)   # compile + route warm, off-clock
+            times, all_lats = [], []
+            for _ in range(WINDOWS):
+                wall, lats = round_trip(STEPS, collect=True)
+                times.append(wall)
+                all_lats.extend(lats)
+            for sid in sids:
+                router.close_session(sid)
+            all_lats.sort()
+
+            def pct(p):
+                return round(
+                    all_lats[min(len(all_lats) - 1,
+                                 int(p * (len(all_lats) - 1)))] * 1e3, 3)
+            out = window_stats(times, K, STEPS)
+            out.update({
+                "replicas": n_replicas,
+                "clients": K,
+                "routed_p50_ms": pct(0.50),
+                "routed_p99_ms": pct(0.99),
+                "router": {k: v for k, v in router.stats().items()
+                           if k in ("sessions_lost",)},
+            })
+            return out
+        finally:
+            for s in servers:
+                s.stop()
+
+    one = leg(1)
+    two = leg(2)
+    scaling = (two["items_per_sec_median"]
+               / max(one["items_per_sec_median"], 1e-9))
+    return {
+        "metric": f"routed decode tokens/sec through the fleet router, "
+                  f"{K} closed-loop clients, 2 replicas",
+        "value": round(two["items_per_sec_median"], 1),
+        "unit": "tokens/sec",
+        "one_replica": one,
+        "two_replicas": two,
+        "scaling_2v1": round(scaling, 3),
+        "routed_p99_ms": two["routed_p99_ms"],
+        **{k: v for k, v in two.items()
+           if k.startswith("items_per_sec") or k in (
+               "window_rel_spread", "best_of", "window_sec",
+               "steps_per_window")},
+    }
+
+
 def bench_sharded_serving(n_chips):
     """Sharded-inference A/B (ROADMAP 3a): the same wide-MLP ``output()``
     replica-style vs under ``conf.sharding(data=1, fsdp=n_chips)`` — the
@@ -1875,6 +1990,7 @@ def _run_configs(result):
         ("bench_pipeline", bench_pipeline),
         ("bench_serving", bench_serving),
         ("bench_decode", bench_decode),
+        ("bench_fleet", bench_fleet),
         ("bench_resilience", bench_resilience),
         ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
         ("bench_sharded_serving", lambda: bench_sharded_serving(n_chips)),
@@ -1906,9 +2022,9 @@ def _run_configs(result):
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
                  "bench_kernels", "bench_pipeline", "bench_serving",
-                 "bench_decode", "bench_resilience", "bench_sharded",
-                 "bench_sharded_serving", "charrnn", "word2vec",
-                 "vgg16", "resnet50"]
+                 "bench_decode", "bench_fleet", "bench_resilience",
+                 "bench_sharded", "bench_sharded_serving", "charrnn",
+                 "word2vec", "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])
                          if nv[0] in order else len(order))
         if os.environ.get("DL4J_BENCH_SCAN") == "1":

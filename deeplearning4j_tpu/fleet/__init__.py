@@ -1,0 +1,27 @@
+"""Serving fleet tier (ROADMAP item 3; docs/FLEET.md): a
+consistent-hash session router over N gateway replicas, live
+cross-replica session migration on the compiled-carry contract, health
+supervision, and drain-free blue/green rollout.
+
+The stack, bottom-up::
+
+    server/decode.py   DecodePool.export_session / import_session —
+                       a session's carry slice as a relocatable object
+    server/gateway.py  the per-replica RPC surface (+ drain/undrain)
+    fleet/ring.py      weighted-vnode consistent-hash placement
+    fleet/client.py    the router→replica hop (request-ID propagated)
+    fleet/router.py    SessionRouter — routing, failover, migration,
+                       fleet-wide admission
+    fleet/manager.py   FleetManager — health polling through breakers,
+                       drain-free rollout orchestration
+"""
+
+from deeplearning4j_tpu.fleet.client import (
+    ReplicaClient, ReplicaError, ReplicaUnavailableError)
+from deeplearning4j_tpu.fleet.manager import FleetManager
+from deeplearning4j_tpu.fleet.ring import HashRing
+from deeplearning4j_tpu.fleet.router import SessionLostError, SessionRouter
+
+__all__ = ["HashRing", "ReplicaClient", "ReplicaError",
+           "ReplicaUnavailableError", "SessionRouter", "SessionLostError",
+           "FleetManager"]

@@ -1,0 +1,123 @@
+"""HTTP JSON-RPC client for one gateway replica — the router→replica
+hop (docs/FLEET.md).
+
+Speaks exactly the wire protocol ``server/gateway.Server`` serves
+(``POST / {"method", "params"}`` plus the bare ``GET`` probe surfaces),
+and maps the gateway's HTTP error semantics back onto the resilience
+taxonomy so the router composes with ``resilience.policy``:
+
+* connection-level failures (refused, reset, timeout) →
+  :class:`ReplicaUnavailableError` — a ``TransientError``, so a
+  ``RetryPolicy`` retries it (on the next candidate replica);
+* 503 → :class:`OverloadedError` carrying the replica's ``Retry-After``;
+* 504 → :class:`DeadlineExceededError`;
+* anything else → :class:`ReplicaError` with the replica's error string.
+
+**Trace propagation** (the PR-10 satellite): every call forwards the
+``request_id`` already in scope as ``X-DL4J-Request-ID``; the replica's
+gateway ADOPTS it instead of minting its own, so one ``request_scope``
+correlates the full cross-replica flow in either side's ``GET /trace``.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.resilience.errors import (
+    DeadlineExceededError, OverloadedError, TransientError)
+
+
+class ReplicaError(RuntimeError):
+    """The replica answered with an application error (HTTP 4xx/5xx
+    other than the overload/deadline family)."""
+
+    def __init__(self, message: str, code: int = 500,
+                 method: str = "?"):
+        super().__init__(message)
+        self.code = int(code)
+        self.method = method
+
+
+class ReplicaUnavailableError(TransientError):
+    """The replica could not be reached at all (connection refused /
+    reset / timed out) — retryable, typically on another replica."""
+
+
+class ReplicaClient:
+    """Thin blocking JSON-RPC client bound to one replica base URL."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def __repr__(self):
+        return f"ReplicaClient({self.base_url!r})"
+
+    def call(self, method: str, params: Optional[dict] = None,
+             timeout_s: Optional[float] = None):
+        """One RPC round trip; returns the replica's ``result``."""
+        body = json.dumps({"method": method,
+                           "params": params or {}}).encode()
+        headers = {"Content-Type": "application/json"}
+        rid = events.current_context().get("request_id")
+        if rid:
+            headers["X-DL4J-Request-ID"] = str(rid)
+        req = urllib.request.Request(self.base_url + "/", data=body,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s) as r:
+                return json.loads(r.read()).get("result")
+        except urllib.error.HTTPError as e:
+            raise self._map_http_error(e, method) from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.base_url} unreachable for {method!r}: "
+                f"{getattr(e, 'reason', e)}") from None
+
+    @staticmethod
+    def _map_http_error(e: "urllib.error.HTTPError",
+                        method: str) -> Exception:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except Exception:
+            payload = {}
+        msg = payload.get("error") or f"HTTP {e.code}"
+        if e.code == 503:
+            try:
+                retry_after = float(payload.get(
+                    "retry_after_s",
+                    e.headers.get("Retry-After", 1.0) or 1.0))
+            except (TypeError, ValueError):
+                retry_after = 1.0
+            return OverloadedError(msg, retry_after_s=retry_after)
+        if e.code == 504:
+            return DeadlineExceededError(msg)
+        return ReplicaError(msg, code=e.code, method=method)
+
+    def get_json(self, path: str,
+                 timeout_s: Optional[float] = None) -> Tuple[int, dict]:
+        """A bare GET probe (``/healthz``, ``/readyz``, ``/trace``,
+        ...); returns ``(status_code, parsed_body)``.  A 503 readyz is
+        a VALID answer, not an exception — only transport failures
+        raise (:class:`ReplicaUnavailableError`)."""
+        url = self.base_url + "/" + path.lstrip("/")
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=timeout_s or self.timeout_s) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except Exception:
+                return e.code, {}
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.base_url} unreachable for GET {path}: "
+                f"{getattr(e, 'reason', e)}") from None

@@ -1,0 +1,194 @@
+"""FleetManager — replica supervision for the fleet tier
+(docs/FLEET.md): registration, health, rebalancing, and the drain-free
+rollout orchestration.
+
+* **Health**: a poll loop hits every replica's ``/readyz`` through a
+  per-replica :class:`~deeplearning4j_tpu.resilience.CircuitBreaker`
+  (a replica that keeps failing probes is short-circuited for the
+  cooldown instead of eating a connect timeout per tick).  Verdicts
+  flow into the router (``mark_ready``): an unready replica stops
+  taking placements; an UNREACHABLE one additionally loses its
+  sessions (their carries died with it) so clients fail cleanly and
+  reopen instead of hanging.
+
+* **Drain-free rollout** (:meth:`rollout`): per replica —
+  park it off the ring → ``drain`` RPC (its gateway sheds new session
+  joins, 503) → migrate its live sessions onto the rest of the fleet →
+  run the caller's roll hook (republish the checkpoint for a
+  blue/green flip, bounce the process, ...) → wait for ``/readyz`` 200
+  → ``undrain`` → back on the ring.  Every session keeps streaming
+  through the whole pass; a final :meth:`SessionRouter.rebalance`
+  shifts the ring's share back.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from deeplearning4j_tpu.fleet.client import ReplicaUnavailableError
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.resilience.errors import CircuitOpenError
+
+
+class FleetManager:
+    """Supervises a :class:`~.router.SessionRouter`'s replicas."""
+
+    def __init__(self, router, poll_interval_s: float = 1.0,
+                 probe_timeout_s: float = 5.0):
+        self.router = router
+        self.poll_interval_s = max(0.05, float(poll_interval_s))
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        router.manager = self
+
+    # ------------------------------------------------------------------
+    # Health polling
+    # ------------------------------------------------------------------
+    def poll_once(self) -> dict:
+        """Probe every replica's ``/readyz`` once, through its breaker.
+        Returns ``{name: ready}``."""
+        out = {}
+        for name in self.router.replica_names():
+            try:
+                rep = self.router._get_replica(name)
+            except KeyError:
+                continue
+            try:
+                code, body = rep.breaker.call(
+                    rep.client.get_json, "readyz",
+                    timeout_s=self.probe_timeout_s)
+                ready = code == 200
+                err = (None if ready else
+                       ",".join(sorted(
+                           k for k, v in (body.get("checks") or {}).items()
+                           if not v)) or f"HTTP {code}")
+                self.router.mark_ready(name, ready, error=err)
+            except CircuitOpenError as e:
+                ready = False
+                self.router.mark_ready(name, False,
+                                       error=f"breaker open: {e}")
+            except ReplicaUnavailableError as e:
+                # transport-dead, not merely unready: sessions are lost
+                ready = False
+                self.router._replica_down(rep, str(e))
+            except Exception as e:
+                ready = False
+                self.router.mark_ready(
+                    name, False, error=f"{type(e).__name__}: {e}")
+            out[name] = ready
+        return out
+
+    def start(self) -> "FleetManager":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="fleet-health-poll")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                pass   # the poll loop must outlive any probe surprise
+            self._stop.wait(self.poll_interval_s)
+
+    # ------------------------------------------------------------------
+    # Drain-free blue/green rollout
+    # ------------------------------------------------------------------
+    def rollout(self, roll: Optional[Callable[[str], None]] = None,
+                wait_ready_s: float = 60.0,
+                rebalance: bool = True) -> dict:
+        """Roll every replica in turn without draining the fleet:
+        sessions are MIGRATED off a replica before it rolls and the
+        ring shifts back afterwards — no client ever loses a stream.
+
+        ``roll(name)`` is the caller's hook that actually rolls the
+        replica (republish the model file so its blue/green
+        ``ModelCache`` flips, restart the process, swap the image, …).
+        ``None`` still exercises the full drain→migrate→ready cycle —
+        the runbook's dry run."""
+        passes = []
+        for name in self.router.replica_names():
+            step = {"replica": name, "migrated": [], "errors": [],
+                    "ready_again": False}
+            try:
+                rep = self.router._get_replica(name)
+            except KeyError:
+                continue
+            # 1. park: no NEW sessions placed here (existing keep going)
+            self.router.set_placement(name, False)
+            try:
+                # 2. the replica itself sheds session joins (covers
+                # clients that talk to it directly, not via the router)
+                try:
+                    rep.client.call("drain", {})
+                except Exception as e:
+                    step["errors"].append(
+                        {"drain": f"{type(e).__name__}: {e}"})
+                # 3. migrate its live sessions onto the rest of the fleet
+                for sid in self.router.sessions_on(name):
+                    try:
+                        self.router.migrate_session(sid, reason="rollout")
+                        step["migrated"].append(sid)
+                    except Exception as e:
+                        step["errors"].append(
+                            {"session_id": sid,
+                             "error": f"{type(e).__name__}: {e}"})
+                # 4. roll it
+                if roll is not None:
+                    roll(name)
+                # 5. wait for the rolled replica to answer ready again
+                step["ready_again"] = self._wait_ready(rep, wait_ready_s)
+                # 6. re-admit session joins
+                try:
+                    rep.client.call("undrain", {})
+                except Exception as e:
+                    step["errors"].append(
+                        {"undrain": f"{type(e).__name__}: {e}"})
+            finally:
+                # 7. back on the ring (even on errors — a parked
+                # replica with no roll applied is still a serving one)
+                self.router.set_placement(name, True)
+            self.router._metrics.c_rollouts.inc()
+            events.emit("fleet.rollout", replica=name,
+                        migrated=len(step["migrated"]),
+                        errors=len(step["errors"]),
+                        ready_again=step["ready_again"])
+            passes.append(step)
+        result = {"replicas": passes}
+        if rebalance:
+            result["rebalance"] = self.router.rebalance(reason="rollout")
+        return result
+
+    def _wait_ready(self, rep, wait_ready_s: float) -> bool:
+        deadline = time.monotonic() + max(0.0, float(wait_ready_s))
+        while time.monotonic() < deadline:
+            try:
+                code, body = rep.client.get_json(
+                    "readyz", timeout_s=self.probe_timeout_s)
+                # drain leaves not_draining=False until undrain — every
+                # OTHER check green is "rolled and healthy"
+                checks = (body.get("checks") or {})
+                others_ok = all(v for k, v in checks.items()
+                                if k != "not_draining")
+                if code == 200 or (checks and others_ok):
+                    self.router.mark_ready(rep.name, True)
+                    return True
+            except ReplicaUnavailableError:
+                pass   # still rolling
+            except Exception:
+                pass
+            time.sleep(0.05)
+        return False

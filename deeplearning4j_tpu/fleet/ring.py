@@ -1,0 +1,98 @@
+"""Consistent-hash ring with weighted virtual nodes — the fleet
+router's placement function (docs/FLEET.md).
+
+Each replica owns ``int(vnodes * weight)`` points on a 64-bit hash
+circle (``blake2b`` of ``"{name}#{i}"``); a key routes to the first
+point clockwise from its own hash.  The consistency property the fleet
+tier leans on: adding or removing one replica moves only the keys whose
+arc changed (~``1/N`` of them) — every other session keeps its owner,
+so a rebalance migrates the minimum set of carries.
+
+Pure data structure: no locks, no I/O.  :class:`SessionRouter` guards
+it with its own lock.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+
+def _hash64(key: str) -> int:
+    """Stable 64-bit position on the circle (NOT Python's ``hash()`` —
+    that is salted per process, and two routers must agree)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Weighted-vnode consistent-hash ring over replica names."""
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self._weights: Dict[str, float] = {}
+        self._points: List[Tuple[int, str]] = []   # sorted (hash, name)
+        self._keys: List[int] = []                 # parallel hash list
+
+    def add(self, name: str, weight: float = 1.0) -> None:
+        """Add (or re-weight) a node: ``int(vnodes * weight)`` points,
+        minimum 1 so a low-weight node still takes traffic."""
+        if name in self._weights:
+            self.remove(name)
+        weight = max(0.0, float(weight))
+        n = max(1, int(round(self.vnodes * weight))) if weight > 0 else 0
+        self._weights[name] = weight
+        for i in range(n):
+            bisect.insort(self._points, (_hash64(f"{name}#{i}"), name))
+        self._keys = [h for h, _ in self._points]
+
+    def remove(self, name: str) -> bool:
+        if name not in self._weights:
+            return False
+        del self._weights[name]
+        self._points = [(h, n) for h, n in self._points if n != name]
+        self._keys = [h for h, _ in self._points]
+        return True
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._weights
+
+    def __len__(self) -> int:
+        return len(self._weights)
+
+    def nodes(self) -> Dict[str, float]:
+        return dict(self._weights)
+
+    def lookup(self, key: str) -> Optional[str]:
+        """The owning node for ``key`` (None on an empty ring)."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._keys, _hash64(key)) % len(self._points)
+        return self._points[i][1]
+
+    def preference(self, key: str, n: Optional[int] = None) -> List[str]:
+        """Distinct nodes in ring order starting at ``key``'s owner —
+        the failover order: the owner first, then each next-closest
+        node.  ``n`` truncates (default: all nodes)."""
+        if not self._points:
+            return []
+        want = len(self._weights) if n is None else min(n,
+                                                        len(self._weights))
+        out: List[str] = []
+        start = bisect.bisect_right(self._keys, _hash64(key))
+        for j in range(len(self._points)):
+            name = self._points[(start + j) % len(self._points)][1]
+            if name not in out:
+                out.append(name)
+                if len(out) >= want:
+                    break
+        return out
+
+    def snapshot(self) -> dict:
+        """Introspection for stats RPCs: weights and point counts."""
+        counts: Dict[str, int] = {}
+        for _, name in self._points:
+            counts[name] = counts.get(name, 0) + 1
+        return {"vnodes": self.vnodes, "nodes": dict(self._weights),
+                "points": counts, "total_points": len(self._points)}

@@ -1,0 +1,807 @@
+"""SessionRouter — the fleet front tier above N gateway replicas
+(ROADMAP item 3; docs/FLEET.md).
+
+Decode sessions were pinned to the one gateway process that opened them
+(``server/decode.py`` slot pools are per-process) — a hard ceiling on
+horizontal scale.  This tier unpins them:
+
+* **Consistent-hash placement** (:class:`~.ring.HashRing`, weighted
+  virtual nodes): ``open_session`` places a new stream on the ring;
+  ``predict`` spreads stateless work the same way.  A replica
+  joining/leaving moves ~1/N of placement keys — the minimum session
+  set migrates on a rebalance.
+
+* **Forwarding with failover**: every RPC forwards over the
+  ``ReplicaClient`` hop (request-ID propagated, so one trace covers the
+  whole flow) through a ``resilience.RetryPolicy`` — an unreachable
+  replica is retried on the next ring candidate for stateless calls;
+  for session-pinned calls it becomes a clean
+  :class:`SessionLostError` (the carry died with the replica), and
+  :meth:`reopen_session` restarts the stream elsewhere — zero client
+  hangs either way.
+
+* **Live migration**: :meth:`migrate_session` moves a RUNNING session
+  between replicas — two-phase export (source holds the slot in limbo)
+  → import (target restores the carry slice) → confirm (source
+  releases).  Used by :meth:`rebalance` when the ring changes and by
+  the ``FleetManager`` rollout so replicas can be rolled drain-free.
+
+* **Fleet admission**: per-tenant quotas aggregated ACROSS replicas —
+  router-side in-flight row counts, 503 + Retry-After
+  (``OverloadedError``) when the fleet-wide quota trips, before any
+  replica sees the request.
+
+The router duck-types the gateway entry-point surface
+(``predict``/``open_session``/``decode_step``/``close_session`` plus
+``healthz``/``readyz``/``metrics``/``stats``/``trace_dump``), so
+``server.Server(SessionRouter(...))`` serves the fleet tier on the same
+wire protocol clients already speak.  Metered as ``dl4j_router_*`` /
+``dl4j_fleet_*`` (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.fleet.client import (
+    ReplicaClient, ReplicaError, ReplicaUnavailableError)
+from deeplearning4j_tpu.fleet.ring import HashRing
+from deeplearning4j_tpu.monitor import events, flight
+from deeplearning4j_tpu.resilience import CircuitBreaker, RetryPolicy
+from deeplearning4j_tpu.resilience.errors import (
+    OverloadedError, TransientError)
+
+
+class SessionLostError(RuntimeError):
+    """A session's owning replica died (or its pool did) before the
+    carry could be migrated — the device state is gone.  Carries enough
+    context for :meth:`SessionRouter.reopen_session` to restart the
+    stream on a live replica (the client replays its prefix)."""
+
+    def __init__(self, session_id: str, replica: Optional[str] = None,
+                 model_path: Optional[str] = None,
+                 tenant: Optional[str] = None):
+        super().__init__(
+            f"decode session {session_id} lost (replica {replica or '?'} "
+            "unreachable) — reopen the session and replay")
+        self.session_id = session_id
+        self.replica = replica
+        self.model_path = model_path
+        self.tenant = tenant
+
+
+class _Replica:
+    __slots__ = ("name", "url", "weight", "client", "breaker", "ready",
+                 "placeable", "last_error", "last_probe")
+
+    def __init__(self, name: str, url: str, weight: float,
+                 client: ReplicaClient, breaker: CircuitBreaker):
+        self.name = name
+        self.url = url
+        self.weight = weight
+        self.client = client
+        self.breaker = breaker
+        self.ready = True          # optimistic until a probe says otherwise
+        self.placeable = True      # on the ring (rollout parks this False)
+        self.last_error: Optional[str] = None
+        self.last_probe: Optional[float] = None
+
+
+class FleetMetrics:
+    """The ``dl4j_router_*`` / ``dl4j_fleet_*`` families."""
+
+    def __init__(self):
+        reg = monitor.get_registry()
+        self.c_requests = reg.counter(
+            "dl4j_router_requests_total",
+            "RPCs forwarded by the fleet router, by outcome",
+            ("method", "replica", "outcome"))
+        self.c_retries = reg.counter(
+            "dl4j_router_retries_total",
+            "router forwards retried on another candidate after a "
+            "replica failure", ("method",))
+        self.g_sessions = reg.gauge(
+            "dl4j_router_sessions",
+            "decode sessions currently tracked by the router")
+        self.g_replicas = reg.gauge(
+            "dl4j_fleet_replicas",
+            "fleet replicas by state (registered >= ready >= placeable)",
+            ("state",))
+        self.c_migrations = reg.counter(
+            "dl4j_fleet_migrations_total",
+            "live session migrations completed, by trigger", ("reason",))
+        self.c_migration_failures = reg.counter(
+            "dl4j_fleet_migration_failures_total",
+            "session migrations that failed (source reinstated or "
+            "session lost)", ("reason",))
+        self.h_migration = reg.histogram(
+            "dl4j_fleet_migration_seconds",
+            "export → import → confirm wall time per migrated session")
+        self.c_lost = reg.counter(
+            "dl4j_fleet_sessions_lost_total",
+            "sessions whose carry died with their replica", ("reason",))
+        self.c_rollouts = reg.counter(
+            "dl4j_fleet_rollouts_total",
+            "drain-free rollout replica passes completed")
+        self.c_shed = reg.counter(
+            "dl4j_resilience_shed_total",
+            "requests shed instead of served", labels=("reason",))
+
+    def replicas(self, registered: int, ready: int, placeable: int):
+        self.g_replicas.labels(state="registered").set(registered)
+        self.g_replicas.labels(state="ready").set(ready)
+        self.g_replicas.labels(state="placeable").set(placeable)
+
+
+class SessionRouter:
+    """Consistent-hash session router over N gateway replicas."""
+
+    def __init__(self, vnodes: int = 32,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 fleet_quota_rows: Optional[int] = None,
+                 max_fleet_rows: int = 4096,
+                 retry_after_s: float = 1.0,
+                 request_timeout_s: float = 60.0,
+                 migrate_timeout_s: float = 30.0):
+        self._lock = threading.RLock()
+        self._migrate_cv = threading.Condition(self._lock)
+        self._replicas: Dict[str, _Replica] = {}
+        self._ring = HashRing(vnodes)
+        #: sid → {"replica", "model_path", "tenant", "key", "lost"}
+        self._sessions: Dict[str, dict] = {}
+        self._migrating: set = set()
+        self._inflight_rows = 0
+        self._tenant_rows: Dict[str, int] = {}
+        self.fleet_quota_rows = (None if fleet_quota_rows is None
+                                 else max(1, int(fleet_quota_rows)))
+        self.max_fleet_rows = max(1, int(max_fleet_rows))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self.request_timeout_s = float(request_timeout_s)
+        self.migrate_timeout_s = float(migrate_timeout_s)
+        # retry ONLY transients (an unreachable replica, a migration
+        # window) — a replica's 503/504 carries backpressure semantics
+        # the client must see, not something to paper over
+        self.retry = retry_policy or RetryPolicy(
+            max_attempts=3, base_delay_ms=20, max_delay_ms=250,
+            retry_on=(TransientError,), name="fleet.route")
+        self._metrics = FleetMetrics()
+        self._seq = itertools.count(1)
+        self._t_start = time.time()
+        self.manager = None   # a FleetManager attaches itself here
+
+    # ------------------------------------------------------------------
+    # Replica registration / ring membership
+    # ------------------------------------------------------------------
+    def add_replica(self, name: str, url: str, weight: float = 1.0,
+                    client: Optional[ReplicaClient] = None) -> None:
+        """Register a gateway replica and put it on the placement ring.
+        ``weight`` scales its share of virtual nodes (a bigger machine
+        takes proportionally more sessions)."""
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            rep = _Replica(
+                name, url, float(weight),
+                client or ReplicaClient(url, timeout_s=self.request_timeout_s),
+                CircuitBreaker(cooldown_s=2.0, min_calls=2, window=6,
+                               name=f"replica.{name}"))
+            self._replicas[name] = rep
+            self._ring.add(name, weight)
+            self._update_replica_gauges_locked()
+        events.emit("fleet.replica_added", replica=name, url=url,
+                    weight=weight)
+
+    def remove_replica(self, name: str, migrate: bool = True) -> dict:
+        """Deregister a replica: leave the ring first (no new
+        placements), migrate its sessions to the rest of the fleet
+        (best effort — failures mark the session lost), then drop it."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            self._ring.remove(name)
+            rep.placeable = False
+            sids = [sid for sid, i in self._sessions.items()
+                    if i["replica"] == name and not i.get("lost")]
+        moved, errors = [], []
+        for sid in sids if migrate else []:
+            try:
+                self.migrate_session(sid, reason="rebalance")
+                moved.append(sid)
+            except Exception as e:
+                errors.append({"session_id": sid,
+                               "error": f"{type(e).__name__}: {e}"})
+        with self._lock:
+            self._replicas.pop(name, None)
+            for sid, i in list(self._sessions.items()):
+                if i["replica"] == name:
+                    i["lost"] = True
+                    self._metrics.c_lost.labels(
+                        reason="replica_removed").inc()
+            self._update_replica_gauges_locked()
+        events.emit("fleet.replica_removed", replica=name,
+                    migrated=len(moved), errors=len(errors))
+        return {"replica": name, "migrated": moved, "errors": errors}
+
+    def set_placement(self, name: str, enabled: bool) -> None:
+        """Ring membership without deregistration — the rollout lever:
+        a parked replica keeps serving its existing sessions but takes
+        no new placements."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                raise KeyError(f"unknown replica {name!r}")
+            rep.placeable = bool(enabled)
+            if enabled and name not in self._ring:
+                self._ring.add(name, rep.weight)
+            elif not enabled:
+                self._ring.remove(name)
+            self._update_replica_gauges_locked()
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return list(self._replicas)
+
+    def sessions_on(self, name: str) -> List[str]:
+        with self._lock:
+            return [sid for sid, i in self._sessions.items()
+                    if i["replica"] == name and not i.get("lost")]
+
+    def _update_replica_gauges_locked(self) -> None:
+        reps = self._replicas.values()
+        self._metrics.replicas(
+            len(self._replicas),
+            sum(1 for r in reps if r.ready),
+            sum(1 for r in reps if r.ready and r.placeable))
+
+    def _get_replica(self, name: str) -> _Replica:
+        with self._lock:
+            rep = self._replicas.get(name)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        return rep
+
+    def _candidates(self, key: str, exclude=()) -> List[_Replica]:
+        """Ready replicas in ring-preference order for ``key`` —
+        the owner first, failover candidates after."""
+        with self._lock:
+            order = self._ring.preference(key)
+            # parked/unready replicas fall out; replicas not on the
+            # ring at all (mid-rollout) are still appended LAST so a
+            # fleet that parked everyone can still serve
+            cands = [self._replicas[n] for n in order
+                     if n in self._replicas
+                     and self._replicas[n].ready
+                     and n not in exclude]
+            extra = [r for n, r in self._replicas.items()
+                     if r.ready and n not in order and n not in exclude]
+        cands += extra
+        if not cands:
+            self._metrics.c_shed.labels(reason="no_ready_replicas").inc()
+            raise OverloadedError("no ready replicas in the fleet",
+                                  retry_after_s=self.retry_after_s)
+        return cands
+
+    def _replica_down(self, rep: _Replica, error: str) -> None:
+        """A transport-level failure: mark the replica unready and its
+        sessions lost (their carries are unreachable — they will fail
+        cleanly, not hang)."""
+        with self._lock:
+            was_ready = rep.ready
+            rep.ready = False
+            rep.last_error = error
+            lost = [sid for sid, i in self._sessions.items()
+                    if i["replica"] == rep.name and not i.get("lost")]
+            for sid in lost:
+                self._sessions[sid]["lost"] = True
+            if lost:
+                self._metrics.c_lost.labels(reason="replica_dead").inc(
+                    len(lost))
+            self._update_replica_gauges_locked()
+        if was_ready:
+            events.emit("fleet.replica_health", severity="warn",
+                        replica=rep.name, ready=False, error=error,
+                        sessions_lost=len(lost))
+
+    def mark_ready(self, name: str, ready: bool,
+                   error: Optional[str] = None) -> None:
+        """Health verdict from the FleetManager's poll loop."""
+        with self._lock:
+            rep = self._replicas.get(name)
+            if rep is None:
+                return
+            flipped = rep.ready != bool(ready)
+            rep.ready = bool(ready)
+            rep.last_error = error
+            rep.last_probe = time.time()
+            self._update_replica_gauges_locked()
+        if flipped:
+            events.emit("fleet.replica_health",
+                        severity="info" if ready else "warn",
+                        replica=name, ready=bool(ready), error=error)
+
+    # ------------------------------------------------------------------
+    # Fleet-wide admission (quotas aggregated across replicas)
+    # ------------------------------------------------------------------
+    def _admit(self, rows: int, tenant: Optional[str]) -> None:
+        t = tenant or "-"
+        with self._lock:
+            if self._inflight_rows + rows > self.max_fleet_rows:
+                self._metrics.c_shed.labels(reason="fleet_queue_full").inc()
+                events.emit("request.shed", severity="warn",
+                            reason="fleet_queue_full", rows=rows,
+                            queued=self._inflight_rows)
+                raise OverloadedError(
+                    f"fleet queue full ({self._inflight_rows} rows in "
+                    f"flight, limit {self.max_fleet_rows})",
+                    retry_after_s=self.retry_after_s)
+            if self.fleet_quota_rows is not None \
+                    and self._tenant_rows.get(t, 0) + rows \
+                    > self.fleet_quota_rows:
+                self._metrics.c_shed.labels(
+                    reason="fleet_tenant_quota").inc()
+                events.emit("request.shed", severity="warn",
+                            reason="fleet_tenant_quota", rows=rows,
+                            queued=self._tenant_rows.get(t, 0))
+                raise OverloadedError(
+                    f"tenant {t!r} over fleet-wide quota "
+                    f"({self._tenant_rows.get(t, 0)} rows in flight "
+                    f"across replicas, limit {self.fleet_quota_rows})",
+                    retry_after_s=self.retry_after_s)
+            self._inflight_rows += rows
+            self._tenant_rows[t] = self._tenant_rows.get(t, 0) + rows
+
+    def _release(self, rows: int, tenant: Optional[str]) -> None:
+        t = tenant or "-"
+        with self._lock:
+            self._inflight_rows = max(0, self._inflight_rows - rows)
+            left = self._tenant_rows.get(t, 0) - rows
+            if left > 0:
+                self._tenant_rows[t] = left
+            else:
+                self._tenant_rows.pop(t, None)
+
+    # ------------------------------------------------------------------
+    # Routed entry-point surface
+    # ------------------------------------------------------------------
+    def predict(self, model_path: str, features=None,
+                tenant: Optional[str] = None,
+                top_k: Optional[int] = None, argmax_only: bool = False,
+                deadline_ms: Optional[float] = None,
+                coalesce: Optional[bool] = None) -> dict:
+        """Stateless inference, spread over the ring and failed over to
+        the next candidate when a replica is unreachable."""
+        if features is None:
+            raise ValueError("router predict needs inline features= "
+                             "(data_dir runs on a specific replica)")
+        rows = max(1, len(features))
+        params = self._params(model_path=model_path, features=features,
+                              tenant=tenant, top_k=top_k,
+                              argmax_only=argmax_only or None,
+                              deadline_ms=deadline_ms, coalesce=coalesce)
+        key = f"predict-{next(self._seq)}"
+        with events.request_scope(tenant=tenant):
+            self._admit(rows, tenant)
+            try:
+                return self._route_spread("predict", params, key)
+            finally:
+                self._release(rows, tenant)
+
+    def open_session(self, model_path: str,
+                     tenant: Optional[str] = None) -> dict:
+        """Place a new decode session on the ring and open it on the
+        owning replica.  The placement key is remembered so a later
+        :meth:`rebalance` knows where the ring NOW says the session
+        belongs."""
+        key = f"session-{next(self._seq)}"
+        params = self._params(model_path=model_path, tenant=tenant)
+        picked: Dict[str, str] = {}
+        with events.request_scope(tenant=tenant):
+            self._admit(1, tenant)
+            try:
+                result = self._route_spread("open_session", params, key,
+                                            picked=picked)
+            finally:
+                self._release(1, tenant)
+        sid = result["session_id"]
+        with self._lock:
+            self._sessions[sid] = {
+                "replica": picked["name"], "model_path": str(model_path),
+                "tenant": tenant, "key": key, "lost": False}
+            self._metrics.g_sessions.set(len(self._sessions))
+        result["replica"] = picked["name"]
+        return result
+
+    def decode_step(self, session_id: str, features, mask=None,
+                    tenant: Optional[str] = None,
+                    deadline_ms: Optional[float] = None,
+                    top_k: Optional[int] = None,
+                    argmax_only: bool = False) -> dict:
+        """One step of a pinned session, routed to its owning replica.
+        A migration in flight is waited out (bounded); an unreachable
+        owner becomes a clean :class:`SessionLostError`."""
+        info = self._session_info(session_id)
+        tenant = tenant if tenant is not None else info.get("tenant")
+        params = self._params(session_id=session_id, features=features,
+                              mask=mask, tenant=tenant,
+                              deadline_ms=deadline_ms, top_k=top_k,
+                              argmax_only=argmax_only or None)
+        with events.request_scope(tenant=tenant, session_id=session_id):
+            self._admit(1, tenant)
+            try:
+                return self.retry.call(self._pinned_attempt,
+                                       "decode_step", session_id, params)
+            finally:
+                self._release(1, tenant)
+
+    def close_session(self, session_id: str) -> dict:
+        """Close a session on its owner; the router mapping is dropped
+        regardless (a dead owner's session is closed by definition)."""
+        with self._lock:
+            info = self._sessions.pop(session_id, None)
+            self._migrating.discard(session_id)
+            self._metrics.g_sessions.set(len(self._sessions))
+        if info is None or info.get("lost"):
+            return {"closed": False}
+        rep = self._get_replica(info["replica"])
+        try:
+            return rep.client.call("close_session",
+                                   {"session_id": session_id})
+        except (ReplicaUnavailableError, ReplicaError):
+            return {"closed": False}
+
+    def reopen_session(self, session_id: str) -> dict:
+        """Restart a LOST session's stream on a live replica: fresh
+        carry (the client replays its prefix), same model and tenant.
+        The fail-and-reopen half of the failover contract."""
+        with self._lock:
+            info = self._sessions.pop(session_id, None)
+            self._migrating.discard(session_id)
+            self._metrics.g_sessions.set(len(self._sessions))
+        if info is None:
+            raise KeyError(f"unknown session {session_id!r}")
+        result = self.open_session(info["model_path"],
+                                   tenant=info.get("tenant"))
+        result["replaced"] = session_id
+        result["carry_lost"] = True
+        return result
+
+    # -- forwarding internals ------------------------------------------
+    @staticmethod
+    def _params(**kw) -> dict:
+        return {k: v for k, v in kw.items() if v is not None}
+
+    def _route_spread(self, method: str, params: dict, key: str,
+                      picked: Optional[dict] = None):
+        """Forward an unpinned RPC to the ring owner of ``key``,
+        failing over to the next candidate (through the retry policy)
+        when a replica is unreachable."""
+        tried: List[str] = []
+
+        def attempt():
+            rep = self._candidates(key, exclude=tried)[0]
+            if tried:
+                self._metrics.c_retries.labels(method=method).inc()
+            try:
+                result = rep.client.call(method, params)
+            except ReplicaUnavailableError as e:
+                tried.append(rep.name)
+                self._metrics.c_requests.labels(
+                    method=method, replica=rep.name,
+                    outcome="unreachable").inc()
+                self._replica_down(rep, str(e))
+                raise
+            except Exception:
+                self._metrics.c_requests.labels(
+                    method=method, replica=rep.name, outcome="error").inc()
+                raise
+            self._metrics.c_requests.labels(
+                method=method, replica=rep.name, outcome="ok").inc()
+            if picked is not None:
+                picked["name"] = rep.name
+            return result
+
+        return self.retry.call(attempt)
+
+    def _pinned_attempt(self, method: str, session_id: str, params: dict):
+        """One forward of a session-pinned RPC (re-resolves the owner
+        so a retry lands on the post-migration replica)."""
+        info = self._session_info(session_id)
+        rep = self._get_replica(info["replica"])
+        try:
+            result = rep.client.call(method, params)
+        except ReplicaUnavailableError as e:
+            self._metrics.c_requests.labels(
+                method=method, replica=rep.name,
+                outcome="unreachable").inc()
+            self._replica_down(rep, str(e))
+            raise SessionLostError(
+                session_id, replica=rep.name,
+                model_path=info.get("model_path"),
+                tenant=info.get("tenant")) from e
+        except ReplicaError as e:
+            self._metrics.c_requests.labels(
+                method=method, replica=rep.name, outcome="error").inc()
+            msg = str(e)
+            if "unknown or expired decode session" in msg:
+                # the replica is alive but the session is gone (TTL,
+                # pool death, confirmed migration we lost track of)
+                self._forget_session(session_id)
+                raise KeyError(msg) from e
+            if "is migrating" in msg:
+                raise TransientError(msg) from e   # retry shortly
+            raise
+        self._metrics.c_requests.labels(
+            method=method, replica=rep.name, outcome="ok").inc()
+        return result
+
+    def _session_info(self, session_id: str) -> dict:
+        """The session's routing record; waits out an in-flight
+        migration (bounded) and converts a lost mapping into
+        :class:`SessionLostError`."""
+        deadline = time.monotonic() + self.migrate_timeout_s
+        with self._migrate_cv:
+            while session_id in self._migrating:
+                if time.monotonic() >= deadline:
+                    raise TransientError(
+                        f"session {session_id} migration did not settle "
+                        f"within {self.migrate_timeout_s}s")
+                self._migrate_cv.wait(0.02)
+            info = self._sessions.get(session_id)
+            if info is None:
+                raise KeyError(
+                    f"unknown or expired decode session {session_id!r}")
+            if info.get("lost"):
+                raise SessionLostError(
+                    session_id, replica=info.get("replica"),
+                    model_path=info.get("model_path"),
+                    tenant=info.get("tenant"))
+            return dict(info)
+
+    def _forget_session(self, session_id: str) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+            self._migrating.discard(session_id)
+            self._metrics.g_sessions.set(len(self._sessions))
+
+    # ------------------------------------------------------------------
+    # Live migration + rebalance
+    # ------------------------------------------------------------------
+    def migrate_session(self, session_id: str,
+                        target: Optional[str] = None,
+                        reason: str = "manual") -> dict:
+        """Move a RUNNING session between replicas: export (source slot
+        held in limbo) → import (target restores the carry) → confirm
+        (source releases).  An import failure reinstates the source —
+        the stream never has zero owners; steps arriving mid-move are
+        rejected retryable and land after the mapping flips."""
+        info = self._session_info(session_id)
+        with self._lock:
+            if session_id in self._migrating:
+                raise TransientError(
+                    f"session {session_id} is already migrating")
+            self._migrating.add(session_id)
+        t0 = time.perf_counter()
+        try:
+            result = self._migrate(session_id, info, target)
+        except BaseException as e:
+            self._metrics.c_migration_failures.labels(reason=reason).inc()
+            events.emit("fleet.migrate_failed", severity="error",
+                        session_id=session_id, replica=info["replica"],
+                        reason=reason,
+                        error=f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            with self._migrate_cv:
+                self._migrating.discard(session_id)
+                self._migrate_cv.notify_all()
+        dt = time.perf_counter() - t0
+        self._metrics.c_migrations.labels(reason=reason).inc()
+        self._metrics.h_migration.observe(dt)
+        events.emit("fleet.migrated", session_id=session_id,
+                    source=result["from"], target=result["to"],
+                    reason=reason, steps=result.get("steps"),
+                    duration_s=round(dt, 4))
+        return result
+
+    def _migrate(self, sid: str, info: dict,
+                 target: Optional[str]) -> dict:
+        src = self._get_replica(info["replica"])
+        tgt = self._pick_target(info, exclude=src.name, target=target)
+        try:
+            payload = src.client.call(
+                "export_session", {"session_id": sid},
+                timeout_s=self.migrate_timeout_s)
+        except ReplicaUnavailableError as e:
+            self._replica_down(src, str(e))
+            raise SessionLostError(sid, replica=src.name,
+                                   model_path=info.get("model_path"),
+                                   tenant=info.get("tenant")) from e
+        try:
+            tgt.client.call(
+                "import_session",
+                {"model_path": info["model_path"], "payload": payload,
+                 "session_id": sid},
+                timeout_s=self.migrate_timeout_s)
+        except BaseException as e:
+            # the carry never left the source's device pool — reinstate
+            try:
+                src.client.call("finish_export",
+                                {"session_id": sid, "ok": False})
+            except Exception:
+                pass   # source TTL will reap the limbo slot eventually
+            if isinstance(e, ReplicaUnavailableError):
+                self._replica_down(tgt, str(e))
+            raise
+        try:
+            src.client.call("finish_export", {"session_id": sid, "ok": True})
+        except Exception:
+            pass   # target owns the stream; source TTL reaps the limbo
+        with self._lock:
+            cur = self._sessions.get(sid)
+            if cur is not None:
+                cur["replica"] = tgt.name
+                cur["lost"] = False
+        return {"session_id": sid, "from": src.name, "to": tgt.name,
+                "steps": payload.get("steps")}
+
+    def _pick_target(self, info: dict, exclude: str,
+                     target: Optional[str]) -> _Replica:
+        if target is not None:
+            rep = self._get_replica(target)
+            if not rep.ready:
+                raise OverloadedError(
+                    f"migration target {target!r} is not ready",
+                    retry_after_s=self.retry_after_s)
+            return rep
+        for rep in self._candidates(info["key"], exclude=(exclude,)):
+            if rep.name != exclude:
+                return rep
+        raise OverloadedError("no migration target available",
+                              retry_after_s=self.retry_after_s)
+
+    def rebalance(self, reason: str = "rebalance") -> dict:
+        """Move every session whose ring owner changed (replica
+        joined/left/parked) onto its CURRENT owner — the consistency
+        property bounds this to ~1/N of sessions per membership
+        change."""
+        with self._lock:
+            todo = [(sid, dict(i)) for sid, i in self._sessions.items()
+                    if not i.get("lost")]
+        moved, errors = [], []
+        for sid, info in todo:
+            with self._lock:
+                desired = self._ring.lookup(info["key"])
+                cur = self._sessions.get(sid)
+                stale = (cur is not None and desired is not None
+                         and desired != cur["replica"]
+                         and desired in self._replicas
+                         and self._replicas[desired].ready)
+            if not stale:
+                continue
+            try:
+                self.migrate_session(sid, target=desired, reason=reason)
+                moved.append(sid)
+            except Exception as e:
+                errors.append({"session_id": sid,
+                               "error": f"{type(e).__name__}: {e}"})
+        return {"moved": moved, "errors": errors}
+
+    # ------------------------------------------------------------------
+    # Probe / observability surface (Server duck-type)
+    # ------------------------------------------------------------------
+    def healthz(self) -> dict:
+        with self._lock:
+            n = len(self._replicas)
+        return {"status": "ok", "tier": "fleet-router", "replicas": n,
+                "uptime_s": round(time.time() - self._t_start, 1)}
+
+    def readyz(self, live: bool = True) -> dict:
+        """Fleet-level aggregated readiness: ready iff at least one
+        replica answers ``/readyz`` 200.  ``live=True`` (default)
+        probes each replica now; ``live=False`` trusts the
+        FleetManager's cached poll verdicts."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        out = {}
+        for rep in reps:
+            if live:
+                try:
+                    code, body = rep.client.get_json("readyz", timeout_s=5.0)
+                    ready = code == 200
+                    err = (None if ready else
+                           ",".join(sorted(
+                               k for k, v in
+                               (body.get("checks") or {}).items()
+                               if not v)) or f"HTTP {code}")
+                except ReplicaUnavailableError as e:
+                    ready, err = False, str(e)
+                self.mark_ready(rep.name, ready, error=err)
+            out[rep.name] = {"ready": rep.ready, "url": rep.url,
+                             "placeable": rep.placeable,
+                             "error": rep.last_error}
+        n_ready = sum(1 for r in out.values() if r["ready"])
+        with self._lock:
+            sessions = sum(1 for i in self._sessions.values()
+                           if not i.get("lost"))
+        ready = n_ready > 0
+        return {"ready": ready, "replicas": out,
+                "checks": {"replicas_ready": ready},
+                "replicas_ready": n_ready, "sessions": sessions}
+
+    def stats(self) -> dict:
+        with self._lock:
+            per_replica = {}
+            for name, rep in self._replicas.items():
+                per_replica[name] = {
+                    "url": rep.url, "weight": rep.weight,
+                    "ready": rep.ready, "placeable": rep.placeable,
+                    "breaker": rep.breaker.snapshot(),
+                    "sessions": sum(
+                        1 for i in self._sessions.values()
+                        if i["replica"] == name and not i.get("lost")),
+                    "last_error": rep.last_error,
+                }
+            return {
+                "replicas": per_replica,
+                "sessions": len(self._sessions),
+                "sessions_lost": sum(1 for i in self._sessions.values()
+                                     if i.get("lost")),
+                "migrating": sorted(self._migrating),
+                "ring": self._ring.snapshot(),
+                "admission": {
+                    "inflight_rows": self._inflight_rows,
+                    "max_fleet_rows": self.max_fleet_rows,
+                    "fleet_quota_rows": self.fleet_quota_rows,
+                    "by_tenant": dict(self._tenant_rows),
+                },
+            }
+
+    def metrics(self, format: str = "prometheus"):
+        """The scrape endpoint as an RPC (same registry the replicas
+        mirror their own families into when co-hosted; a separate
+        router process scrapes its own ``dl4j_router_*``/``dl4j_fleet_*``
+        families here and the replicas' ``/metrics`` directly)."""
+        fmt = str(format).lower()
+        snap = monitor.get_registry().snapshot()
+        if fmt == "json":
+            return snap
+        if fmt != "prometheus":
+            raise ValueError(f"format must be prometheus or json, "
+                             f"got {format!r}")
+        return {"content_type": monitor.CONTENT_TYPE,
+                "body": monitor.render_prometheus(snap)}
+
+    def trace_dump(self, last_n: Optional[int] = None,
+                   format: str = "events", request_id: Optional[str] = None,
+                   dump: bool = False, reason: str = "manual") -> dict:
+        """The router process's own journal (the replica hops carry the
+        same request IDs — fetch a replica's ``GET /trace`` with the
+        same ``request_id`` for the other half of the flow)."""
+        fmt = str(format).lower()
+        if fmt not in ("events", "chrome"):
+            raise ValueError(f"format must be events or chrome, got "
+                             f"{format!r}")
+        journal = events.get_journal()
+        evts = journal.tail(n=last_n, request_id=request_id)
+        out: dict = {"count": len(evts),
+                     "total_emitted": journal.total_emitted,
+                     "dropped": journal.dropped}
+        if dump:
+            out["path"] = flight.dump(reason, force=True)
+        if fmt == "chrome":
+            out["trace"] = events.chrome_trace(evts)
+        else:
+            out["events"] = evts
+        return out
+
+    def close(self) -> None:
+        """Detach (Server shutdown hook): stops an attached
+        FleetManager's poll loop; replicas are not contacted."""
+        mgr = self.manager
+        if mgr is not None:
+            mgr.stop()

@@ -62,8 +62,17 @@ EVENT_TYPES = (
     "decode.step",
     "decode.session_opened",
     "decode.session_closed",
+    "decode.session_exported",
+    "decode.session_imported",
+    "decode.drain",
     "decode.died",
     "decode.restarted",
+    "fleet.replica_added",
+    "fleet.replica_removed",
+    "fleet.replica_health",
+    "fleet.migrated",
+    "fleet.migrate_failed",
+    "fleet.rollout",
     "cache.load",
     "cache.evicted",
     "rollout.flip",

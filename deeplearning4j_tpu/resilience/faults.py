@@ -8,6 +8,9 @@ Production code is instrumented with named **sites**::
     checkpoint.write     CheckpointListener, before a checkpoint save
     gateway.predict      gateway entry point, on each predict request
     decode.step          DecodePool batcher, before each decode dispatch
+    fleet.migrate        DecodePool batcher, before each session
+                         export/import control op (a kill here is a
+                         replica dying mid-migration)
 
 Each instrumented point calls :func:`check(site)`; with nothing armed
 that is a single attribute read.  A :class:`FaultPlan` armed at a site
@@ -49,7 +52,8 @@ from deeplearning4j_tpu.resilience.errors import TransientError
 
 # The instrumented sites (docs/RESILIENCE.md keeps the prose catalog).
 SITES = ("reader.next_raw", "cache.load", "batcher.compute",
-         "checkpoint.write", "gateway.predict", "decode.step")
+         "checkpoint.write", "gateway.predict", "decode.step",
+         "fleet.migrate")
 
 ENV_VAR = "DL4J_FAULT_PLAN"
 

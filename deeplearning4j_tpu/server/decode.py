@@ -41,6 +41,23 @@ carried-state cache instead of re-tracing it):
   (fault site ``decode.step``) fails every in-flight session cleanly —
   futures error, slots reclaim, the next submit restarts the thread.
 
+* **Session migration** (the fleet tier's seam, docs/FLEET.md): a
+  session's entire decode state is one slot slice of the carry pytree —
+  an explicit, relocatable object (arXiv 2603.09555's compiled-carry
+  contract; arXiv 2112.01075's portable-redistribution view).
+  :meth:`DecodePool.export_session` host-gathers that slice (riding the
+  batcher's control queue, so device state is only ever touched by the
+  thread that owns it) into a JSON-serializable payload;
+  :meth:`DecodePool.import_session` restores it into another pool's
+  slot on another replica with exact float round-trip — the migrated
+  stream continues within 1e-6 of an unmigrated twin.  Export is
+  two-phase: the source slot is held in an ``exported`` limbo (excluded
+  from stats/active counts, steps rejected as retryable) until
+  :meth:`finish_export` confirms the import landed — or reinstates the
+  session when it didn't.  :meth:`drain` is the rollout forcing
+  function: stop admitting joins, report what remains, let migration
+  move it.  Both halves run through the ``fleet.migrate`` fault site.
+
 Metered as the ``dl4j_decode_*`` family (docs/OBSERVABILITY.md).
 """
 
@@ -63,7 +80,7 @@ from deeplearning4j_tpu.monitor import events, flight
 from deeplearning4j_tpu.ops import bucketing
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import (
-    DeadlineExceededError, OverloadedError)
+    DeadlineExceededError, OverloadedError, TransientError)
 
 log = logging.getLogger(__name__)
 
@@ -162,7 +179,7 @@ class DecodeMetrics:
 
 class DecodeSession:
     __slots__ = ("sid", "slot", "tenant", "created_at", "last_used",
-                 "steps", "started")
+                 "steps", "started", "migrating", "exported")
 
     def __init__(self, sid: str, slot: int, tenant: Optional[str]):
         self.sid = sid
@@ -175,6 +192,13 @@ class DecodeSession:
         # gathered carries for fresh rows in-trace, so a reused slot's
         # stale carry is never observed
         self.started = False
+        # migration limbo: `migrating` rejects new steps (retryable)
+        # while an export is being prepared; `exported` means the carry
+        # snapshot left this pool — the slot is held but the session no
+        # longer counts as active until finish_export() closes it (the
+        # import landed) or reinstates it (the import failed)
+        self.migrating = False
+        self.exported = False
 
 
 class _PendingStep:
@@ -260,10 +284,15 @@ class DecodePool:
         self._cond = threading.Condition()
         self._queue: List[_PendingStep] = []
         self._inflight: List[_PendingStep] = []
+        # migration/export ops ride this queue so ONLY the batcher
+        # thread ever touches the device pool (tuples of
+        # (kind, arg, Future))
+        self._control: List[Tuple] = []
         self._sessions: Dict[str, DecodeSession] = {}
         self._free: List[int] = list(range(self.max_slots))
         self._running = True
         self._dead = False
+        self._draining = False
         self.deaths = 0
         self.restarts = 0
         # device state — touched ONLY by the batcher thread after init
@@ -271,6 +300,7 @@ class DecodePool:
         # the in-place update)
         self._pool = None
         self._tails: Optional[Tuple] = None
+        self._dtype = np.dtype(np.float32)
         self._step_jit = None
         self._thread = self._spawn_thread()
 
@@ -284,6 +314,13 @@ class DecodePool:
         with self._cond:
             if not self._running:
                 raise RuntimeError("DecodePool is stopped")
+            if self._draining:
+                self.metrics.record_shed("decode_draining")
+                events.emit("request.shed", severity="warn",
+                            reason="decode_draining", model=self.name)
+                raise OverloadedError(
+                    "decode pool draining (rollout/migration in "
+                    "progress)", retry_after_s=retry_after_s)
             self._sweep_locked()
             if not self._free:
                 self.metrics.record_shed("decode_slots_full")
@@ -296,7 +333,7 @@ class DecodePool:
             sid = uuid.uuid4().hex[:16]
             self._sessions[sid] = DecodeSession(sid, slot, tenant)
             self.metrics.record_opened(tenant)
-            self.metrics.g_active.set(len(self._sessions))
+            self.metrics.g_active.set(self._active_locked())
         events.emit("decode.session_opened", model=self.name,
                     session_id=sid, slot=slot, tenant=tenant)
         return sid
@@ -319,13 +356,20 @@ class DecodePool:
                     RuntimeError(f"decode session {sid} closed ({reason}) "
                                  "with steps still queued"))
         self.metrics.record_closed(reason)
-        self.metrics.g_active.set(len(self._sessions))
+        self.metrics.g_active.set(self._active_locked())
+        self._cond.notify_all()   # wake drain()/export waiters
         events.emit("decode.session_closed", model=self.name,
                     session_id=sid, slot=s.slot, tenant=s.tenant,
                     reason=reason, steps=s.steps,
                     severity="warn" if reason in ("batcher_died", "error")
                     else "info")
         return True
+
+    def _active_locked(self) -> int:
+        """Live sessions — exported slots are held but no longer count
+        (the session's state has left this pool; counting it would
+        double the fleet-wide total during a migration window)."""
+        return sum(1 for s in self._sessions.values() if not s.exported)
 
     def _sweep_locked(self, now: Optional[float] = None) -> int:
         if self.ttl_s <= 0:
@@ -346,7 +390,20 @@ class DecodePool:
     @property
     def active_sessions(self) -> int:
         with self._cond:
+            return self._active_locked()
+
+    @property
+    def held_slots(self) -> int:
+        """Slots currently claimed, INCLUDING exported-but-unconfirmed
+        sessions (rollout adoption must wait for these too — their
+        migration may still abort back onto this pool)."""
+        with self._cond:
             return len(self._sessions)
+
+    @property
+    def draining(self) -> bool:
+        with self._cond:
+            return self._draining
 
     def session_ids(self) -> List[str]:
         with self._cond:
@@ -372,6 +429,11 @@ class DecodePool:
             s = self._sessions.get(sid)
             if s is None:
                 raise KeyError(f"unknown or expired decode session {sid!r}")
+            if s.migrating or s.exported:
+                # retryable: the router re-sends once the session lands
+                # on its new replica (or is reinstated here)
+                raise TransientError(
+                    f"decode session {sid} is migrating; retry")
             restarted = False
             if self._dead or not self._thread.is_alive():
                 self._dead = False
@@ -446,22 +508,34 @@ class DecodePool:
         self._thread.join(timeout)
         with self._cond:
             leftovers, self._queue = self._queue, []
+            ctl, self._control = self._control, []
             sids = list(self._sessions)
             for sid in sids:
                 self._close_locked(sid, reason="shutdown")
         for p in leftovers:
             if not p.future.done():
                 p.future.set_exception(RuntimeError("DecodePool stopped"))
+        for _, _, fut in ctl:
+            if not fut.done():
+                fut.set_exception(RuntimeError("DecodePool stopped"))
 
     def stats(self) -> dict:
         with self._cond:
+            # exported slots are EXCLUDED: the session's state already
+            # left for another replica — a load balancer (or the fleet
+            # readyz aggregation) summing per-replica session counts
+            # must not see the same stream twice mid-migration
             sessions = {sid: {"slot": s.slot, "tenant": s.tenant,
                               "steps": s.steps,
                               "idle_s": round(time.monotonic() -
                                               s.last_used, 3)}
-                        for sid, s in self._sessions.items()}
+                        for sid, s in self._sessions.items()
+                        if not s.exported}
+            exporting = sum(1 for s in self._sessions.values()
+                            if s.exported)
             free = len(self._free)
             queued = len(self._queue)
+            draining = self._draining
         out = {
             "slots": self.max_slots,
             "slots_free": free,
@@ -470,6 +544,8 @@ class DecodePool:
             "queued_steps": queued,
             "deaths": self.deaths,
             "restarts": self.restarts,
+            "draining": draining,
+            "exporting": exporting,
             "sessions": sessions,
             **self.metrics.snapshot(),
         }
@@ -478,6 +554,272 @@ class DecodePool:
             out["decode_programs"] = tel.snapshot()["by_kind"].get(
                 "decode_step", 0)
         return out
+
+    # ------------------------------------------------------------------
+    # Session migration (the fleet tier's seam — docs/FLEET.md)
+    # ------------------------------------------------------------------
+    def export_session(self, sid: str, timeout: float = 30.0) -> dict:
+        """Snapshot one session's decode state as a JSON-serializable
+        payload (phase one of a migration).
+
+        The carry slice is host-gathered ON the batcher thread (a
+        control op between dispatches — the device pool has exactly one
+        owner), after any queued/in-flight steps for the session have
+        landed, so the snapshot is the state AFTER the last acknowledged
+        token.  On success the session enters ``exported`` limbo: the
+        slot stays held, steps are rejected as retryable, and the
+        session no longer counts as active — :meth:`finish_export`
+        closes it (import confirmed) or reinstates it (import failed).
+        """
+        deadline = time.monotonic() + max(0.1, float(timeout))
+        with self._cond:
+            s = self._sessions.get(sid)
+            if s is None:
+                raise KeyError(f"unknown or expired decode session {sid!r}")
+            if s.migrating or s.exported:
+                raise TransientError(
+                    f"decode session {sid} is already migrating")
+            s.migrating = True
+        try:
+            self._wait_steps_drained(sid, deadline)
+            fut = self._submit_control("export", sid)
+            payload = fut.result(max(0.1, deadline - time.monotonic()))
+        except BaseException:
+            with self._cond:
+                s2 = self._sessions.get(sid)
+                if s2 is not None:
+                    s2.migrating = False
+            raise
+        with self._cond:
+            s2 = self._sessions.get(sid)
+            if s2 is not None:
+                s2.exported = True
+                self.metrics.g_active.set(self._active_locked())
+        events.emit("decode.session_exported", model=self.name,
+                    session_id=sid, slot=s.slot, tenant=s.tenant,
+                    steps=payload.get("steps"))
+        return payload
+
+    def finish_export(self, sid: str, ok: bool = True) -> bool:
+        """Phase two of a migration: ``ok=True`` (the import landed on
+        the target replica) releases the slot; ``ok=False`` reinstates
+        the session — its carry never left this pool's device buffer,
+        so it resumes serving exactly where it stopped."""
+        with self._cond:
+            s = self._sessions.get(sid)
+            if s is None:
+                return False
+            if ok:
+                return self._close_locked(sid, reason="migrated")
+            s.exported = False
+            s.migrating = False
+            s.last_used = time.monotonic()   # limbo time is not idle time
+            self.metrics.g_active.set(self._active_locked())
+            return True
+
+    def import_session(self, payload: dict, session_id: Optional[str] = None,
+                       tenant: Optional[str] = None,
+                       timeout: float = 30.0) -> str:
+        """Restore an exported session into THIS pool: claim a slot,
+        scatter the payload's carry into it (on the batcher thread), and
+        continue the stream — next-token parity with the source is the
+        float-exact round trip the migration tests pin.  Keeps the
+        source's session id by default so the client's handle survives
+        the move."""
+        sid = session_id or payload.get("session_id") or uuid.uuid4().hex[:16]
+        tenant = tenant if tenant is not None else payload.get("tenant")
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("DecodePool is stopped")
+            if self._draining:
+                self.metrics.record_shed("decode_draining")
+                raise OverloadedError(
+                    "decode pool draining — not accepting migrated "
+                    "sessions", retry_after_s=1.0)
+            if sid in self._sessions:
+                raise ValueError(f"decode session {sid!r} already exists "
+                                 "in this pool")
+            self._sweep_locked()
+            if not self._free:
+                self.metrics.record_shed("decode_slots_full")
+                raise OverloadedError(
+                    f"decode slots exhausted ({self.max_slots} sessions "
+                    "active)", retry_after_s=1.0)
+            slot = self._free.pop()
+            s = DecodeSession(sid, slot, tenant)
+            s.steps = int(payload.get("steps", 0) or 0)
+            s.started = bool(payload.get("started")) \
+                and payload.get("carry") is not None
+            self._sessions[sid] = s
+            self.metrics.record_opened(tenant)
+            self.metrics.g_active.set(self._active_locked())
+        try:
+            if payload.get("carry") is not None:
+                fut = self._submit_control("import", (s, payload))
+                fut.result(max(0.1, float(timeout)))
+        except BaseException:
+            self.close_session(sid, reason="error")
+            raise
+        events.emit("decode.session_imported", model=self.name,
+                    session_id=sid, slot=slot, tenant=tenant,
+                    steps=s.steps)
+        return sid
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Stop admitting session joins (opens AND imports shed 503)
+        and report what remains.  With a deadline, waits that long for
+        live sessions to leave on their own (client closes, TTL,
+        migration) — the forcing function is the caller's migration
+        loop, not this method.  :meth:`resume` re-admits."""
+        with self._cond:
+            already = self._draining
+            self._draining = True
+            held = len(self._sessions)
+        if not already:
+            events.emit("decode.drain", model=self.name, sessions=held)
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
+        with self._cond:
+            while self._sessions and deadline is not None \
+                    and time.monotonic() < deadline:
+                self._sweep_locked()
+                self._cond.wait(min(0.05, max(
+                    0.0, deadline - time.monotonic())))
+            remaining = [sid for sid, s in self._sessions.items()]
+        return {"draining": True, "remaining": remaining,
+                "drained": not remaining}
+
+    def resume(self) -> None:
+        """Clear the draining flag (rollout finished or aborted)."""
+        with self._cond:
+            self._draining = False
+
+    def _wait_steps_drained(self, sid: str, deadline: float) -> None:
+        """Block until no queued or in-flight step references ``sid`` —
+        an export taken between a step's gather and its scatter would
+        snapshot a stale carry."""
+        with self._cond:
+            def pending():
+                return any(p.session.sid == sid
+                           for p in self._queue + self._inflight)
+            while pending():
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"session {sid} still has steps in flight")
+                self._cond.wait(0.02)
+
+    def _submit_control(self, kind: str, arg) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("DecodePool is stopped")
+            if self._dead or not self._thread.is_alive():
+                self._dead = False
+                self.restarts += 1
+                self._thread = self._spawn_thread()
+            self._control.append((kind, arg, fut))
+            self._cond.notify_all()
+        return fut
+
+    def _handle_control(self, op) -> None:
+        """Run one control op on the batcher thread.  A ``mode="kill"``
+        fault at ``fleet.migrate`` (a replica dying mid-migration)
+        resolves the waiter's future with a clean error FIRST, then
+        takes the thread down through the normal crash handler — the
+        migration fails loudly, no client hangs."""
+        kind, arg, fut = op
+        try:
+            faults.check("fleet.migrate")
+            if kind == "export":
+                result = self._do_export(arg)
+            elif kind == "import":
+                result = self._do_import(*arg)
+            else:
+                raise ValueError(f"unknown control op {kind!r}")
+        except BaseException as e:
+            if not fut.done():
+                if isinstance(e, Exception):
+                    fut.set_exception(e)
+                else:
+                    fut.set_exception(RuntimeError(
+                        "decode batcher killed mid-migration "
+                        f"({type(e).__name__}: {e}); session state lost — "
+                        "reopen the session and replay"))
+            if not isinstance(e, Exception):
+                raise
+            return
+        if not fut.done():
+            fut.set_result(result)
+
+    def _do_export(self, sid: str) -> dict:
+        """Batcher-thread half of export: slice the session's slot out
+        of the device pool and host-gather it (the reshard-path move —
+        ``device_get`` gathers sharded leaves too)."""
+        with self._cond:
+            s = self._sessions.get(sid)
+        if s is None:
+            raise KeyError(f"unknown or expired decode session {sid!r}")
+        payload = {
+            "version": 1,
+            "session_id": sid,
+            "model": self.name,
+            "tenant": s.tenant,
+            "steps": s.steps,
+            "started": bool(s.started),
+            "dtype": str(self._dtype),
+            "feature_tails": None,
+            "carry": None,
+        }
+        if s.started and self._pool is not None:
+            slot_slice = tree_map(lambda a: a[s.slot], self._pool)
+            leaves = jax.tree_util.tree_leaves(slot_slice)
+            host = jax.device_get(leaves)
+            payload["carry"] = {"leaves": [
+                {"shape": list(np.shape(a)),
+                 "dtype": str(np.asarray(a).dtype),
+                 "data": np.asarray(a).ravel().tolist()}
+                for a in host]}
+            payload["feature_tails"] = [list(t) for t in self._tails]
+        return payload
+
+    def _do_import(self, session: DecodeSession, payload: dict) -> dict:
+        """Batcher-thread half of import: materialize the pool's device
+        state if needed, then scatter the payload's carry leaves into
+        the claimed slot."""
+        carry = payload["carry"]
+        fts = payload.get("feature_tails")
+        if self._pool is None:
+            if not fts:
+                raise ValueError("carry payload missing feature_tails")
+            tails = [(1,) + tuple(int(d) for d in t) for t in fts]
+            self._ensure_device_state(
+                tails, np.dtype(payload.get("dtype") or "float32"))
+        elif fts is not None:
+            got = tuple(tuple(int(d) for d in t) for t in fts)
+            if got != self._tails:
+                raise ValueError(
+                    f"migrated carry feature shape {got} != the pool's "
+                    f"{self._tails} (one pool serves one input layout)")
+        pool_leaves, treedef = jax.tree_util.tree_flatten(self._pool)
+        in_leaves = carry["leaves"]
+        if len(in_leaves) != len(pool_leaves):
+            raise ValueError(
+                f"migrated carry has {len(in_leaves)} leaves, this "
+                f"pool's template has {len(pool_leaves)} — model "
+                "architectures differ")
+        new_leaves = []
+        for spec, p in zip(in_leaves, pool_leaves):
+            a = np.asarray(spec["data"],
+                           dtype=np.dtype(spec["dtype"])).reshape(
+                               tuple(spec["shape"]))
+            if tuple(a.shape) != tuple(p.shape[1:]):
+                raise ValueError(
+                    f"migrated carry leaf shape {a.shape} != the pool "
+                    f"slot's {tuple(p.shape[1:])}")
+            new_leaves.append(
+                p.at[session.slot].set(jnp.asarray(a).astype(p.dtype)))
+        self._pool = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return {"slot": session.slot, "leaves": len(new_leaves)}
 
     # ------------------------------------------------------------------
     # Warmup
@@ -556,14 +898,20 @@ class DecodePool:
                 died = self._running   # normal stop() exits are not deaths
                 stranded = self._inflight + self._queue
                 self._inflight = []
+                ctl = []
                 if died:
                     self._queue = []
+                    ctl, self._control = self._control, []
                     self.deaths += 1
                     self._dead = True
                     self._pool = None
                     self._step_jit = None
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="batcher_died")
+            for _, _, fut in ctl:
+                if not fut.done():
+                    fut.set_exception(RuntimeError(
+                        "decode batcher thread died; migration aborted"))
             if died:
                 for p in stranded:
                     if not p.future.done():
@@ -587,6 +935,10 @@ class DecodePool:
 
     def _loop(self) -> None:
         while True:
+            with self._cond:
+                ops, self._control = self._control, []
+            for op in ops:
+                self._handle_control(op)
             taken = self._take_batch()
             if not taken:
                 if not self._running:
@@ -607,6 +959,7 @@ class DecodePool:
                 self._dispatch(group)
                 with self._cond:
                     self._inflight = []
+                    self._cond.notify_all()   # wake export step-drain waits
 
     def _take_batch(self) -> List[_PendingStep]:
         """Drain at most ONE pending step per session (a session's steps
@@ -615,7 +968,7 @@ class DecodePool:
         queued in order.  With ``min_batch > 1`` the drain waits up to
         ``max_wait_s`` for more sessions to join."""
         with self._cond:
-            while self._running and not self._queue:
+            while self._running and not self._queue and not self._control:
                 self._cond.wait(0.1)
                 self._sweep_locked()   # idle servers still expire TTLs
             if not self._queue:
@@ -632,7 +985,8 @@ class DecodePool:
                     else:
                         seen.add(sid)
                         taken.append(p)
-                if len(taken) >= self.min_batch or not self._running:
+                if len(taken) >= self.min_batch or not self._running \
+                        or self._control:
                     self._queue = rest
                     return taken
                 remaining = deadline - time.perf_counter()
@@ -674,6 +1028,7 @@ class DecodePool:
                 n, feature_tail=tails[0], dtype=dtype)
         self._pool = tmpl
         self._tails = tuple(tuple(t[1:]) for t in tails)
+        self._dtype = np.dtype(dtype)
         self._step_jit = jax.jit(  # dl4j: noqa[DL4J104] one jit per pool over a fixed is_graph, cached in self._step_jit for the pool's lifetime
             _pool_step_raw(self.model, self._is_graph),
             donate_argnums=(2,))
@@ -820,6 +1175,7 @@ class DecodeManager:
         self._lock = threading.Lock()
         self._pools: Dict[str, DecodePool] = {}
         self._by_sid: Dict[str, DecodePool] = {}
+        self._draining = False
 
     def _pool_for(self, model_path: str) -> DecodePool:
         import os
@@ -829,7 +1185,7 @@ class DecodeManager:
         with self._lock:
             pool = self._pools.get(key)
             if pool is not None and pool.model is not model \
-                    and pool.active_sessions == 0 and pool.queue_rows() == 0:
+                    and pool.held_slots == 0 and pool.queue_rows() == 0:
                 # rolled-out model: adopt the new instance once drained
                 retired = pool
                 pool = None
@@ -845,6 +1201,11 @@ class DecodeManager:
 
     def open_session(self, model_path: str,
                      tenant: Optional[str] = None) -> dict:
+        with self._lock:
+            if self._draining:
+                raise OverloadedError(
+                    "decode draining (rollout/migration in progress)",
+                    retry_after_s=self.retry_after_s)
         pool = self._pool_for(model_path)
         sid = pool.open_session(tenant=tenant,
                                 retry_after_s=self.retry_after_s)
@@ -852,7 +1213,7 @@ class DecodeManager:
             self._by_sid[sid] = pool
         return {"session_id": sid, "model": pool.name,
                 "slots": pool.max_slots,
-                "slots_free": pool.max_slots - pool.active_sessions}
+                "slots_free": pool.max_slots - pool.held_slots}
 
     def _pool_of(self, session_id: str) -> DecodePool:
         with self._lock:
@@ -882,6 +1243,69 @@ class DecodeManager:
             return False
         return pool.close_session(session_id)
 
+    # ------------------------------------------------------------------
+    # Session migration + drain (the fleet tier's RPC surface)
+    # ------------------------------------------------------------------
+    def export_session(self, session_id: str) -> dict:
+        """Phase one of a cross-replica migration: the session's carry
+        snapshot, JSON-serializable (docs/FLEET.md)."""
+        pool = self._pool_of(session_id)
+        return pool.export_session(session_id)
+
+    def finish_export(self, session_id: str, ok: bool = True) -> bool:
+        """Phase two: confirm (release the slot) or abort (reinstate)."""
+        with self._lock:
+            pool = self._by_sid.get(session_id)
+        if pool is None:
+            return False
+        done = pool.finish_export(session_id, ok=ok)
+        if ok and done:
+            with self._lock:
+                self._by_sid.pop(session_id, None)
+        return done
+
+    def import_session(self, model_path: str, payload: dict,
+                       session_id: Optional[str] = None,
+                       tenant: Optional[str] = None) -> dict:
+        """Restore an exported session into this replica's pool for
+        ``model_path`` (keeping the source's session id by default)."""
+        with self._lock:
+            if self._draining:
+                raise OverloadedError(
+                    "decode draining — not accepting migrated sessions",
+                    retry_after_s=self.retry_after_s)
+        pool = self._pool_for(model_path)
+        sid = pool.import_session(payload, session_id=session_id,
+                                  tenant=tenant)
+        with self._lock:
+            self._by_sid[sid] = pool
+        return {"session_id": sid, "model": pool.name,
+                "slots": pool.max_slots,
+                "slots_free": pool.max_slots - pool.held_slots}
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Stop admitting decode session joins across every pool and
+        report remaining sessions per model — the rollout forcing
+        function (ISSUE: adoption used to wait for a drain that nothing
+        forced).  Migration/rollout moves the remainder; :meth:`resume`
+        re-admits."""
+        with self._lock:
+            self._draining = True
+            pools = list(self._pools.items())
+        return {key: pool.drain(deadline_s) for key, pool in pools}
+
+    def resume(self) -> None:
+        with self._lock:
+            self._draining = False
+            pools = list(self._pools.values())
+        for p in pools:
+            p.resume()
+
     def queue_rows(self) -> int:
         with self._lock:
             pools = list(self._pools.values())
@@ -899,7 +1323,7 @@ class DecodeManager:
     def batchers_alive(self) -> bool:
         with self._lock:
             pools = [p for p in self._pools.values()
-                     if p.active_sessions > 0 or p.queue_rows() > 0]
+                     if p.held_slots > 0 or p.queue_rows() > 0]
         return all(p.thread_alive for p in pools)
 
     def sweep(self) -> int:

@@ -315,6 +315,48 @@ class DeepLearning4jEntryPoint:
         reclaimed for the next session)."""
         return {"closed": self.decode.close_session(session_id)}
 
+    # ------------------------------------------------------------------
+    # Cross-replica session migration (fleet/ tier — docs/FLEET.md)
+    # ------------------------------------------------------------------
+    def export_session(self, session_id: str) -> dict:
+        """Phase one of a migration: snapshot the session's device
+        carry as a JSON payload and hold its slot in exported limbo
+        (excluded from stats/active counts) until ``finish_export``."""
+        return self.decode.export_session(session_id)
+
+    def finish_export(self, session_id: str, ok: bool = True) -> dict:
+        """Phase two: ``ok=True`` releases the migrated session's slot;
+        ``ok=False`` reinstates it (the import failed — the carry never
+        left this replica's device pool)."""
+        return {"finished": self.decode.finish_export(session_id,
+                                                      ok=bool(ok))}
+
+    def import_session(self, model_path: str, payload: dict,
+                       session_id: Optional[str] = None,
+                       tenant: Optional[str] = None) -> dict:
+        """Restore an exported session onto THIS replica (the target
+        half of a migration) — the stream continues from the imported
+        carry with next-token parity against the source."""
+        return self.decode.import_session(model_path, payload,
+                                          session_id=session_id,
+                                          tenant=tenant)
+
+    def drain(self, deadline_ms: Optional[float] = None) -> dict:
+        """Stop admitting decode session joins (opens and imports shed
+        503) and report remaining sessions per pool — the rollout
+        forcing function.  ``/readyz`` goes unready while draining so a
+        load balancer shifts traffic; ``undrain`` re-admits."""
+        deadline_s = None if deadline_ms is None \
+            else max(0.0, float(deadline_ms)) / 1e3
+        return {"pools": self.decode.drain(deadline_s),
+                "draining": True}
+
+    def undrain(self) -> dict:
+        """Re-admit decode session joins after a drain (rollout done or
+        aborted)."""
+        self.decode.resume()
+        return {"draining": False}
+
     def decode_stats(self) -> dict:
         """Per-model decode-pool observability: slots, sessions, step
         counts, the continuous-batching histogram and the bounded
@@ -395,6 +437,9 @@ class DeepLearning4jEntryPoint:
             # thread too — a dead decode batcher strands every open
             # session, which is exactly what an LB should drain over
             "decode_alive": self.decode.batchers_alive(),
+            # a draining replica is mid-rollout/migration: an LB (or
+            # the fleet router) should place sessions elsewhere
+            "not_draining": not self.decode.draining,
             "queue_below_limit": queued < self.max_queue_rows,
             "breaker_closed": (breaker is None
                                or breaker.state != CircuitBreaker.OPEN),
@@ -645,11 +690,16 @@ class Server:
             def do_POST(self):
                 method = ""
                 headers = {}
-                # the gateway mints the trace/request ID: every event
-                # this RPC produces (admission, batcher queue, coalesced
-                # compute, decode step) journals under it, and the
-                # client gets it back for support-ticket correlation
-                rid = events.new_request_id()
+                # the gateway ADOPTS an upstream trace/request ID when
+                # the caller sends one (the fleet router's hop header —
+                # one request_scope then correlates the full
+                # router→replica flow in GET /trace) and mints one
+                # otherwise; every event this RPC produces (admission,
+                # batcher queue, coalesced compute, decode step)
+                # journals under it, and the client gets it back for
+                # support-ticket correlation
+                rid = (self.headers.get("X-DL4J-Request-ID") or "").strip() \
+                    or events.new_request_id()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n) or b"{}")

@@ -246,6 +246,25 @@ class ModelCache:
                     duration_s=round(time.perf_counter() - t0, 6))
         return model
 
+    def wait_warm(self, path=None, timeout_s: float = 60.0) -> bool:
+        """Block until no blue/green background warm is in flight for
+        ``path`` (or for any entry when None) — the rollout runbook's
+        wait step (docs/FLEET.md): after republishing a checkpoint,
+        ``wait_warm`` returning True means the flip happened (or failed
+        and was counted) and the next ``get`` serves a settled version.
+        Returns False on timeout."""
+        key = None if path is None else os.path.abspath(str(path))
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while True:
+            with self._lock:
+                warming = (bool(self._rollouts) if key is None
+                           else key in self._rollouts)
+            if not warming:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
     def peek(self, path):
         """The cached model if (and only if) it is resident and fresh —
         no load, no counter changes (stats/telemetry introspection)."""

@@ -1,0 +1,738 @@
+"""Serving fleet tier (deeplearning4j_tpu/fleet/, docs/FLEET.md):
+consistent-hash ring properties, pool-level carry export/import parity
+(chunks + masks, mid-stream), exported-slot exclusion and drain, the
+two-replica router e2e (concurrent sessions, live migration over HTTP,
+request-ID propagation on the hop, fleet-wide admission, replica death
+→ clean fail-and-reopen), FleetManager health polling through breakers,
+the drain-free rollout, and a tier-1 subprocess smoke with a
+fault-armed replica (site ``fleet.migrate``)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.fleet import (
+    FleetManager, HashRing, SessionLostError, SessionRouter)
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.serialization import load_model, write_model
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import (
+    OverloadedError, TransientError)
+from deeplearning4j_tpu.server import (
+    DeepLearning4jEntryPoint, ModelCache, Server)
+from deeplearning4j_tpu.server.decode import DecodePool
+
+F, H, C = 4, 10, 3
+
+
+def _lstm(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+            .shape_bucketing(True).list()
+            .layer(L.GravesLSTM(n_in=F, n_out=H, activation="tanh"))
+            .layer(L.RnnOutputLayer(n_in=H, n_out=C, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _seq(n, t, seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(n, t, F)).astype(np.float32)
+
+
+def _counter(name, **labels):
+    fam = monitor.get_registry().get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for s in fam.samples():
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            total += s["value"]
+    return total
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fleet") / "lstm.zip")
+    write_model(_lstm(), path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def ref_net(model_path):
+    return load_model(model_path)
+
+
+@pytest.fixture(scope="module")
+def fleet2(model_path):
+    """Two in-process gateway replicas (real HTTP hops) + a router."""
+    eps = [DeepLearning4jEntryPoint(decode_slots=8, max_wait_ms=1.0)
+           for _ in range(2)]
+    servers = [Server(ep, port=0).start() for ep in eps]
+    router = SessionRouter()
+    for i, s in enumerate(servers):
+        router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+    yield {"router": router, "servers": servers, "eps": eps}
+    for s in servers:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Consistent-hash ring
+# ---------------------------------------------------------------------------
+def test_hash_ring_deterministic_and_minimal_movement():
+    ring = HashRing(vnodes=64)
+    for n in ("a", "b", "c"):
+        ring.add(n)
+    keys = [f"k{i}" for i in range(240)]
+    before = {k: ring.lookup(k) for k in keys}
+    # a fresh ring with the same members agrees exactly (no process
+    # salt — two routers must place identically)
+    r2 = HashRing(vnodes=64)
+    for n in ("a", "b", "c"):
+        r2.add(n)
+    assert {k: r2.lookup(k) for k in keys} == before
+    # adding a node moves only a minority of keys, all TO the new node
+    ring.add("d")
+    after = {k: ring.lookup(k) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    assert 0 < len(moved) < len(keys) // 2
+    assert all(after[k] == "d" for k in moved)
+    # removing it restores the original placement exactly
+    ring.remove("d")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_hash_ring_weights_and_preference():
+    ring = HashRing(vnodes=32)
+    ring.add("small", weight=1.0)
+    ring.add("big", weight=4.0)
+    keys = [f"s{i}" for i in range(400)]
+    owners = [ring.lookup(k) for k in keys]
+    assert owners.count("big") > owners.count("small")
+    snap = ring.snapshot()
+    assert snap["points"]["big"] == 4 * snap["points"]["small"]
+    # preference order: the owner first, every node exactly once
+    pref = ring.preference("s0")
+    assert pref[0] == ring.lookup("s0")
+    assert sorted(pref) == ["big", "small"]
+
+
+# ---------------------------------------------------------------------------
+# Pool-level migration: export/import parity, limbo, drain
+# ---------------------------------------------------------------------------
+def test_pool_migration_parity_mid_stream_chunks_and_masks(model_path):
+    """A session migrated mid-stream (after a bucketed prefill chunk +
+    masked steps) continues ≤1e-6-identical to an unmigrated twin."""
+    netA, netB = load_model(model_path), load_model(model_path)
+    T = 8
+    x = _seq(1, T, seed=1)
+    mask = np.ones((1, T), np.float32)
+    mask[0, 6:] = 0.0
+    full = np.asarray(netA.output(x, mask))
+    poolA = DecodePool(netA, name="A", max_slots=4, max_wait_ms=0.5)
+    poolB = DecodePool(netB, name="B", max_slots=4, max_wait_ms=0.5)
+    try:
+        sid = poolA.open_session(tenant="t1")
+        twin = poolA.open_session()
+        got, gtw = [], []
+        # bucketed prefill chunk (T=3 pads to the time ladder), then
+        # token steps — twin runs the identical schedule, unmigrated
+        for a, lst in ((sid, got), (twin, gtw)):
+            (o,) = poolA.step(a, x[0, :3], masks=mask[0, :3])
+            lst.append(o)
+        for t in (3, 4):
+            for a, lst in ((sid, got), (twin, gtw)):
+                (o,) = poolA.step(a, x[0, t:t + 1], masks=mask[0, t:t + 1])
+                lst.append(o)
+        payload = poolA.export_session(sid)
+        assert payload["steps"] == 3 and payload["started"]
+        assert payload["tenant"] == "t1"
+        assert poolB.import_session(payload) == sid
+        assert poolA.finish_export(sid, ok=True)
+        for t in range(5, T):
+            (o,) = poolB.step(sid, x[0, t:t + 1], masks=mask[0, t:t + 1])
+            got.append(o)
+            (o,) = poolA.step(twin, x[0, t:t + 1], masks=mask[0, t:t + 1])
+            gtw.append(o)
+        got = np.concatenate(got, axis=0)
+        gtw = np.concatenate(gtw, axis=0)
+        np.testing.assert_allclose(got, gtw, atol=1e-6)
+        # and both match the full-sequence reference at unmasked steps
+        np.testing.assert_allclose(got[:6], full[0, :6], atol=1e-5,
+                                   rtol=1e-4)
+        # the source counted the close as a migration, not an error
+        assert _counter("dl4j_decode_sessions_closed_total",
+                        model="A", reason="migrated") >= 1
+    finally:
+        poolA.stop()
+        poolB.stop()
+
+
+def test_export_limbo_excluded_from_stats_and_reinstates(model_path):
+    """Satellite: exported slots leave stats()/active counts while the
+    migration is pending; an aborted export reinstates the session with
+    its carry intact."""
+    net = load_model(model_path)
+    pool = DecodePool(net, name="limbo", max_slots=4, max_wait_ms=0.5)
+    try:
+        sid = pool.open_session()
+        other = pool.open_session()
+        x = _seq(1, 4, seed=2)
+        for t in range(2):
+            pool.step(sid, x[0, t:t + 1])
+        payload = pool.export_session(sid)
+        st = pool.stats()
+        assert sid not in st["sessions"]          # excluded
+        assert other in st["sessions"]            # others unaffected
+        assert st["exporting"] == 1
+        assert pool.active_sessions == 1
+        assert pool.held_slots == 2               # slot still held
+        with pytest.raises(TransientError):
+            pool.submit_step(sid, x[0, 2:3])      # steps shed retryable
+        # abort: the import failed somewhere — session resumes HERE
+        assert pool.finish_export(sid, ok=False)
+        assert sid in pool.stats()["sessions"]
+        (o,) = pool.step(sid, x[0, 2:3])
+        assert o.shape == (1, C)
+        # a second export of the same state produces the same carry
+        payload2 = pool.export_session(sid)
+        assert payload2["steps"] == 3
+        assert payload2["steps"] != payload["steps"]
+        pool.finish_export(sid, ok=False)
+    finally:
+        pool.stop()
+
+
+def test_pool_drain_blocks_joins_and_reports(model_path):
+    net = load_model(model_path)
+    pool = DecodePool(net, name="drain", max_slots=4, max_wait_ms=0.5)
+    try:
+        sid = pool.open_session()
+        d = pool.drain()
+        assert d["draining"] and d["remaining"] == [sid]
+        assert not d["drained"]
+        with pytest.raises(OverloadedError):
+            pool.open_session()
+        with pytest.raises(OverloadedError):
+            pool.import_session({"session_id": "x", "carry": None})
+        shed = _counter("dl4j_resilience_shed_total",
+                        reason="decode_draining")
+        assert shed >= 2
+        # closing the last session completes the drain within deadline
+        pool.close_session(sid)
+        d = pool.drain(deadline_s=5.0)
+        assert d["drained"] and d["remaining"] == []
+        pool.resume()
+        assert pool.open_session()
+    finally:
+        pool.stop()
+
+
+def test_gateway_drain_rpc_flips_readyz(model_path):
+    ep = DeepLearning4jEntryPoint(decode_slots=2)
+    server = Server(ep, port=0).start()
+    base = f"http://{server.host}:{server.port}"
+
+    def post(method, params=None):
+        req = urllib.request.Request(
+            base + "/", data=json.dumps({"method": method,
+                                         "params": params or {}}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        code, body = post("open_session", {"model_path": model_path})
+        assert code == 200
+        code, body = post("drain", {})
+        assert code == 200 and body["result"]["draining"]
+        # draining replica: readyz 503 with not_draining failing — the
+        # LB (or fleet router) shifts placements away
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/readyz", timeout=10)
+        rz = json.loads(ei.value.read())
+        assert rz["checks"]["not_draining"] is False
+        code, _ = post("open_session", {"model_path": model_path})
+        assert code == 503
+        code, body = post("undrain", {})
+        assert code == 200 and body["result"]["draining"] is False
+        with urllib.request.urlopen(base + "/readyz", timeout=10) as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Router e2e over two replicas
+# ---------------------------------------------------------------------------
+def test_fleet_serves_concurrent_sessions_through_router(fleet2, ref_net,
+                                                         model_path):
+    """Acceptance: a 2-replica fleet serves concurrent decode sessions
+    through the router — every stream's routed step sequence matches
+    the reference full-sequence output."""
+    router = fleet2["router"]
+    K, T = 4, 6
+    x = _seq(K, T, seed=3)
+    full = np.asarray(ref_net.output(x))
+    opened = [router.open_session(model_path) for _ in range(K)]
+    sids = [o["session_id"] for o in opened]
+    outs = {i: [] for i in range(K)}
+    errors = []
+
+    def client(i):
+        try:
+            for t in range(T):
+                r = router.decode_step(sids[i], x[i, t:t + 1].tolist())
+                outs[i].append(np.asarray(r["predictions"], np.float32))
+        except Exception as e:   # surfaced on the main thread below
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(K)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "client hang"
+    assert not errors, errors
+    for i in range(K):
+        got = np.concatenate(outs[i], axis=0)
+        np.testing.assert_allclose(got, full[i], atol=1e-4, rtol=1e-3)
+    st = router.stats()
+    assert st["sessions"] == K
+    assert sum(r["sessions"] for r in st["replicas"].values()) == K
+    rz = router.readyz()
+    assert rz["ready"] and rz["replicas_ready"] == 2
+    for sid in sids:
+        router.close_session(sid)
+    assert router.stats()["sessions"] == 0
+
+
+def test_router_live_migration_parity_over_http(fleet2, ref_net,
+                                                model_path):
+    """Acceptance: a live session migrated between replicas continues
+    with ≤1e-6 output parity (vs an unmigrated twin on the fleet)."""
+    router = fleet2["router"]
+    T = 8
+    x = _seq(1, T, seed=4)
+    a = router.open_session(model_path, tenant="mig")
+    b = router.open_session(model_path)
+    got, gtw = [], []
+    for t in range(4):
+        for sid, lst in ((a["session_id"], got), (b["session_id"], gtw)):
+            r = router.decode_step(sid, x[0, t:t + 1].tolist())
+            lst.append(np.asarray(r["predictions"], np.float32))
+    src = router._session_info(a["session_id"])["replica"]
+    mig = router.migrate_session(a["session_id"])
+    assert mig["from"] == src and mig["to"] != src
+    assert mig["steps"] == 4
+    assert router._session_info(a["session_id"])["replica"] == mig["to"]
+    for t in range(4, T):
+        for sid, lst in ((a["session_id"], got), (b["session_id"], gtw)):
+            r = router.decode_step(sid, x[0, t:t + 1].tolist())
+            lst.append(np.asarray(r["predictions"], np.float32))
+    got = np.concatenate(got, axis=0)
+    gtw = np.concatenate(gtw, axis=0)
+    np.testing.assert_allclose(got, gtw, atol=1e-6)
+    np.testing.assert_allclose(
+        got, np.asarray(ref_net.output(x))[0], atol=1e-4, rtol=1e-3)
+    assert _counter("dl4j_fleet_migrations_total", reason="manual") >= 1
+    # the source's exported slot was confirmed-released, not errored
+    router.close_session(a["session_id"])
+    router.close_session(b["session_id"])
+
+
+def test_request_id_propagates_on_router_hop(fleet2, model_path):
+    """Satellite (PR 10 hand-off): one request_scope correlates the
+    full router→replica flow — the replica ADOPTS the forwarded
+    X-DL4J-Request-ID instead of minting its own, so its GET /trace
+    filtered by the router's ID shows the replica-side events."""
+    router = fleet2["router"]
+    rid = "fleetrid%08x" % 0xC0FFEE
+    with events.scope(request_id=rid):
+        router.predict(model_path, _seq(1, 1, seed=5).tolist(),
+                       tenant="traced")
+    hits = []
+    for s in fleet2["servers"]:
+        with urllib.request.urlopen(
+                f"http://{s.host}:{s.port}/trace?request_id={rid}",
+                timeout=10) as r:
+            tr = json.loads(r.read())
+        hits.append(tr["count"])
+    assert max(hits) > 0
+    evts = events.get_journal().tail(request_id=rid)
+    types = {e["type"] for e in evts}
+    # the replica-side hop journals under the SAME id: its rpc.request
+    # (adopted header) plus its gateway admission
+    assert "rpc.request" in types and "request.admitted" in types
+    tenants = {e.get("tenant") for e in evts if e.get("tenant")}
+    assert tenants == {"traced"}   # tenant rode the hop too
+
+
+def test_fleet_wide_tenant_quota_503(fleet2, model_path):
+    """Fleet admission aggregates per-tenant in-flight rows ACROSS
+    replicas at the router: the flooding tenant sheds with a fleet
+    quota error while the small tenant keeps being served."""
+    router = fleet2["router"]
+    quota_router = SessionRouter(fleet_quota_rows=2)
+    for name, rep in router._replicas.items():
+        quota_router.add_replica(name, rep.url)
+    faults.arm({"site": "batcher.compute", "mode": "latency",
+                "latency_ms": 120, "probability": 1.0})
+    results = []
+    lock = threading.Lock()
+
+    def client(tenant):
+        try:
+            quota_router.predict(model_path, _seq(1, 1, seed=6).tolist(),
+                                 tenant=tenant)
+            out = ("ok", None)
+        except OverloadedError as e:
+            out = ("shed", str(e))
+        except Exception as e:
+            out = ("error", repr(e))
+        with lock:
+            results.append((tenant, *out))
+
+    threads = [threading.Thread(target=client, args=("hog",))
+               for _ in range(6)]
+    threads.append(threading.Thread(target=client, args=("small",)))
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "client hang"
+        hog = [r for r in results if r[0] == "hog"]
+        assert any(r[1] == "shed" and "fleet-wide quota" in r[2]
+                   for r in hog), results
+        assert [r[1] for r in results if r[0] == "small"] == ["ok"], results
+        assert _counter("dl4j_resilience_shed_total",
+                        reason="fleet_tenant_quota") >= 1
+    finally:
+        faults.reset()
+
+
+def test_rebalance_moves_sessions_off_parked_replica(fleet2, model_path):
+    """Ring membership change → rebalance migrates exactly the sessions
+    whose owner changed (onto the remaining replica)."""
+    router = fleet2["router"]
+    sids = [router.open_session(model_path)["session_id"]
+            for _ in range(6)]
+    x = _seq(1, 1, seed=7)
+    for sid in sids:
+        router.decode_step(sid, x[0].tolist())
+    on_r1 = router.sessions_on("r1")
+    router.set_placement("r1", False)       # park: off the ring
+    moved = router.rebalance(reason="rebalance")
+    try:
+        assert sorted(moved["moved"]) == sorted(on_r1)
+        assert not moved["errors"], moved
+        assert router.sessions_on("r1") == []
+        # parked ≠ dead: streams keep working (now all on r0)
+        for sid in sids:
+            r = router.decode_step(sid, x[0].tolist())
+            assert r["shape"] == [1, C]
+        if on_r1:
+            assert _counter("dl4j_fleet_migrations_total",
+                            reason="rebalance") >= len(on_r1)
+    finally:
+        router.set_placement("r1", True)
+        for sid in sids:
+            router.close_session(sid)
+
+
+def test_replica_death_fails_cleanly_and_reopens(model_path, ref_net):
+    """Acceptance: killing one replica migrates-or-cleanly-fails its
+    sessions with ZERO client hangs — steps against the dead owner
+    raise SessionLostError (bounded), reopen lands on the live replica,
+    and the fleet stays ready."""
+    eps = [DeepLearning4jEntryPoint(decode_slots=8) for _ in range(2)]
+    servers = [Server(ep, port=0).start() for ep in eps]
+    router = SessionRouter()
+    for i, s in enumerate(servers):
+        router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+    victim = -1
+    try:
+        # open sessions until both replicas hold at least one
+        sids = []
+        for _ in range(16):
+            sids.append(router.open_session(model_path)["session_id"])
+            if all(router.sessions_on(f"r{i}") for i in range(2)):
+                break
+        assert all(router.sessions_on(f"r{i}") for i in range(2))
+        x = _seq(1, 2, seed=8)
+        for sid in sids:
+            router.decode_step(sid, x[0, :1].tolist())
+        victim = 1 if router.sessions_on("r1") else 0
+        dead_sids = router.sessions_on(f"r{victim}")
+        live_sids = [s for s in sids if s not in dead_sids]
+        servers[victim].stop()
+        t0 = time.monotonic()
+        with pytest.raises(SessionLostError):
+            router.decode_step(dead_sids[0], x[0, 1:2].tolist())
+        assert time.monotonic() - t0 < 30.0, "not bounded"
+        # survivors keep streaming untouched
+        for sid in live_sids:
+            router.decode_step(sid, x[0, 1:2].tolist())
+        # fail-and-reopen: fresh carry on the live replica
+        re = router.reopen_session(dead_sids[0])
+        assert re["carry_lost"] is True
+        assert re["replica"] != f"r{victim}"
+        r = router.decode_step(re["session_id"], x[0, :1].tolist())
+        assert r["shape"] == [1, C]
+        rz = router.readyz()
+        assert rz["ready"] and rz["replicas_ready"] == 1
+        assert rz["replicas"][f"r{victim}"]["ready"] is False
+        assert _counter("dl4j_fleet_sessions_lost_total",
+                        reason="replica_dead") >= len(dead_sids)
+    finally:
+        for i, s in enumerate(servers):
+            if i != victim:
+                s.stop()
+
+
+def test_replica_killed_mid_migration_fault_site(model_path):
+    """Satellite: a replica killed mid-migration (fault site
+    ``fleet.migrate``, mode=kill) fails the migration loudly — the
+    export future resolves with a clean error (no hang), the source
+    pool's sessions close through the dead-batcher path, and the
+    client reopens."""
+    eps = [DeepLearning4jEntryPoint(decode_slots=8) for _ in range(2)]
+    servers = [Server(ep, port=0).start() for ep in eps]
+    router = SessionRouter()
+    for i, s in enumerate(servers):
+        router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+    try:
+        sid = router.open_session(model_path)["session_id"]
+        x = _seq(1, 2, seed=9)
+        router.decode_step(sid, x[0, :1].tolist())
+        faults.arm({"site": "fleet.migrate", "mode": "kill",
+                    "probability": 1.0, "max_injections": 1})
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="killed mid-migration"):
+            router.migrate_session(sid)
+        assert time.monotonic() - t0 < 30.0, "not bounded"
+        assert _counter("dl4j_fleet_migration_failures_total",
+                        reason="manual") >= 1
+        # the source pool died → its sessions closed; the next step is
+        # a clean unknown-session error, then a fresh open works
+        with pytest.raises((KeyError, SessionLostError)):
+            router.decode_step(sid, x[0, 1:2].tolist())
+        re = router.open_session(model_path)
+        r = router.decode_step(re["session_id"], x[0, :1].tolist())
+        assert r["shape"] == [1, C]
+        # the fault is consumed: a fresh migration now succeeds
+        mig = router.migrate_session(re["session_id"])
+        assert mig["to"] != mig["from"]
+        r = router.decode_step(re["session_id"], x[0, 1:2].tolist())
+        assert r["shape"] == [1, C]
+    finally:
+        faults.reset()
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# FleetManager: health polling, rollout
+# ---------------------------------------------------------------------------
+def test_fleet_manager_poll_breaker_and_down_detection(model_path):
+    eps = [DeepLearning4jEntryPoint(decode_slots=4) for _ in range(2)]
+    servers = [Server(ep, port=0).start() for ep in eps]
+    router = SessionRouter()
+    for i, s in enumerate(servers):
+        router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+    mgr = FleetManager(router, poll_interval_s=0.05, probe_timeout_s=2.0)
+    owner = ""
+    try:
+        assert mgr.poll_once() == {"r0": True, "r1": True}
+        sid = router.open_session(model_path)["session_id"]
+        owner = router._session_info(sid)["replica"]
+        servers[int(owner[1:])].stop()
+        verdicts = mgr.poll_once()
+        assert verdicts[owner] is False
+        # the dead replica's sessions are lost (unreachable ≠ unready)
+        with pytest.raises(SessionLostError):
+            router._session_info(sid)
+        # repeated probe failures open the replica's breaker
+        for _ in range(4):
+            mgr.poll_once()
+        breaker = router._get_replica(owner).breaker
+        assert breaker.snapshot()["state"] in ("open", "half_open")
+        # cached (non-live) readyz reflects the manager's verdicts
+        rz = router.readyz(live=False)
+        assert rz["replicas"][owner]["ready"] is False
+        assert rz["ready"] is True   # the other replica carries the fleet
+    finally:
+        mgr.stop()
+        for i, s in enumerate(servers):
+            if f"r{i}" != owner:
+                s.stop()
+
+
+def test_drain_free_rollout_no_stream_ends(fleet2, ref_net, model_path):
+    """Tentpole acceptance: both replicas roll (drain → migrate →
+    ready-wait → undrain → rebalance) while every session keeps
+    decoding — the full token sequence across the rollout matches the
+    reference, i.e. no stream lost its carry."""
+    router = fleet2["router"]
+    mgr = FleetManager(router, poll_interval_s=0.5)
+    K, T = 3, 9
+    x = _seq(K, T, seed=10)
+    full = np.asarray(ref_net.output(x))
+    sids = [router.open_session(model_path)["session_id"]
+            for _ in range(K)]
+    outs = {i: [] for i in range(K)}
+
+    def step_all(t):
+        for i, sid in enumerate(sids):
+            r = router.decode_step(sid, x[i, t:t + 1].tolist())
+            outs[i].append(np.asarray(r["predictions"], np.float32))
+
+    try:
+        for t in range(3):
+            step_all(t)
+        rollouts0 = _counter("dl4j_fleet_rollouts_total")
+        result = mgr.rollout(roll=None, wait_ready_s=30)
+        assert len(result["replicas"]) == 2
+        for step in result["replicas"]:
+            assert step["ready_again"] is True
+            assert not step["errors"], step
+        assert _counter("dl4j_fleet_rollouts_total") == rollouts0 + 2
+        # sessions survived BOTH replica passes — continue and compare
+        for t in range(3, T):
+            step_all(t)
+        for i in range(K):
+            got = np.concatenate(outs[i], axis=0)
+            np.testing.assert_allclose(got, full[i], atol=1e-4, rtol=1e-3)
+        # replicas are back on the ring and un-drained
+        rz = router.readyz()
+        assert rz["ready"] and rz["replicas_ready"] == 2
+        for ep in fleet2["eps"]:
+            assert ep.decode.draining is False
+    finally:
+        for sid in sids:
+            router.close_session(sid)
+        mgr.stop()
+
+
+def test_model_cache_wait_warm_blue_green(model_path, tmp_path):
+    path = str(tmp_path / "bg.zip")
+    write_model(_lstm(seed=1), path)
+    cache = ModelCache(blue_green=True)
+    m1 = cache.get(path, warmup_dims=(1, F))
+    assert cache.wait_warm(path, timeout_s=5) is True   # nothing warming
+    time.sleep(0.01)
+    write_model(_lstm(seed=2), path)
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    assert cache.get(path) is m1        # old serves, background warm kicks
+    assert cache.wait_warm(path, timeout_s=60) is True
+    assert cache.get(path) is not m1    # flipped
+    assert cache.stats()["rollouts"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 subprocess smoke: fleet with a fault-armed replica
+# ---------------------------------------------------------------------------
+_FLEET_SMOKE = r"""
+import json, os, tempfile, time
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.serialization import write_model
+from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+from deeplearning4j_tpu.fleet import SessionRouter, SessionLostError
+
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+        .shape_bucketing(True).list()
+        .layer(L.GravesLSTM(n_in=4, n_out=10, activation="tanh"))
+        .layer(L.RnnOutputLayer(n_in=10, n_out=3, activation="softmax",
+                                loss="mcxent"))
+        .build())
+path = os.path.join(tempfile.mkdtemp(), "lstm.zip")
+write_model(MultiLayerNetwork(conf).init(), path)
+servers = [Server(DeepLearning4jEntryPoint(decode_slots=8), port=0).start()
+           for _ in range(2)]
+router = SessionRouter()
+for i, s in enumerate(servers):
+    router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+
+out = {}
+x = np.random.default_rng(0).normal(size=(4, 4)).astype(np.float32)
+sid = router.open_session(path)["session_id"]
+router.decode_step(sid, x[0:1].tolist())
+
+# the armed DL4J_FAULT_PLAN kills the replica's batcher mid-export: the
+# migration must fail CLEANLY and in bounded time — no client hang
+t0 = time.monotonic()
+try:
+    router.migrate_session(sid)
+    out["migrate_failed"] = False
+except Exception as e:
+    out["migrate_failed"] = True
+    out["migrate_error"] = type(e).__name__
+    out["migrate_error_clean"] = "killed mid-migration" in str(e)
+out["migrate_bounded"] = time.monotonic() - t0 < 30.0
+
+# the poisoned session fails cleanly too; a fresh one serves
+try:
+    router.decode_step(sid, x[1:2].tolist())
+    out["stale_step_failed"] = False
+except (KeyError, SessionLostError, Exception):
+    out["stale_step_failed"] = True
+sid2 = router.open_session(path)["session_id"]
+r = router.decode_step(sid2, x[0:1].tolist())
+out["fresh_step_shape"] = r["shape"]
+
+# the fault is consumed (max_injections=1): a real migration now works
+# and the stream continues on the target replica
+mig = router.migrate_session(sid2)
+out["second_migration_ok"] = mig["to"] != mig["from"]
+r = router.decode_step(sid2, x[1:2].tolist())
+out["post_migration_shape"] = r["shape"]
+out["fleet_ready"] = router.readyz()["ready"]
+for s in servers:
+    s.stop()
+print(json.dumps(out))
+"""
+
+
+def test_fleet_fault_armed_subprocess_smoke():
+    env = dict(os.environ)
+    env["DL4J_FAULT_PLAN"] = json.dumps(
+        [{"site": "fleet.migrate", "mode": "kill", "probability": 1.0,
+          "max_injections": 1}])
+    p = subprocess.run([sys.executable, "-c", _FLEET_SMOKE],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["migrate_failed"] is True
+    assert out["migrate_error_clean"] is True
+    assert out["migrate_bounded"] is True
+    assert out["stale_step_failed"] is True
+    assert out["fresh_step_shape"] == [1, 3]
+    assert out["second_migration_ok"] is True
+    assert out["post_migration_shape"] == [1, 3]
+    assert out["fleet_ready"] is True
