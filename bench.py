@@ -2047,6 +2047,33 @@ def _run_configs(result):
         assert lint_summary["gating"] == 0, lint_summary
         result["lint"] = {"exit_code": proc.returncode, **lint_summary}
         log(f"dl4j-lint gate: exit 0, {lint_summary}")
+        # the concurrency checker rides the same smoke: a bounded
+        # exploration of the serving-stack protocols must stay at zero
+        # violations (CPU-forced: the checker never needs the chip and
+        # a second TPU client in a subprocess would fight this one)
+        chk = subprocess.run(
+            [_sys.executable, "-m", "deeplearning4j_tpu.analysis.check",
+             "--schedules", "40", "--seed", "0", "--budget-s", "120",
+             "--format", "json"],
+            cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=600)
+        assert chk.returncode == 0, (
+            f"dl4j-check gate failed (exit {chk.returncode}):\n"
+            f"{chk.stdout[-2000:]}{chk.stderr[-1000:]}")
+        chk_doc = json.loads(chk.stdout)
+        assert not chk_doc["violations"], chk_doc["violations"][:3]
+        result["check"] = {
+            "exit_code": chk.returncode,
+            "total_runs": chk_doc["total_runs"],
+            "total_distinct": chk_doc["total_distinct"],
+            "violations": len(chk_doc["violations"]),
+            "scenarios": {k: {"runs": v["runs"],
+                              "distinct": v["distinct"]}
+                          for k, v in chk_doc["scenarios"].items()},
+        }
+        log(f"dl4j-check gate: exit 0, {chk_doc['total_runs']} "
+            f"schedules, {chk_doc['total_distinct']} distinct, "
+            "0 violations")
 
     for name, fn in config_list:
         if dry_run:

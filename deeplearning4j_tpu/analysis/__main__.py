@@ -69,6 +69,11 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="rewrite the baseline from this run's "
                              "unsuppressed findings and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop baseline fingerprints that no "
+                             "longer fire and exit 0 (baseline "
+                             "hygiene: stale entries could silently "
+                             "mask a future regression)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
@@ -102,9 +107,25 @@ def main(argv=None) -> int:
                 if args.rules else None)
     disabled = [r.strip() for r in args.disable.split(",") if r.strip()]
 
-    findings, _project = core.lint(
-        args.paths, baseline_path=baseline_path, docs_path=args.docs,
-        rule_ids=rule_ids, disabled=disabled)
+    # inline core.lint() so the loaded Baseline object (and its
+    # usage/staleness bookkeeping) stays in hand
+    project = core.build_project(args.paths, docs_path=args.docs)
+    findings = core.run_rules(project, rule_ids=rule_ids,
+                              disabled=disabled)
+    baseline = core.Baseline.load(baseline_path) if baseline_path \
+        else None
+    core.apply_suppressions(project, findings, baseline)
+    stale = baseline.stale_entries() if baseline is not None else []
+
+    if args.prune_baseline:
+        if baseline is None:
+            print("no baseline file to prune")
+            return 0
+        dropped = baseline.prune()
+        print(f"baseline pruned: {baseline.path} — {dropped} stale "
+              f"entr{'y' if dropped == 1 else 'ies'} dropped, "
+              f"{len(baseline.entries)} kept")
+        return 0
 
     if args.write_baseline:
         path = args.baseline or DEFAULT_BASELINE
@@ -114,10 +135,13 @@ def main(argv=None) -> int:
         return 0
 
     if args.format == "json":
+        summary = _summary(findings)
+        summary["stale_baseline"] = [e.get("fingerprint")
+                                     for e in stale]
         print(json.dumps({
             "version": 1,
             "findings": [f.to_dict() for f in findings],
-            "summary": _summary(findings),
+            "summary": summary,
         }, indent=1, sort_keys=True))
     else:
         report = _text_report(findings, args.verbose)
@@ -127,6 +151,10 @@ def main(argv=None) -> int:
         print(f"dl4j-lint: {s['total']} finding(s) — {s['gating']} "
               f"gating, {s['suppressed']} noqa'd, {s['baselined']} "
               "baselined")
+        for e in stale:
+            print(f"warning: stale baseline entry (fires nowhere): "
+                  f"{e.get('rule')} {e.get('path')} :: "
+                  f"{e.get('symbol')} — run --prune-baseline")
     return 1 if any(f.gates() for f in findings) else 0
 
 

@@ -1,0 +1,31 @@
+"""dl4j-check: deterministic-schedule concurrency checker and protocol
+lifecycle verifier for the serving stack (docs/ANALYSIS.md
+"Concurrency checker").
+
+Public surface:
+
+* :func:`explore` / :func:`explore_protocols` — run a scenario under
+  many interleavings (seeded-random or bounded-exhaustive), collect
+  violations, count distinct schedules.
+* :func:`replay` / :func:`replay_file` / :func:`save_trace` — re-run
+  an exact recorded schedule (every violation carries its decisions).
+* :data:`SCENARIOS` — the scenario registry (migration, kill-mid-
+  migration, batcher death/restart, decode death, drain, breaker, and
+  the positive controls).
+* :class:`Harness` / :class:`Scheduler` / :func:`schedule_point` —
+  the cooperative scheduler itself, for bespoke scenarios.
+
+CLI: ``python -m deeplearning4j_tpu.analysis.check`` (exit 0 = zero
+violations over the explored schedules).
+"""
+
+from deeplearning4j_tpu.analysis.check.explore import (  # noqa: F401
+    ExploreResult, RunResult, explore, explore_protocols, replay,
+    replay_file, run_once, save_trace)
+from deeplearning4j_tpu.analysis.check.scenarios import (  # noqa: F401
+    DEFAULT_SCENARIOS, SCENARIOS, Context)
+from deeplearning4j_tpu.analysis.check.sched import (  # noqa: F401
+    DFSPolicy, Harness, RandomPolicy, ReplayPolicy, Scheduler,
+    Violation, schedule_point)
+from deeplearning4j_tpu.analysis.check.specs import (  # noqa: F401
+    BreakerSpec, SessionLifecycleSpec, SpecMonitor, watch_decode_pool)

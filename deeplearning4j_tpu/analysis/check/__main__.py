@@ -1,0 +1,118 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis.check``.
+
+Explores the serving-stack protocol scenarios under the deterministic
+scheduler and gates on zero violations.
+
+Exit codes: 0 = no violations across every explored schedule; 1 =
+violations (each printed with its replay recipe); 2 = usage error.
+
+The replay workflow::
+
+    # a failing run prints (and with --save-trace writes) the recipe
+    python -m deeplearning4j_tpu.analysis.check --scenarios double_claim \
+        --schedules 50 --save-trace /tmp/fail.json
+    # re-run THAT schedule, byte-for-byte
+    python -m deeplearning4j_tpu.analysis.check --replay /tmp/fail.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    # NOTE: the package __init__ re-exports the explore() FUNCTION under
+    # the same name as this module — import the module via sys.modules,
+    # not package attribute lookup
+    import importlib
+    ex = importlib.import_module(
+        "deeplearning4j_tpu.analysis.check.explore")
+    sc = importlib.import_module(
+        "deeplearning4j_tpu.analysis.check.scenarios")
+
+    parser = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis.check",
+        description="dl4j-check: deterministic-schedule concurrency "
+                    "checker for the serving stack")
+    parser.add_argument("--scenarios", default=None,
+                        help="comma-separated scenario names (default: "
+                             "the gating protocol set)")
+    parser.add_argument("--schedules", type=int, default=60,
+                        help="max schedules per scenario (default 60)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--mode", choices=("random", "exhaustive"),
+                        default="random")
+    parser.add_argument("--max-preemptions", type=int, default=4)
+    parser.add_argument("--budget-s", type=float, default=None,
+                        help="wall-clock budget across all scenarios")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--replay", default=None, metavar="TRACE_JSON",
+                        help="re-run one recorded schedule instead of "
+                             "exploring")
+    parser.add_argument("--save-trace", default=None, metavar="PATH",
+                        help="write the first violation's replay "
+                             "recipe here")
+    parser.add_argument("--list", action="store_true",
+                        help="list scenarios and exit")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name in sorted(sc.SCENARIOS):
+            gate = "gating" if name in sc.DEFAULT_SCENARIOS \
+                else "positive-control"
+            doc = (sc.SCENARIOS[name].__doc__ or "").strip().split("\n")[0]
+            print(f"{name:<16} [{gate}] {doc}")
+        return 0
+
+    if args.replay:
+        r = ex.replay_file(args.replay)
+        doc = {"version": 1, "scenario": r.scenario,
+               "decisions": r.decisions, "trace_hash": r.trace_hash,
+               "steps": r.steps,
+               "violations": r.violation_dicts()}
+        if args.format == "json":
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(f"replayed {r.scenario}: {r.steps} steps, "
+                  f"trace {r.trace_hash}")
+            for v in r.violations:
+                print(f"  VIOLATION [{v.kind}] {v.message}")
+        return 1 if r.violations else 0
+
+    names = ([s.strip() for s in args.scenarios.split(",") if s.strip()]
+             if args.scenarios else None)
+    try:
+        summary = ex.explore_protocols(
+            names, schedules=args.schedules, seed=args.seed,
+            mode=args.mode, max_preemptions=args.max_preemptions,
+            time_budget_s=args.budget_s)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.save_trace and summary["violations"]:
+        ex.save_trace(summary["violations"][0], args.save_trace)
+
+    if args.format == "json":
+        print(json.dumps(summary, indent=1, sort_keys=True))
+    else:
+        for name, s in summary["scenarios"].items():
+            print(f"{name:<16} {s['runs']:>4} schedules, "
+                  f"{s['distinct']:>4} distinct, "
+                  f"{len(s['violations'])} violation(s), "
+                  f"{s['wall_s']:.1f}s")
+        print(f"dl4j-check: {summary['total_runs']} schedules, "
+              f"{summary['total_distinct']} distinct interleavings, "
+              f"{len(summary['violations'])} violation(s)")
+        for v in summary["violations"][:20]:
+            print(f"  VIOLATION [{v['kind']}] ({v['scenario']}, "
+                  f"seed={v['seed']}) {v['message']}")
+            print(f"    replay: decisions={v['decisions']}")
+    return 1 if summary["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

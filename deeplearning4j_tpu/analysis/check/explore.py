@@ -1,0 +1,288 @@
+"""Schedule exploration: run a scenario under many interleavings,
+count the distinct ones, collect violations, and make every failing
+schedule replayable.
+
+Two modes:
+
+* ``random`` — one seeded :class:`RandomPolicy` per schedule
+  (``seed + i``); preemption-bounded.  Coverage scales with the
+  schedule budget and every run is reproducible from its seed alone.
+* ``exhaustive`` — bounded-exhaustive DFS over decision prefixes: run
+  the default schedule, then branch every recorded decision point,
+  skipping alternatives that would exceed the preemption bound.  For
+  small scenarios this enumerates the whole (bounded) schedule space.
+
+A schedule's identity is its trace hash; ``distinct`` counts unique
+hashes (two decision vectors can collapse to the same interleaving
+when a choice was between equivalent wakeups).
+
+Violations carry ``(scenario, seed, decisions)`` — :func:`replay`
+re-runs the exact schedule; :func:`save_trace`/:func:`replay_file`
+round-trip it through JSON for the bug-report workflow.
+
+Every exploration meters ``dl4j_check_*`` (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time as _time
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.analysis.check import scenarios as _scenarios
+from deeplearning4j_tpu.analysis.check.sched import (
+    DFSPolicy, Harness, RandomPolicy, ReplayPolicy, Scheduler)
+from deeplearning4j_tpu.analysis.check.specs import SpecMonitor
+
+_real_perf_counter = _time.perf_counter
+
+
+class RunResult:
+    """One schedule's outcome."""
+
+    def __init__(self, scenario: str, policy, sched: Scheduler,
+                 wall_s: float):
+        self.scenario = scenario
+        self.seed = getattr(policy, "seed", None)
+        self.decisions = sched.decisions
+        self.branches = sched.branches
+        self.violations = sched.violations
+        self.steps = sched.steps
+        self.preemptions = sched.preemptions
+        self.trace = sched.trace_text()
+        self.trace_hash = hashlib.sha256(
+            self.trace.encode()).hexdigest()[:16]
+        self.wall_s = wall_s
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def violation_dicts(self) -> List[dict]:
+        return [dict(v.to_dict(), scenario=self.scenario, seed=self.seed,
+                     decisions=self.decisions,
+                     trace_hash=self.trace_hash)
+                for v in self.violations]
+
+
+def _metrics():
+    from deeplearning4j_tpu import monitor
+    reg = monitor.get_registry()
+    return {
+        "schedules": reg.counter(
+            "dl4j_check_schedules_total",
+            "deterministic-scheduler schedules executed",
+            labels=("scenario",)),
+        "violations": reg.counter(
+            "dl4j_check_violations_total",
+            "checker violations found (invariant/spec/deadlock/...)",
+            labels=("scenario", "kind")),
+        "distinct": reg.gauge(
+            "dl4j_check_distinct_interleavings",
+            "distinct interleavings seen in the last exploration",
+            labels=("scenario",)),
+        "steps": reg.counter(
+            "dl4j_check_schedule_steps_total",
+            "scheduling points executed across all schedules",
+            labels=("scenario",)),
+    }
+
+
+def run_once(scenario: str, policy, max_steps: int = 50000) -> RunResult:
+    """One schedule of ``scenario`` under ``policy`` (with specs and
+    the end-of-run obligations checked)."""
+    import logging
+    fn = _scenarios.SCENARIOS[scenario]
+    _scenarios.warm()
+    sched = Scheduler(policy=policy, max_steps=max_steps)
+    monitor = SpecMonitor(sched)
+    t0 = _real_perf_counter()
+    # injected kills are the SCENARIO, not noise worth one log line per
+    # schedule: mute the framework's error logging for the run
+    logger = logging.getLogger("deeplearning4j_tpu")
+    prev_level = logger.level
+    logger.setLevel(logging.CRITICAL)
+    try:
+        with Harness(sched, monitor):
+            ctx = _scenarios.Context(sched)
+            sched.run(lambda: fn(ctx), name="root")
+    finally:
+        logger.setLevel(prev_level)
+    unresolved = sum(1 for f in sched.futures if not f.done())
+    if unresolved:
+        from deeplearning4j_tpu.analysis.check.sched import Violation
+        sched.violations.append(Violation(
+            "future-unresolved",
+            f"{unresolved} future(s) never resolved by the end of the "
+            "schedule — a stranded waiter in real execution",
+            step=sched.steps))
+    return RunResult(scenario, policy, sched,
+                     _real_perf_counter() - t0)
+
+
+class ExploreResult:
+    def __init__(self, scenario: str, mode: str, seed: int):
+        self.scenario = scenario
+        self.mode = mode
+        self.seed = seed
+        self.runs = 0
+        self.distinct_hashes: set = set()
+        self.violations: List[dict] = []
+        self.steps_total = 0
+        self.wall_s = 0.0
+        self.traces: List[str] = []   # only with keep_traces
+
+    @property
+    def distinct(self) -> int:
+        return len(self.distinct_hashes)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.scenario, "mode": self.mode,
+            "seed": self.seed, "runs": self.runs,
+            "distinct": self.distinct,
+            "violations": self.violations,
+            "steps_total": self.steps_total,
+            "wall_s": round(self.wall_s, 3),
+        }
+
+    def _absorb(self, r: RunResult, keep_traces: bool) -> None:
+        self.runs += 1
+        self.distinct_hashes.add(r.trace_hash)
+        self.steps_total += r.steps
+        self.wall_s += r.wall_s
+        if not r.ok:
+            self.violations.extend(r.violation_dicts())
+        if keep_traces:
+            self.traces.append(r.trace)
+
+
+def explore(scenario: str, mode: str = "random", schedules: int = 50,
+            seed: int = 0, max_preemptions: int = 4,
+            p_preempt: float = 0.4, time_budget_s: Optional[float] = None,
+            max_steps: int = 50000, keep_traces: bool = False,
+            stop_on_violation: bool = False) -> ExploreResult:
+    """Explore up to ``schedules`` interleavings of ``scenario``."""
+    if scenario not in _scenarios.SCENARIOS:
+        raise KeyError(f"unknown scenario {scenario!r}; one of "
+                       f"{sorted(_scenarios.SCENARIOS)}")
+    res = ExploreResult(scenario, mode, seed)
+    t0 = _real_perf_counter()
+
+    def over_budget() -> bool:
+        return (time_budget_s is not None
+                and _real_perf_counter() - t0 > time_budget_s)
+
+    if mode == "random":
+        for i in range(schedules):
+            if over_budget():
+                break
+            policy = RandomPolicy(seed=seed + i,
+                                  max_preemptions=max_preemptions,
+                                  p_preempt=p_preempt)
+            r = run_once(scenario, policy, max_steps=max_steps)
+            res._absorb(r, keep_traces)
+            if stop_on_violation and not r.ok:
+                break
+    elif mode == "exhaustive":
+        frontier: List[List[int]] = [[]]
+        explored: set = set()
+        while frontier and res.runs < schedules and not over_budget():
+            prefix = frontier.pop()
+            key = tuple(prefix)
+            if key in explored:
+                continue
+            explored.add(key)
+            policy = DFSPolicy(prefix)
+            policy.seed = seed
+            r = run_once(scenario, policy, max_steps=max_steps)
+            res._absorb(r, keep_traces)
+            if stop_on_violation and not r.ok:
+                break
+            branches = r.branches
+            for i in range(len(branches) - 1, len(prefix) - 1, -1):
+                ncand, chosen, cur_idx = branches[i]
+                pre_used = sum(
+                    1 for (n2, c2, cu2) in branches[:i]
+                    if cu2 is not None and c2 != cu2)
+                for j in range(ncand):
+                    if j == chosen:
+                        continue
+                    is_preempt = cur_idx is not None and j != cur_idx
+                    if is_preempt and pre_used >= max_preemptions:
+                        continue
+                    frontier.append(
+                        [b[1] for b in branches[:i]] + [j])
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    res.wall_s = _real_perf_counter() - t0
+    m = _metrics()
+    m["schedules"].labels(scenario=scenario).inc(res.runs)
+    m["steps"].labels(scenario=scenario).inc(res.steps_total)
+    m["distinct"].labels(scenario=scenario).set(res.distinct)
+    kinds: Dict[str, int] = {}
+    for v in res.violations:
+        kinds[v["kind"]] = kinds.get(v["kind"], 0) + 1
+    for kind, n in kinds.items():
+        m["violations"].labels(scenario=scenario, kind=kind).inc(n)
+    return res
+
+
+def replay(scenario: str, decisions: Sequence[int],
+           max_steps: int = 50000) -> RunResult:
+    """Re-run one exact schedule from its recorded decision vector."""
+    return run_once(scenario, ReplayPolicy(list(decisions)),
+                    max_steps=max_steps)
+
+
+def save_trace(violation: dict, path: str) -> None:
+    """Persist a failing schedule (the replay recipe) as JSON."""
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "dl4j_check_trace": violation}, f,
+                  indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def replay_file(path: str) -> RunResult:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    v = doc.get("dl4j_check_trace") or doc
+    return replay(v["scenario"], v.get("decisions") or [])
+
+
+def explore_protocols(
+        scenarios: Optional[Sequence[str]] = None,
+        schedules: int = 50, seed: int = 0, mode: str = "random",
+        max_preemptions: int = 4,
+        time_budget_s: Optional[float] = None) -> dict:
+    """Explore a set of scenarios (default: the gating protocol set)
+    and aggregate — the CLI/CI entry point."""
+    names = list(scenarios or _scenarios.DEFAULT_SCENARIOS)
+    per = (None if time_budget_s is None
+           else max(1.0, time_budget_s / max(1, len(names))))
+    out: Dict[str, dict] = {}
+    total_runs = total_distinct = 0
+    all_violations: List[dict] = []
+    for name in names:
+        r = explore(name, mode=mode, schedules=schedules, seed=seed,
+                    max_preemptions=max_preemptions, time_budget_s=per)
+        out[name] = r.to_dict()
+        total_runs += r.runs
+        total_distinct += r.distinct
+        all_violations.extend(r.violations)
+    return {
+        "version": 1,
+        "mode": mode,
+        "seed": seed,
+        "scenarios": out,
+        "total_runs": total_runs,
+        "total_distinct": total_distinct,
+        "violations": all_violations,
+        "ok": not all_violations,
+    }

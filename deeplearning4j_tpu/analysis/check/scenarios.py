@@ -1,0 +1,513 @@
+"""Checker scenarios: the serving-stack protocols driven under the
+deterministic scheduler.
+
+Each scenario runs the REAL protocol code — ``DecodePool``'s control
+queue, two-phase export→import→confirm, crash handler and restart
+path; ``MicroBatcher``'s dispatch/death/restart; ``CircuitBreaker``'s
+window machine — with only the device compute stubbed
+(:class:`CheckDecodePool` swaps the jitted gather→step→scatter for a
+step-counting carry, so a slot collision or a lost/duplicated step is
+visible as a wrong carry VALUE, not just a bookkeeping mismatch).
+Locks, queues, futures and threads are the production ones, shimmed by
+the harness; scenario actors are spawned as managed threads and every
+interleaving of them is the explorer's choice.
+
+A scenario must be deterministic given the schedule (no wall-clock, no
+real randomness on the control path) and must stop its pools before
+returning — a leaked batcher thread polls forever and the scheduler
+reports it as an overrun.
+
+``double_claim``/``deadlock``/``leaked_future`` are positive controls:
+deliberately broken miniatures that the checker MUST flag (the tests
+pin that, and pin that a saved failing schedule replays to the same
+violation).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.analysis.check import specs as _specs
+from deeplearning4j_tpu.analysis.check.sched import (
+    SThread, schedule_point)
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import (
+    CircuitOpenError, TransientError)
+
+_WARM = {"done": False}
+
+
+def warm() -> None:
+    """One-time pre-harness warmup: import jax and touch the device so
+    backend initialization (which spawns real helper threads) never
+    happens inside a harness, and construct one throwaway pool so the
+    metric registry families exist before the first measured run."""
+    if _WARM["done"]:
+        return
+    import jax.numpy as jnp
+    np.asarray(jnp.zeros((1,), np.float32))
+    pool = CheckDecodePool(_StubModel(), name="chk-warm", max_slots=1)
+    pool.stop(timeout=5.0)
+    from deeplearning4j_tpu.server.batcher import MicroBatcher
+    MicroBatcher(lambda x: x, name="chk-warm").stop(timeout=5.0)
+    _WARM["done"] = True
+
+
+class Context:
+    """What a scenario gets: managed-thread spawning, pool watching,
+    and direct access to the run's scheduler."""
+
+    def __init__(self, sched):
+        self.sched = sched
+
+    def thread(self, name: str, fn: Callable[[], None]) -> SThread:
+        t = SThread(target=fn, name=name)
+        t.start()
+        return t
+
+    def watch_pool(self, pool) -> None:
+        _specs.watch_decode_pool(self.sched, pool)
+
+    def probe(self, name: str, fn) -> None:
+        self.sched.probes.append((name, fn))
+
+    def future(self):
+        from deeplearning4j_tpu.server import batcher
+        return batcher.Future()
+
+
+# ----------------------------------------------------------------------
+# The stubbed decode model: real DecodePool, counting-carry compute
+# ----------------------------------------------------------------------
+class _StubGlobalConf:
+    bucket_time_sizes = None
+
+
+class _StubConf:
+    global_conf = _StubGlobalConf()
+
+
+class _StubModel:
+    """The minimal engine surface DecodePool touches for a non-graph
+    model; no ``_forward_all`` attr, so the pool takes the MLN path."""
+
+    conf = _StubConf()
+    net_params: Dict = {}
+    net_state = [{}]
+
+
+def _counting_pool_step(params, state, pool, idx, fresh, xs, fms):
+    """Pure-host stand-in for the ONE compiled decode program, keeping
+    its exact contract: gather slot carries by ``idx``, zero the
+    ``fresh`` rows, advance, scatter back.  The carry is a step
+    counter, so session i's n-th step returns exactly ``n`` — a slot
+    collision, a lost scatter, or a stale migrated carry shows up as a
+    wrong output value under SOME schedule."""
+    h = np.asarray(pool["h"])
+    idx = np.asarray(idx)
+    fresh = np.asarray(fresh)
+    g = h[idx] * (1.0 - fresh)[:, None]
+    newh = g + 1.0
+    x = np.asarray(xs[0])
+    if x.ndim >= 3:
+        out = np.repeat(newh[:, None, :], x.shape[1], axis=1)
+    else:
+        out = newh
+    h2 = h.copy()
+    h2[idx] = newh
+    import jax.numpy as jnp
+    return (out,), {"h": jnp.asarray(h2)}
+
+
+from deeplearning4j_tpu.server.decode import DecodePool  # noqa: E402
+
+
+class CheckDecodePool(DecodePool):
+    """DecodePool with the device state stubbed to the counting carry;
+    every protocol path (batcher loop, control queue, export/import,
+    crash handler, drain) is the parent's real code."""
+
+    def _ensure_device_state(self, tails, dtype) -> None:
+        if self._pool is not None:
+            return
+        import jax.numpy as jnp
+        n = self.max_slots + 1
+        self._pool = {"h": jnp.zeros((n, 1), np.float32)}
+        self._tails = tuple(tuple(t[1:]) for t in tails)
+        self._dtype = np.dtype(np.float32)
+        self._step_jit = _counting_pool_step
+
+
+def _x():
+    return np.zeros((1, 1), np.float32)
+
+
+def _val(out) -> float:
+    return float(np.asarray(out[0]).ravel()[0])
+
+
+# ----------------------------------------------------------------------
+# Protocol scenarios
+# ----------------------------------------------------------------------
+def scenario_migration(ctx: Context) -> None:
+    """Two-phase live migration racing a client stream: export →
+    import → confirm on one thread while the session keeps stepping on
+    another.  The carry must count 1..4 without a gap or repeat no
+    matter where the move lands in the stream."""
+    faults.reset()
+    src = CheckDecodePool(_StubModel(), name="chk-src", max_slots=4,
+                          max_wait_ms=0.0)
+    dst = CheckDecodePool(_StubModel(), name="chk-dst", max_slots=4,
+                          max_wait_ms=0.0)
+    ctx.watch_pool(src)
+    ctx.watch_pool(dst)
+    try:
+        sid = src.open_session(tenant="t0")
+        loc = {"pool": src}
+        results = []
+        errors = []
+
+        def stepper():
+            for _i in range(4):
+                for _try in range(50):
+                    pool = loc["pool"]
+                    try:
+                        out = pool.step(sid, _x(), timeout=60)
+                        results.append(_val(out))
+                        break
+                    except (TransientError, KeyError):
+                        # mid-migration: wait out the move, re-read loc
+                        time.sleep(0.001)
+                else:
+                    errors.append("step retries exhausted")
+                    return
+
+        def migrator():
+            try:
+                payload = src.export_session(sid, timeout=30)
+            except Exception as e:
+                errors.append(f"export failed: {type(e).__name__}: {e}")
+                return
+            try:
+                dst.import_session(payload)
+            except Exception as e:
+                src.finish_export(sid, ok=False)
+                errors.append(f"import failed: {type(e).__name__}: {e}")
+                return
+            loc["pool"] = dst
+            src.finish_export(sid, ok=True)
+
+        t1 = ctx.thread("stepper", stepper)
+        t2 = ctx.thread("migrator", migrator)
+        t1.join(120.0)
+        t2.join(120.0)
+        assert not errors, errors
+        assert results == [1.0, 2.0, 3.0, 4.0], \
+            f"carry broke across the migration: {results}"
+        assert src.active_sessions == 0, "source still counts the " \
+            "migrated session (double-count)"
+    finally:
+        src.stop(timeout=30.0)
+        dst.stop(timeout=30.0)
+
+
+def scenario_migration_kill(ctx: Context) -> None:
+    """A replica dying mid-migration (``fleet.migrate`` kill): the
+    export must fail LOUDLY on the migrator, every client future must
+    resolve, and the pool must serve new sessions after the restart."""
+    faults.reset()
+    src = CheckDecodePool(_StubModel(), name="chk-src", max_slots=4,
+                          max_wait_ms=0.0)
+    ctx.watch_pool(src)
+    try:
+        faults.arm({"site": "fleet.migrate", "mode": "kill", "on_call": 1})
+        sid = src.open_session(tenant="t0")
+        outcomes = []
+
+        def stepper():
+            for _i in range(3):
+                try:
+                    out = src.step(sid, _x(), timeout=60)
+                    outcomes.append(("ok", _val(out)))
+                except (TransientError, KeyError, RuntimeError) as e:
+                    outcomes.append(("err", type(e).__name__))
+                    return
+
+        def migrator():
+            try:
+                src.export_session(sid, timeout=30)
+                outcomes.append(("export-ok", None))
+            except Exception as e:
+                outcomes.append(("export-err", type(e).__name__))
+
+        t1 = ctx.thread("stepper", stepper)
+        t2 = ctx.thread("migrator", migrator)
+        t1.join(120.0)
+        t2.join(120.0)
+        kinds = [k for k, _ in outcomes]
+        assert "export-err" in kinds, \
+            f"kill-mid-migration did not fail loudly: {outcomes}"
+        assert src.deaths == 1, f"expected one batcher death, " \
+            f"got {src.deaths}"
+        # the restart path: a fresh session streams again
+        sid2 = src.open_session()
+        out = src.step(sid2, _x(), timeout=60)
+        assert _val(out) == 1.0, "post-restart carry not fresh"
+    finally:
+        src.stop(timeout=30.0)
+
+
+def scenario_batcher_death(ctx: Context) -> None:
+    """MicroBatcher thread killed mid-compute: in-flight requests fail
+    with a clear error (never hang), the next submit restarts the
+    thread, and every client converges to a correct answer."""
+    from deeplearning4j_tpu.server.batcher import MicroBatcher
+    faults.reset()
+    mb = MicroBatcher(lambda x: x * 2.0, max_batch=8, max_wait_ms=0.0,
+                      name="chk-mb")
+    try:
+        faults.arm({"site": "batcher.compute", "mode": "kill",
+                    "on_call": 1})
+        outs: Dict[int, object] = {}
+
+        def client(i: int):
+            x = np.full((1, 2), float(i), np.float32)
+            for _try in range(4):
+                try:
+                    outs[i] = mb.predict(x, timeout=60)
+                    return
+                except RuntimeError:
+                    # the batcher died under us; resubmitting restarts it
+                    continue
+            outs[i] = "failed"
+
+        threads = [ctx.thread(f"client-{i}", lambda i=i: client(i))
+                   for i in range(3)]
+        for t in threads:
+            t.join(120.0)
+        for i in range(3):
+            got = outs.get(i)
+            assert isinstance(got, np.ndarray), f"client {i}: {got!r}"
+            assert float(got[0, 0]) == 2.0 * i, f"client {i} got a " \
+                f"batch-mate's rows: {got!r}"
+        assert mb.deaths == 1, f"expected one death, got {mb.deaths}"
+        assert mb.restarts >= 1, "dead batcher was never restarted"
+    finally:
+        mb.stop(timeout=30.0)
+
+
+def scenario_decode_death(ctx: Context) -> None:
+    """Decode batcher killed at ``decode.step``: sessions close with a
+    clear error, no waiter strands, and the pool restarts clean."""
+    faults.reset()
+    pool = CheckDecodePool(_StubModel(), name="chk-dp", max_slots=4,
+                           max_wait_ms=0.0)
+    ctx.watch_pool(pool)
+    try:
+        faults.arm({"site": "decode.step", "mode": "kill", "on_call": 1})
+        sids = [pool.open_session() for _ in range(2)]
+        outcomes = []
+
+        def stepper(sid: str):
+            try:
+                out = pool.step(sid, _x(), timeout=60)
+                outcomes.append(("ok", _val(out)))
+            except (RuntimeError, KeyError, TransientError) as e:
+                outcomes.append(("err", type(e).__name__))
+
+        threads = [ctx.thread(f"stepper-{i}",
+                              lambda sid=sid: stepper(sid))
+                   for i, sid in enumerate(sids)]
+        for t in threads:
+            t.join(120.0)
+        assert len(outcomes) == 2, f"a stepper hung: {outcomes}"
+        assert any(k == "err" for k, _ in outcomes), \
+            f"the kill never surfaced: {outcomes}"
+        assert pool.deaths == 1, f"expected one death, got {pool.deaths}"
+        sid3 = pool.open_session()
+        out = pool.step(sid3, _x(), timeout=60)
+        assert _val(out) == 1.0, "post-restart carry not fresh"
+    finally:
+        pool.stop(timeout=30.0)
+
+
+def scenario_drain(ctx: Context) -> None:
+    """Drain admits nothing: concurrent opens/imports against a
+    draining pool must shed (503), never admit, and resume re-admits."""
+    from deeplearning4j_tpu.resilience.errors import OverloadedError
+    faults.reset()
+    src = CheckDecodePool(_StubModel(), name="chk-src", max_slots=4,
+                          max_wait_ms=0.0)
+    dst = CheckDecodePool(_StubModel(), name="chk-dst", max_slots=4,
+                          max_wait_ms=0.0)
+    ctx.watch_pool(src)
+    ctx.watch_pool(dst)
+    try:
+        sid = dst.open_session()
+        dst.step(sid, _x(), timeout=60)
+        payload = dst.export_session(sid, timeout=30)
+        results = []
+
+        def drainer():
+            src.drain()
+            results.append(("drained", None))
+
+        def opener():
+            for _try in range(2):
+                try:
+                    results.append(("opened", src.open_session()))
+                    return
+                except OverloadedError:
+                    results.append(("shed", None))
+                    return
+
+        def importer():
+            try:
+                results.append(("imported", src.import_session(payload)))
+                dst.finish_export(sid, ok=True)
+            except OverloadedError:
+                results.append(("import-shed", None))
+                dst.finish_export(sid, ok=False)
+
+        threads = [ctx.thread("drainer", drainer),
+                   ctx.thread("opener", opener),
+                   ctx.thread("importer", importer)]
+        for t in threads:
+            t.join(120.0)
+        assert len(results) == 3, results
+        src.resume()
+        sid2 = src.open_session()   # resume re-admits
+        assert sid2
+    finally:
+        src.stop(timeout=30.0)
+        dst.stop(timeout=30.0)
+
+
+def scenario_breaker(ctx: Context) -> None:
+    """CircuitBreaker hammered from two threads through its whole
+    lifecycle (fail → open → cooldown → half-open probe → close); the
+    BreakerSpec checks every transition's legality on every schedule."""
+    from deeplearning4j_tpu.resilience.policy import CircuitBreaker
+    faults.reset()
+    br = CircuitBreaker(failure_threshold=0.5, window=4, min_calls=2,
+                        cooldown_s=0.05, half_open_max=1,
+                        name="chk-breaker", clock=time.monotonic)
+    state = {"fail": True}
+
+    def work():
+        if state["fail"]:
+            raise TransientError("chk: induced failure")
+        return 1
+
+    def caller(n: int):
+        for _i in range(n):
+            try:
+                br.call(work)
+            except (CircuitOpenError, TransientError):
+                pass
+            time.sleep(0.01)
+
+    t1 = ctx.thread("caller-1", lambda: caller(5))
+    t2 = ctx.thread("caller-2", lambda: caller(5))
+    t1.join(120.0)
+    t2.join(120.0)
+    state["fail"] = False
+    recovered = False
+    for _i in range(10):
+        try:
+            br.call(work)
+            recovered = True
+            break
+        except (CircuitOpenError, TransientError):
+            time.sleep(0.05)
+    assert recovered, f"breaker never recovered: {br.snapshot()}"
+    assert br.state == CircuitBreaker.CLOSED
+
+
+# ----------------------------------------------------------------------
+# Positive controls: the checker MUST catch these
+# ----------------------------------------------------------------------
+class RacyPool:
+    """A deliberately unsynchronized slot claimer (the synthetic
+    double-claim bug the determinism/replay tests pin)."""
+
+    def __init__(self, slots: int = 2):
+        self.free = list(range(slots))
+        self.claimed: Dict[str, int] = {}
+
+    def claim(self, sid: str) -> Optional[int]:
+        if not self.free:
+            return None
+        slot = self.free[0]           # read ...
+        schedule_point("racy.claim")  # ... the race window ...
+        self.free.pop(0)              # ... write
+        self.claimed[sid] = slot
+        return slot
+
+
+def _racy_probe(pool: RacyPool) -> Optional[str]:
+    slots = list(pool.claimed.values())
+    if len(set(slots)) != len(slots):
+        return f"slot double-claim: {sorted(pool.claimed.items())}"
+    return None
+
+
+def scenario_double_claim(ctx: Context) -> None:
+    pool = RacyPool(slots=2)
+    ctx.probe("racy-slots", lambda: _racy_probe(pool))
+    t1 = ctx.thread("claim-a", lambda: pool.claim("s1"))
+    t2 = ctx.thread("claim-b", lambda: pool.claim("s2"))
+    t1.join(60.0)
+    t2.join(60.0)
+
+
+def scenario_deadlock(ctx: Context) -> None:
+    """Classic two-lock inversion with no timers: the scheduler must
+    report a deadlock naming both threads."""
+    import threading
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            schedule_point("deadlock.ab")
+            with b:
+                pass
+
+    def ba():
+        with b:
+            schedule_point("deadlock.ba")
+            with a:
+                pass
+
+    t1 = ctx.thread("ab", ab)
+    t2 = ctx.thread("ba", ba)
+    t1.join(5.0)
+    t2.join(5.0)
+
+
+def scenario_leaked_future(ctx: Context) -> None:
+    """A future created and never resolved: the end-of-run obligation
+    check must flag it on EVERY schedule."""
+    ctx.future()   # leaked on purpose
+
+
+SCENARIOS: Dict[str, Callable[[Context], None]] = {
+    "migration": scenario_migration,
+    "migration_kill": scenario_migration_kill,
+    "batcher_death": scenario_batcher_death,
+    "decode_death": scenario_decode_death,
+    "drain": scenario_drain,
+    "breaker": scenario_breaker,
+    "double_claim": scenario_double_claim,
+    "deadlock": scenario_deadlock,
+    "leaked_future": scenario_leaked_future,
+}
+
+#: the scenarios a default checker run gates on (positive controls are
+#: excluded — they exist to prove the checker catches bugs)
+DEFAULT_SCENARIOS = ("migration", "migration_kill", "batcher_death",
+                     "decode_death", "drain", "breaker")

@@ -1,0 +1,213 @@
+"""Machine-readable protocol specs for the serving stack, checked on
+every transition while a dl4j-check harness is active.
+
+Three layers, all feeding :meth:`Scheduler.violation`:
+
+* **State machines over journal events** (:class:`SpecMonitor`): the
+  harness routes every ``events.emit`` through the monitor on the
+  emitting thread, so a transition is checked at the exact point the
+  code declares it.  Specs are data — explicit legal-transition tables
+  — so the protocol contract is reviewable apart from the code:
+
+  - :class:`SessionLifecycleSpec` — the DecodePool slot/session
+    lifecycle: ``(open) → claimed → active → exported-limbo →
+    reinstated | migrated | closed``, plus cross-pool rules: a session
+    id is live on at most ONE pool (exported limbo does not count —
+    "exported slots can't double-count"), drained pools admit nothing
+    (no ``session_opened``/``session_imported`` between ``decode.drain``
+    and ``decode.resumed``), a close out of exported limbo must name a
+    protocol reason (``migrated``/shutdown/death — never ``ttl``: a
+    migration window is not idleness).
+
+  - :class:`BreakerSpec` — the CircuitBreaker machine: ``closed → open
+    → half_open → {closed, open}`` (plus the ``reset()`` ops override
+    ``open → closed``); ``closed → half_open`` has no legal edge — a
+    breaker that skips its cooldown is broken.
+
+* **Invariant probes** (:func:`watch_decode_pool`): run at EVERY
+  scheduling point (the system is quiescent, so reading the slot table
+  without its lock is sound): no two sessions share a slot, no claimed
+  slot is simultaneously on the free list, every slot index is in
+  range.
+
+* **End-of-run obligations** (checked by the explorer): every future
+  created under the harness resolved — on every schedule, a dead
+  batcher (or any other path) never strands a waiter.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+LIVE = ("claimed", "active")
+
+#: legal CircuitBreaker transitions (from, to); "closed -> half_open"
+#: is deliberately absent
+BREAKER_LEGAL = (
+    ("closed", "open"),
+    ("open", "half_open"),
+    ("half_open", "closed"),
+    ("half_open", "open"),
+    ("open", "closed"),       # reset(): the documented ops override
+)
+
+#: reasons that may close a session OUT of exported limbo — protocol
+#: completions and failure teardowns, never idleness
+EXPORTED_CLOSE_REASONS = ("migrated", "shutdown", "batcher_died", "error")
+
+
+class SessionLifecycleSpec:
+    """DecodePool slot/session lifecycle + two-phase migration + drain
+    admission, driven by the ``decode.*`` journal events."""
+
+    name = "session-lifecycle"
+
+    def __init__(self, sched):
+        self._sched = sched
+        #: (model, session_id) -> state
+        self._state: Dict[Tuple[str, str], str] = {}
+        #: model -> draining?
+        self._draining: Dict[str, bool] = {}
+
+    # ------------------------------------------------------------------
+    def _fail(self, msg: str) -> None:
+        self._sched.violation("spec", f"[{self.name}] {msg}")
+
+    def _live_elsewhere(self, model: str, sid: str) -> Optional[str]:
+        for (m, s), st in self._state.items():
+            if s == sid and m != model and st in LIVE:
+                return m
+        return None
+
+    def on_event(self, etype: str, fields: dict) -> None:
+        sid = fields.get("session_id")
+        model = fields.get("model") or "-"
+        if etype == "decode.drain":
+            self._draining[model] = True
+            return
+        if etype == "decode.resumed":
+            self._draining[model] = False
+            return
+        if sid is None or (isinstance(sid, str)
+                           and sid.startswith("warmup-")):
+            return
+        key = (model, sid)
+        st = self._state.get(key)
+        if etype == "decode.session_opened":
+            if self._draining.get(model):
+                self._fail(f"session {sid} opened on {model} while the "
+                           "pool is draining (drain must admit nothing)")
+            if st in LIVE or st == "exported":
+                self._fail(f"session {sid} opened on {model} while "
+                           f"already {st} there (slot double-claim)")
+            other = self._live_elsewhere(model, sid)
+            if other:
+                self._fail(f"session {sid} opened on {model} while live "
+                           f"on {other} (double-live stream)")
+            self._state[key] = "claimed"
+        elif etype == "decode.step":
+            if st not in LIVE:
+                self._fail(f"decode.step for session {sid} on {model} "
+                           f"in state {st!r} (only claimed/active "
+                           "sessions may step)")
+            self._state[key] = "active"
+        elif etype == "decode.session_exported":
+            if st not in LIVE:
+                self._fail(f"session {sid} exported from {model} in "
+                           f"state {st!r} (nothing to snapshot)")
+            self._state[key] = "exported"
+        elif etype == "decode.session_reinstated":
+            if st != "exported":
+                self._fail(f"session {sid} reinstated on {model} in "
+                           f"state {st!r} (only exported limbo "
+                           "reinstates)")
+            self._state[key] = "active"
+        elif etype == "decode.session_imported":
+            if self._draining.get(model):
+                self._fail(f"session {sid} imported into {model} while "
+                           "the pool is draining (drain must admit "
+                           "nothing)")
+            if st in LIVE or st == "exported":
+                self._fail(f"session {sid} imported into {model} while "
+                           f"already {st} there")
+            other = self._live_elsewhere(model, sid)
+            if other:
+                self._fail(f"session {sid} imported into {model} while "
+                           f"live on {other} (the source must hold it "
+                           "in exported limbo, not serve it)")
+            self._state[key] = "active"
+        elif etype == "decode.session_closed":
+            reason = fields.get("reason")
+            if st is None:
+                self._fail(f"close event for unknown session {sid} on "
+                           f"{model}")
+            if st == "exported" and reason not in EXPORTED_CLOSE_REASONS:
+                self._fail(f"session {sid} closed out of exported limbo "
+                           f"with reason {reason!r} — a migration "
+                           "window is not idleness (expected one of "
+                           f"{EXPORTED_CLOSE_REASONS})")
+            self._state[key] = "closed"
+
+
+class BreakerSpec:
+    """CircuitBreaker legality over ``breaker.transition`` events."""
+
+    name = "breaker-lifecycle"
+
+    def __init__(self, sched):
+        self._sched = sched
+        self._state: Dict[str, str] = {}
+
+    def on_event(self, etype: str, fields: dict) -> None:
+        if etype != "breaker.transition":
+            return
+        name = fields.get("breaker") or "-"
+        to = fields.get("to")
+        frm = self._state.get(name, "closed")
+        if (frm, to) not in BREAKER_LEGAL:
+            self._sched.violation(
+                "spec", f"[{self.name}] breaker {name!r} transitioned "
+                        f"{frm} -> {to} (legal: {sorted(BREAKER_LEGAL)})")
+        self._state[name] = to
+
+
+class SpecMonitor:
+    """Fan events out to every registered spec (the harness installs
+    this behind ``events.emit``)."""
+
+    def __init__(self, sched, specs=None):
+        self.sched = sched
+        self.specs = list(specs) if specs is not None else [
+            SessionLifecycleSpec(sched), BreakerSpec(sched)]
+
+    def on_event(self, etype: str, severity: str, fields: dict) -> None:
+        for spec in self.specs:
+            spec.on_event(etype, fields)
+
+
+# ----------------------------------------------------------------------
+# Invariant probes (quiescent-state reads of pool internals)
+# ----------------------------------------------------------------------
+def _slot_probe(pool) -> Optional[str]:
+    sessions = list(pool._sessions.values())
+    slots = [s.slot for s in sessions]
+    if len(set(slots)) != len(slots):
+        dupes = sorted(x for x in set(slots) if slots.count(x) > 1)
+        return f"slot double-claim: slots {dupes} held by two sessions"
+    free = list(pool._free)
+    overlap = sorted(set(slots) & set(free))
+    if overlap:
+        return f"claimed slot(s) {overlap} also on the free list"
+    bad = sorted(x for x in slots if not 0 <= x < pool.max_slots)
+    if bad:
+        return f"slot index(es) {bad} out of range 0..{pool.max_slots - 1}"
+    if len(set(free)) != len(free):
+        return "free list holds a duplicate slot"
+    return None
+
+
+def watch_decode_pool(sched, pool) -> None:
+    """Register the slot-table invariants for ``pool`` on ``sched`` —
+    checked at every scheduling point of the run."""
+    sched.probes.append(
+        (f"slots:{pool.name or 'pool'}", lambda: _slot_probe(pool)))

@@ -133,6 +133,7 @@ class Baseline:
         self.entries = list(entries or [])
         self._fps: Set[str] = {e["fingerprint"] for e in self.entries
                                if "fingerprint" in e}
+        self._used: Set[str] = set()
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
@@ -144,6 +145,34 @@ class Baseline:
 
     def __contains__(self, finding: Finding) -> bool:
         return finding.fingerprint() in self._fps
+
+    def mark_used(self, finding: Finding) -> None:
+        self._used.add(finding.fingerprint())
+
+    def stale_entries(self) -> List[dict]:
+        """Entries whose fingerprint matched NO finding in the last
+        suppression pass: dead grandfathering that could silently mask
+        a future regression with the same fingerprint."""
+        return [e for e in self.entries
+                if e.get("fingerprint") not in self._used]
+
+    def prune(self) -> int:
+        """Rewrite the baseline file keeping only entries that still
+        fire; returns how many stale entries were dropped."""
+        stale = {e.get("fingerprint") for e in self.stale_entries()}
+        if not stale or self.path is None:
+            return 0
+        keep = [e for e in self.entries
+                if e.get("fingerprint") not in stale]
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "findings": keep}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
+        dropped = len(self.entries) - len(keep)
+        self.entries = keep
+        self._fps = {e["fingerprint"] for e in keep
+                     if "fingerprint" in e}
+        return dropped
 
     @staticmethod
     def write(path: str, findings: Sequence[Finding]) -> None:
@@ -261,6 +290,13 @@ LOCK_CTORS = {
     "Lock": "lock", "RLock": "rlock", "Condition": "condition",
     "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
 }
+#: queue constructors — tracked so blocking-call rules recognize a
+#: ``q.get()`` even when the variable isn't named queue-ishly
+QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+#: calls whose result is a Future: the ctor itself plus the submit
+#: verbs used across the serving stack
+FUTURE_CTORS = {"Future"}
+FUTURE_PRODUCERS = {"submit", "submit_step"}
 _LOCKISH_NAME_RE = re.compile(r"(^|_)(lock|mutex|cond)(_|$)|lock$|cond$",
                               re.IGNORECASE)
 
@@ -293,6 +329,8 @@ class Project:
         self._parents: Dict[str, Dict[ast.AST, ast.AST]] = {}
         self._fn_of_node: Dict[Tuple[str, int], FunctionInfo] = {}
         self.lock_attrs: Dict[str, str] = {}            # lock_id -> kind
+        self.queue_attrs: Set[str] = set()              # queue-typed ids
+        self.future_attrs: Set[str] = set()             # future-typed ids
         self.lock_sites: List[LockSite] = []
         self._jit_roots: List[FunctionInfo] = []
         self._jit_sites: Dict[str, List[ast.Call]] = {}  # path -> jit Call nodes
@@ -635,9 +673,44 @@ class Project:
             return None
         return lock_id, kind or "unknown"
 
+    def _binding_id(self, tchain: str, module: str,
+                    func: Optional[FunctionInfo]) -> str:
+        """Canonical id for an assignment TARGET chain (shared by the
+        lock/queue/future binding passes)."""
+        if tchain.startswith("self.") and func is not None \
+                and func.class_name:
+            return f"{module}:{func.class_name}.{tchain[len('self.'):]}"
+        if "." not in tchain and func is not None:
+            scope = func.qualname.split(":", 1)[1]
+            return f"{module}:{scope}.{tchain}"
+        return f"{module}:{tchain}"
+
+    def ids_for(self, expr: ast.AST, path: str,
+                func: Optional[FunctionInfo]) -> List[str]:
+        """Candidate canonical ids for an expression READ — used to
+        look a receiver up in the lock/queue/future binding tables."""
+        chain = _attr_chain(expr)
+        if chain is None:
+            return []
+        module = self.module_of(path)
+        out: List[str] = []
+        if chain.startswith("self.") and func is not None \
+                and func.class_name:
+            out.append(f"{module}:{func.class_name}."
+                       f"{chain[len('self.'):]}")
+        elif "." not in chain:
+            if func is not None:
+                scope = func.qualname.split(":", 1)[1]
+                out.append(f"{module}:{scope}.{chain}")
+            out.append(f"{module}:{chain}")
+        else:
+            out.append(f"{module}:{chain}")
+        return out
+
     def _find_locks(self) -> None:
         # pass 1: every `X = threading.Lock()`-style binding, so locks
-        # with non-lockish names are still tracked
+        # with non-lockish names are still tracked — queue and Future
+        # bindings ride the same pass for the blocking-call rules
         for f in self.files:
             if f.tree is None:
                 continue
@@ -650,9 +723,13 @@ class Project:
                     continue
                 chain = _attr_chain(value.func) or ""
                 ctor = chain.split(".")[-1]
-                if ctor not in LOCK_CTORS:
+                kind = LOCK_CTORS.get(ctor)
+                is_queue = ctor in QUEUE_CTORS
+                is_future = (ctor in FUTURE_CTORS
+                             or (isinstance(value.func, ast.Attribute)
+                                 and value.func.attr in FUTURE_PRODUCERS))
+                if kind is None and not is_queue and not is_future:
                     continue
-                kind = LOCK_CTORS[ctor]
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]
                 func = self.enclosing_function(f.path, node)
@@ -660,18 +737,13 @@ class Project:
                     tchain = _attr_chain(t)
                     if tchain is None:
                         continue
-                    if tchain.startswith("self.") and func is not None \
-                            and func.class_name:
-                        lock_id = (f"{module}:{func.class_name}."
-                                   f"{tchain[len('self.'):]}")
-                    elif "." not in tchain and func is None:
-                        lock_id = f"{module}:{tchain}"
-                    elif "." not in tchain and func is not None:
-                        scope = func.qualname.split(":", 1)[1]
-                        lock_id = f"{module}:{scope}.{tchain}"
-                    else:
-                        lock_id = f"{module}:{tchain}"
-                    self.lock_attrs[lock_id] = kind
+                    bid = self._binding_id(tchain, module, func)
+                    if kind is not None:
+                        self.lock_attrs[bid] = kind
+                    elif is_queue:
+                        self.queue_attrs.add(bid)
+                    elif is_future:
+                        self.future_attrs.add(bid)
         # pass 2: every with-lock region
         for f in self.files:
             if f.tree is None:
@@ -781,6 +853,50 @@ class Project:
                         out.append((f.path, elt, elt.value))
         return out
 
+    # -- thread spawn sites (for the thread-protocol rules) --------------
+    def thread_targets(self) -> List[
+            Tuple[str, ast.Call, List[FunctionInfo]]]:
+        """Every ``Thread(target=...)`` construction with its resolved
+        target functions (``[]`` when the target is not statically
+        resolvable — e.g. a bound method of another object)."""
+        out = []
+        for f in self.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func)
+                if not chain or chain.split(".")[-1] != "Thread":
+                    continue
+                texpr = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        texpr = kw.value
+                if texpr is None:
+                    continue
+                caller = self.enclosing_function(f.path, node)
+                targets = self._fn_arg_targets(texpr, caller, f.path)
+                out.append((f.path, node, targets))
+        return out
+
+    def held_locks_at(self, path: str, node: ast.AST,
+                      func: Optional[FunctionInfo]) -> Set[str]:
+        """Lock ids lexically held at ``node`` (enclosing with-lock
+        blocks in the same function)."""
+        held: Set[str] = set()
+        for anc in self.ancestors(path, node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                for item in anc.items:
+                    got = self._lock_id_and_kind(item.context_expr, path,
+                                                 func)
+                    if got is not None:
+                        held.add(got[0])
+        return held
+
 
 # ----------------------------------------------------------------------
 # Runner
@@ -860,6 +976,11 @@ def run_rules(project: Project,
 def apply_suppressions(project: Project, findings: Sequence[Finding],
                        baseline: Optional[Baseline] = None) -> None:
     for finding in findings:
+        # usage is tracked for EVERY matching finding (even ones a
+        # pragma also covers) so staleness means "fires nowhere", not
+        # "fires only where a noqa shadows it"
+        if baseline is not None and finding in baseline:
+            baseline.mark_used(finding)
         f = project.file(finding.path)
         if f is not None:
             reason = f.pragma_for(finding.rule, finding.line)

@@ -10,6 +10,10 @@ rule with :data:`deeplearning4j_tpu.analysis.core.RULES`.
 | DL4J201 | blocking-under-lock   | warning  | I/O or unbounded wait w/ lock|
 | DL4J202 | lock-order-cycle      | error    | cross-file deadlock ordering |
 | DL4J203 | bare-lock-acquire     | error    | acquire without finally      |
+| DL4J205 | future-success-path-only | warning | thread resolves futures only on success |
+| DL4J206 | unbounded-wait-device-thread | warning | no-timeout wait on device-owner thread |
+| DL4J207 | shared-write-outside-lock | warning | guarded attr written lock-free |
+| DL4J208 | thread-without-crash-handler | warning | spawned thread w/o crash handler |
 | DL4J301 | metric-undocumented   | error    | code metric not in docs      |
 | DL4J302 | metric-doc-stale      | error    | doc metric not in code       |
 | DL4J303 | event-undocumented    | error    | journal event not in docs    |
@@ -20,5 +24,6 @@ Rationale and worked examples: docs/ANALYSIS.md.
 
 from deeplearning4j_tpu.analysis import rules_concurrency  # noqa: F401
 from deeplearning4j_tpu.analysis import rules_metrics  # noqa: F401
+from deeplearning4j_tpu.analysis import rules_threads  # noqa: F401
 from deeplearning4j_tpu.analysis import rules_tracer  # noqa: F401
 from deeplearning4j_tpu.analysis.core import RULES  # noqa: F401

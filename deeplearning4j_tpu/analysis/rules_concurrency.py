@@ -41,6 +41,22 @@ def _timeout_kw(call: ast.Call) -> bool:
     return any(kw.arg == "timeout" for kw in call.keywords)
 
 
+def _is_queue_typed(project: Project, expr: ast.AST, path: str,
+                    func: Optional["FunctionInfo"]) -> bool:
+    """Receiver assigned from a ``queue.Queue()``-family constructor —
+    catches queues whose names don't look queue-ish."""
+    return any(i in project.queue_attrs
+               for i in project.ids_for(expr, path, func))
+
+
+def _is_future_typed(project: Project, expr: ast.AST, path: str,
+                     func: Optional["FunctionInfo"]) -> bool:
+    """Receiver assigned from ``Future()`` or a ``submit*()`` call —
+    catches futures whose names don't say fut/promise."""
+    return any(i in project.future_attrs
+               for i in project.ids_for(expr, path, func))
+
+
 def _block_false(call: ast.Call) -> bool:
     for kw in call.keywords:
         if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
@@ -73,7 +89,8 @@ def _blocking_reason(call: ast.Call, held_kinds: Dict[str, str],
             return None
         recv = _attr_chain(func_expr.value) or ""
         leaf = recv.split(".")[-1]
-        if "q" in leaf.lower() or "queue" in leaf.lower():
+        if "q" in leaf.lower() or "queue" in leaf.lower() \
+                or _is_queue_typed(project, func_expr.value, path, func):
             return f"{leaf}.{attr}() without timeout"
         return None
     if attr == "join" and not call.args and not call.keywords:
@@ -83,7 +100,8 @@ def _blocking_reason(call: ast.Call, held_kinds: Dict[str, str],
     if attr == "result" and not call.args and not _timeout_kw(call):
         recv = _attr_chain(func_expr.value) or ""
         leaf = recv.split(".")[-1].lower()
-        if "fut" in leaf or "promise" in leaf:
+        if "fut" in leaf or "promise" in leaf \
+                or _is_future_typed(project, func_expr.value, path, func):
             return f"{recv.split('.')[-1]}.result() without timeout"
         return None
     if attr == "wait" and not call.args and not _timeout_kw(call):

@@ -1,0 +1,346 @@
+"""Thread-protocol rules (DL4J205–208): the static side of the
+dl4j-check concurrency checker (analysis/check/).
+
+The checker explores interleavings of code that EXISTS; these rules
+gate the structural properties every thread in the serving stack must
+have before any interleaving is even safe to explore: a thread that
+resolves futures must resolve them on the error path too (DL4J205), a
+thread that owns device state must never park forever on an unbounded
+wait (DL4J206), a shared attribute guarded by a lock in most places
+must not be written lock-free in one (DL4J207), and every spawned
+thread needs a crash handler so a ``ThreadKill``-class death is a
+clean failure instead of a stranded-client hang (DL4J208 — the
+batcher/decode ``_loop_guarded`` pattern).
+
+All four skip test files: ad-hoc test threads are not serving-stack
+protocol surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from deeplearning4j_tpu.analysis.core import (
+    WARNING, Finding, FunctionInfo, Project, Rule, _attr_chain,
+    is_test_path, register)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _reach(project: Project, root: FunctionInfo,
+           max_fns: int = 200) -> List[FunctionInfo]:
+    """The statically-resolvable call-graph closure of a thread-main
+    function — the code that runs ON that thread."""
+    seen: Set[int] = {id(root.node)}
+    out = [root]
+    frontier = [root]
+    while frontier and len(out) < max_fns:
+        fn = frontier.pop()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                inner = project.enclosing_function(fn.path, node) or fn
+                for callee in project.resolve_call(node, inner, fn.path):
+                    if id(callee.node) not in seen:
+                        seen.add(id(callee.node))
+                        out.append(callee)
+                        frontier.append(callee)
+    return out
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    for n in names:
+        chain = _attr_chain(n)
+        if chain and chain.split(".")[-1] in _BROAD:
+            return True
+    return False
+
+
+def _has_crash_handler(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Try):
+            if any(_handler_is_broad(h) for h in n.handlers):
+                return True
+    return False
+
+
+def _in_except_or_finally(project: Project, path: str,
+                          node: ast.AST) -> bool:
+    for anc in project.ancestors(path, node):
+        if isinstance(anc, ast.ExceptHandler):
+            return True
+        if isinstance(anc, ast.Try):
+            for stmt in anc.finalbody:
+                for c in ast.walk(stmt):
+                    if c is node:
+                        return True
+    return False
+
+
+def _calls_with_attr(fn: FunctionInfo, attr: str) -> List[ast.Call]:
+    return [n for n in ast.walk(fn.node)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == attr]
+
+
+def _thread_mains(project: Project) -> List[
+        Tuple[str, ast.Call, FunctionInfo]]:
+    out = []
+    seen: Set[int] = set()
+    for path, call, targets in project.thread_targets():
+        if is_test_path(path):
+            continue
+        for t in targets:
+            if id(t.node) in seen:
+                continue
+            seen.add(id(t.node))
+            out.append((path, call, t))
+    return out
+
+
+@register
+class FutureNotResolvedOnAllPaths(Rule):
+    id = "DL4J205"
+    name = "future-success-path-only"
+    severity = WARNING
+    doc = ("A thread-main function (a `Thread(target=...)`) whose "
+           "reachable code resolves futures with `set_result` but has "
+           "no `set_exception` in any except/finally block: when the "
+           "thread's work raises, every waiter blocks forever.  The "
+           "batcher pattern — fail in-flight futures in the crash "
+           "handler — is the fix.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for _path, _call, main in _thread_mains(project):
+            reach = _reach(project, main)
+            set_results = [(fn, n) for fn in reach
+                           for n in _calls_with_attr(fn, "set_result")]
+            if not set_results:
+                continue
+            resolved_on_error = any(
+                _in_except_or_finally(project, fn.path, n)
+                for fn in reach
+                for n in _calls_with_attr(fn, "set_exception"))
+            if resolved_on_error:
+                continue
+            fn, node = set_results[0]
+            yield self.finding(
+                project, node, fn.path,
+                f"futures resolved only on the success path in code "
+                f"run by thread-main `{main.name}` — no set_exception "
+                "in any except/finally; a raising step strands every "
+                "waiter")
+
+
+@register
+class UnboundedWaitOnDeviceThread(Rule):
+    id = "DL4J206"
+    name = "unbounded-wait-device-thread"
+    severity = WARNING
+    doc = ("`Future.result()` or `queue.get()` with no timeout on a "
+           "thread that owns device state (its class touches "
+           "jax/jnp/device buffers): a wedged producer parks the ONLY "
+           "thread allowed to touch the device pool, and every session "
+           "stalls behind it.  Bound the wait and escalate.")
+
+    _DEVICE_ATTRS = {"device_put", "device_get", "block_until_ready",
+                     "jit"}
+
+    def _owns_device_state(self, project: Project,
+                           main: FunctionInfo,
+                           reach: List[FunctionInfo]) -> bool:
+        fns: List[FunctionInfo] = list(reach)
+        if main.class_name:
+            fns += list(project._by_class.get(
+                (main.module, main.class_name), {}).values())
+        for fn in fns:
+            for node in ast.walk(fn.node):
+                chain = _attr_chain(node) if isinstance(
+                    node, (ast.Attribute, ast.Name)) else None
+                if not chain:
+                    continue
+                head = chain.split(".")[0]
+                leaf = chain.split(".")[-1]
+                if head in ("jax", "jnp") or leaf in self._DEVICE_ATTRS:
+                    return True
+        return False
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        from deeplearning4j_tpu.analysis.rules_concurrency import (
+            _is_future_typed, _is_queue_typed, _timeout_kw)
+        for _path, _call, main in _thread_mains(project):
+            reach = _reach(project, main)
+            if not self._owns_device_state(project, main, reach):
+                continue
+            for fn in reach:
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Call) or \
+                            not isinstance(node.func, ast.Attribute):
+                        continue
+                    attr = node.func.attr
+                    if node.args or _timeout_kw(node):
+                        continue
+                    recv = _attr_chain(node.func.value) or ""
+                    leaf = recv.split(".")[-1].lower()
+                    futlike = attr == "result" and (
+                        "fut" in leaf or "promise" in leaf
+                        or _is_future_typed(project, node.func.value,
+                                            fn.path, fn))
+                    qlike = attr == "get" and (
+                        "q" in leaf or "queue" in leaf
+                        or _is_queue_typed(project, node.func.value,
+                                           fn.path, fn))
+                    if not (futlike or qlike):
+                        continue
+                    yield self.finding(
+                        project, node, fn.path,
+                        f"unbounded `{recv}.{attr}()` on thread-main "
+                        f"`{main.name}`'s thread, which owns device "
+                        "state — a wedged producer parks the device "
+                        "owner forever; use a timeout and escalate")
+
+
+@register
+class SharedWriteOutsideLock(Rule):
+    id = "DL4J207"
+    name = "shared-write-outside-lock"
+    severity = WARNING
+    doc = ("A `self.<attr>` written under one lock in ≥2 places but "
+           "written lock-free in a minority of sites (outside "
+           "`__init__`): the attribute→lock map is inferred from the "
+           "guarded accesses themselves, so the lock-free write is "
+           "either a data race or needs the `_locked`-suffix "
+           "convention (callers hold the lock) made explicit.")
+
+    _EXEMPT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        guards_by_method = self._method_call_guards(project)
+        for (module, cls), methods in sorted(project._by_class.items()):
+            writes = self._class_writes(project, methods)
+            if not writes:
+                continue
+            by_attr: Dict[str, List[Tuple]] = {}
+            for w in writes:
+                by_attr.setdefault(w[0], []).append(w)
+            for attr, ws in sorted(by_attr.items()):
+                lock_counts: Dict[str, int] = {}
+                for _a, _m, _n, guards in ws:
+                    for lid in guards:
+                        lock_counts[lid] = lock_counts.get(lid, 0) + 1
+                if not lock_counts:
+                    continue
+                lock = max(sorted(lock_counts), key=lock_counts.get)
+                guarded = lock_counts[lock]
+                if guarded < 2:
+                    continue
+                unguarded = [
+                    (a, m, n) for a, m, n, guards in ws
+                    if lock not in guards
+                    and not m.name.endswith("_locked")
+                    and not self._always_called_under(
+                        guards_by_method, m, lock)]
+                if not unguarded or len(unguarded) > guarded:
+                    # a majority of lock-free writes means a different
+                    # ownership discipline (e.g. a single owner
+                    # thread), not a forgotten lock
+                    continue
+                for _a, m, node in unguarded:
+                    lock_name = lock.split(":")[-1]
+                    yield self.finding(
+                        project, node, m.path,
+                        f"`self.{attr}` is written under `{lock_name}` "
+                        f"at {guarded} site(s) but written here "
+                        "without it — a data race, unless every caller "
+                        "holds the lock (then use the `_locked` name "
+                        "convention)")
+
+    def _class_writes(self, project: Project,
+                      methods: Dict[str, FunctionInfo]) -> List[Tuple]:
+        out: List[Tuple] = []
+        for mname, m in sorted(methods.items()):
+            if mname in self._EXEMPT_METHODS or is_test_path(m.path):
+                continue
+            for node in ast.walk(m.node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node is not m.node:
+                    continue
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = self._self_attr_of(t)
+                    if attr is None:
+                        continue
+                    guards = project.held_locks_at(m.path, node, m)
+                    out.append((attr, m, node, guards))
+        return out
+
+    @staticmethod
+    def _self_attr_of(t: ast.AST) -> Optional[str]:
+        # self.X = ... and self.X[k] = ... both mutate shared state
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self":
+            return t.attr
+        return None
+
+    @staticmethod
+    def _method_call_guards(project: Project) -> Dict[int, List[Set[str]]]:
+        """For every project function: the lock sets lexically held at
+        each of its call sites (the `_close_locked` pattern — a helper
+        only ever invoked under the lock — is guarded by convention)."""
+        out: Dict[int, List[Set[str]]] = {}
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                caller = project.enclosing_function(f.path, node)
+                for target in project.resolve_call(node, caller, f.path):
+                    held = project.held_locks_at(f.path, node, caller)
+                    out.setdefault(id(target.node), []).append(held)
+        return out
+
+    @staticmethod
+    def _always_called_under(guards_by_method, m: FunctionInfo,
+                             lock: str) -> bool:
+        sites = guards_by_method.get(id(m.node))
+        return bool(sites) and all(lock in held for held in sites)
+
+
+@register
+class ThreadWithoutCrashHandler(Rule):
+    id = "DL4J208"
+    name = "thread-without-crash-handler"
+    severity = WARNING
+    doc = ("A `Thread(target=f)` whose target has no try/except "
+           "catching Exception/BaseException anywhere in its body: a "
+           "ThreadKill-class death (or any bug) silently removes the "
+           "thread, and whatever it owed other threads — futures, "
+           "queue slots, readiness — is never delivered.  Wrap the "
+           "body like the batcher's `_loop_guarded`.")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for path, call, targets in project.thread_targets():
+            if is_test_path(path):
+                continue
+            for t in targets:
+                if _has_crash_handler(t.node):
+                    continue
+                yield self.finding(
+                    project, call, path,
+                    f"thread target `{t.name}` has no crash handler "
+                    "(no except Exception/BaseException in its body) — "
+                    "a dying thread strands everything that waits "
+                    "on it")

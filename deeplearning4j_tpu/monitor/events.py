@@ -64,7 +64,9 @@ EVENT_TYPES = (
     "decode.session_closed",
     "decode.session_exported",
     "decode.session_imported",
+    "decode.session_reinstated",
     "decode.drain",
+    "decode.resumed",
     "decode.died",
     "decode.restarted",
     "fleet.replica_added",

@@ -26,6 +26,7 @@ count/value in the length field.
 
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
@@ -33,6 +34,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<cI")
 _LEN = struct.Struct("<I")
@@ -70,13 +73,19 @@ class ParameterServerNode:
 
     # -- server loop --------------------------------------------------------
     def _serve(self) -> None:
-        while self._running:
-            try:
-                conn, _ = self._srv.accept()
-            except OSError:
-                break
-            threading.Thread(target=self._handle, args=(conn,),
-                             daemon=True).start()
+        # crash handler (DL4J208): an unexpected accept-loop error must
+        # be LOUD — a silently-dead acceptor looks alive to clients and
+        # strands every connect until timeout
+        try:
+            while self._running:
+                try:
+                    conn, _ = self._srv.accept()
+                except OSError:
+                    break
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+        except Exception:
+            log.exception("parameter-server accept loop died")
 
     def _handle(self, conn: socket.socket) -> None:
         try:
@@ -120,6 +129,11 @@ class ParameterServerNode:
                     break
         except (ConnectionError, OSError):
             pass
+        except Exception:
+            # crash handler (DL4J208): a malformed frame (struct/decode
+            # error) must not silently kill the handler thread
+            log.exception("parameter-server handler died on a "
+                          "malformed frame")
         finally:
             conn.close()
 

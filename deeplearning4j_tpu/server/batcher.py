@@ -228,11 +228,10 @@ class MicroBatcher:
         x = np.asarray(features)
         if x.ndim < 1 or x.shape[0] == 0:
             raise ValueError("submit() needs a non-empty [k, ...] row batch")
-        fut = Future()
         deadline = (None if timeout_ms is None
                     else time.monotonic() + float(timeout_ms) / 1e3)
-        p = _Pending(x, fut, time.perf_counter(), deadline, tenant,
-                     ctx=events.current_context())
+        t_enqueue = time.perf_counter()
+        ctx = events.current_context()
         restarted = False
         with self._cond:
             if not self._running:
@@ -245,7 +244,12 @@ class MicroBatcher:
                 self._c_restarts.inc()
                 self._thread = self._spawn_thread()
                 restarted = True
-            self._queue.append(p)
+            # the future is only born once the request is admitted — a
+            # rejected submit must not mint one (dl4j-check's resolved-
+            # on-all-schedules obligation counts every future)
+            fut = Future()
+            self._queue.append(_Pending(x, fut, t_enqueue, deadline,
+                                        tenant, ctx=ctx))
             self._cond.notify_all()
         if restarted:
             events.emit("batcher.restarted", model=self._name)

@@ -334,8 +334,11 @@ class DecodePool:
             self._sessions[sid] = DecodeSession(sid, slot, tenant)
             self.metrics.record_opened(tenant)
             self.metrics.g_active.set(self._active_locked())
-        events.emit("decode.session_opened", model=self.name,
-                    session_id=sid, slot=slot, tenant=tenant)
+            # emitted under the lock: journal order == admission order,
+            # so a drain started right after this admit journals AFTER
+            # it (the dl4j-check drain spec reads that ordering)
+            events.emit("decode.session_opened", model=self.name,
+                        session_id=sid, slot=slot, tenant=tenant)
         return sid
 
     def close_session(self, sid: str, reason: str = "closed") -> bool:
@@ -375,8 +378,15 @@ class DecodePool:
         if self.ttl_s <= 0:
             return 0
         now = time.monotonic() if now is None else now
+        # sessions in a migration window are NOT idle: TTL-reaping an
+        # exported-limbo session frees its slot while the carry is in
+        # flight to the target, and a failed import then has nothing to
+        # reinstate — the stream dies instead of resuming (surfaced by
+        # the dl4j-check session-lifecycle spec: close-from-exported
+        # must be a protocol completion, never `ttl`)
         expired = [sid for sid, s in self._sessions.items()
-                   if now - s.last_used > self.ttl_s]
+                   if not s.exported and not s.migrating
+                   and now - s.last_used > self.ttl_s]
         for sid in expired:
             self._close_locked(sid, reason="ttl")
         return len(expired)
@@ -420,7 +430,6 @@ class DecodePool:
         rows.  ``xs`` is one ``[T, ...]`` array per network input."""
         xs = self._normalize_inputs(xs)
         masks = self._normalize_masks(masks, xs)
-        fut = Future()
         deadline = (None if timeout_ms is None
                     else time.monotonic() + float(timeout_ms) / 1e3)
         with self._cond:
@@ -440,6 +449,10 @@ class DecodePool:
                 self.restarts += 1
                 self._thread = self._spawn_thread()
                 restarted = True
+            # the future is only born once the step is admitted — a
+            # rejected submit must not mint one (dl4j-check's resolved-
+            # on-all-schedules obligation counts every future)
+            fut = Future()
             p = _PendingStep(s, xs, masks, fut, deadline,
                              tenant if tenant is not None else s.tenant,
                              ctx=events.current_context())
@@ -595,9 +608,11 @@ class DecodePool:
             if s2 is not None:
                 s2.exported = True
                 self.metrics.g_active.set(self._active_locked())
-        events.emit("decode.session_exported", model=self.name,
-                    session_id=sid, slot=s.slot, tenant=s.tenant,
-                    steps=payload.get("steps"))
+                # under the lock: a finish_export racing in right after
+                # must journal its close AFTER this export
+                events.emit("decode.session_exported", model=self.name,
+                            session_id=sid, slot=s.slot, tenant=s.tenant,
+                            steps=payload.get("steps"))
         return payload
 
     def finish_export(self, sid: str, ok: bool = True) -> bool:
@@ -615,6 +630,9 @@ class DecodePool:
             s.migrating = False
             s.last_used = time.monotonic()   # limbo time is not idle time
             self.metrics.g_active.set(self._active_locked())
+            events.emit("decode.session_reinstated", model=self.name,
+                        session_id=sid, slot=s.slot, tenant=s.tenant,
+                        steps=s.steps)
             return True
 
     def import_session(self, payload: dict, session_id: Optional[str] = None,
@@ -653,6 +671,14 @@ class DecodePool:
             self._sessions[sid] = s
             self.metrics.record_opened(tenant)
             self.metrics.g_active.set(self._active_locked())
+            # emitted at the ADMIT point (under the lock), not after the
+            # carry scatter: a drain that starts while the scatter runs
+            # must journal after this admit, and a failed scatter follows
+            # up with session_closed(error) — journal order stays the
+            # protocol order (the dl4j-check specs depend on it)
+            events.emit("decode.session_imported", model=self.name,
+                        session_id=sid, slot=slot, tenant=tenant,
+                        steps=s.steps)
         try:
             if payload.get("carry") is not None:
                 fut = self._submit_control("import", (s, payload))
@@ -660,9 +686,6 @@ class DecodePool:
         except BaseException:
             self.close_session(sid, reason="error")
             raise
-        events.emit("decode.session_imported", model=self.name,
-                    session_id=sid, slot=slot, tenant=tenant,
-                    steps=s.steps)
         return sid
 
     def drain(self, deadline_s: Optional[float] = None) -> dict:
@@ -692,7 +715,12 @@ class DecodePool:
     def resume(self) -> None:
         """Clear the draining flag (rollout finished or aborted)."""
         with self._cond:
+            was = self._draining
             self._draining = False
+            if was:
+                # under the lock: a session admitted the instant the
+                # flag clears journals AFTER the resumed event
+                events.emit("decode.resumed", model=self.name)
 
     def _wait_steps_drained(self, sid: str, deadline: float) -> None:
         """Block until no queued or in-flight step references ``sid`` —
@@ -818,7 +846,7 @@ class DecodePool:
                     f"slot's {tuple(p.shape[1:])}")
             new_leaves.append(
                 p.at[session.slot].set(jnp.asarray(a).astype(p.dtype)))
-        self._pool = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        self._pool = jax.tree_util.tree_unflatten(treedef, new_leaves)  # dl4j: noqa[DL4J207] control-queue op: only the batcher thread (the pool's single owner) runs this; the locked writes are the crash paths
         return {"slot": session.slot, "leaves": len(new_leaves)}
 
     # ------------------------------------------------------------------
@@ -1026,10 +1054,10 @@ class DecodePool:
         else:
             tmpl = self.model.rnn_carry_template(
                 n, feature_tail=tails[0], dtype=dtype)
-        self._pool = tmpl
+        self._pool = tmpl  # dl4j: noqa[DL4J207] batcher-thread-only write: the device pool has ONE owning thread; the locked writes are the crash paths
         self._tails = tuple(tuple(t[1:]) for t in tails)
         self._dtype = np.dtype(dtype)
-        self._step_jit = jax.jit(  # dl4j: noqa[DL4J104] one jit per pool over a fixed is_graph, cached in self._step_jit for the pool's lifetime
+        self._step_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool over a fixed is_graph, cached by the owning batcher thread for the pool's lifetime; locked writes are the crash paths
             _pool_step_raw(self.model, self._is_graph),
             donate_argnums=(2,))
 

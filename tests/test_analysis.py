@@ -300,6 +300,238 @@ def test_bare_acquire_positive_and_negative(tmp_path):
     assert [f.line for f in findings] == [7]
 
 
+def test_blocking_under_lock_ctor_typed_queue_and_future(tmp_path):
+    # DL4J201 extension: receivers recognized by their CONSTRUCTOR
+    # (queue.Queue() / submit()) even when the name says neither
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import queue
+        import threading
+
+        _lock = threading.Lock()
+        _work = queue.Queue()
+
+        def bad_get():
+            with _lock:
+                return _work.get()           # positive: ctor-typed
+
+        def bad_result(pool):
+            item = pool.submit(job)
+            with _lock:
+                return item.result()         # positive: submit-typed
+
+        def good_result(pool):
+            item = pool.submit(job)
+            with _lock:
+                return item.result(5.0)      # negative: bounded
+
+        def job():
+            return 1
+    """}, rules=["DL4J201"])
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert any("_work.get() without timeout" in m for m in msgs)
+    assert any("item.result() without timeout" in m for m in msgs)
+
+
+# ----------------------------------------------------------------------
+# Thread-protocol rules (DL4J205–208)
+# ----------------------------------------------------------------------
+def test_future_success_path_only(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class BadWorker:
+            def __init__(self):
+                self._pending = []
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                for item, fut in self._pending:
+                    fut.set_result(item)     # positive: success only
+
+        class GoodWorker:
+            def __init__(self):
+                self._pending = []
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                for item, fut in self._pending:
+                    try:
+                        fut.set_result(work(item))
+                    except Exception as e:
+                        fut.set_exception(e)  # resolved on error too
+
+        def work(item):
+            return item
+    """}, rules=["DL4J205"])
+    assert len(findings) == 1
+    assert "success path" in findings[0].message
+    assert "BadWorker._loop" in findings[0].symbol
+
+
+def test_unbounded_wait_on_device_thread(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import queue
+        import threading
+
+        import jax.numpy as jnp
+
+        class DeviceOwner:
+            def __init__(self):
+                self._work = queue.Queue()
+                self._buf = jnp.zeros((4,))
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                try:
+                    while True:
+                        item = self._work.get()      # positive
+                except Exception:
+                    pass
+
+        class HostOnly:
+            def __init__(self):
+                self._work = queue.Queue()
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                try:
+                    while True:
+                        item = self._work.get()      # negative: no device
+                except Exception:
+                    pass
+
+        class BoundedOwner:
+            def __init__(self):
+                self._work = queue.Queue()
+                self._buf = jnp.zeros((4,))
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                try:
+                    while True:
+                        item = self._work.get(timeout=1.0)   # negative
+                except Exception:
+                    pass
+    """}, rules=["DL4J206"])
+    assert len(findings) == 1
+    assert "owns device" in findings[0].message
+    assert "DeviceOwner._loop" in findings[0].symbol
+
+
+def test_shared_write_outside_lock(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def dec(self):
+                with self._lock:
+                    self.n -= 1
+
+            def reset(self):
+                self.n = 0        # positive: lock-free minority write
+
+        class Disciplined:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def dec(self):
+                with self._lock:
+                    self.n -= 1
+
+            def reset(self):
+                with self._lock:
+                    self._reset_locked()
+
+            def _reset_locked(self):
+                self.n = 0        # negative: _locked convention
+    """}, rules=["DL4J207"])
+    assert len(findings) == 1
+    assert "self.n" in findings[0].message
+    assert findings[0].symbol == "Counter.reset"
+
+
+def test_shared_write_majority_unguarded_is_owner_thread_style(tmp_path):
+    # a single-owner-thread attribute (most writes lock-free, the
+    # locked ones being crash paths) must NOT be flagged
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.buf = None
+
+            def step_a(self):
+                self.buf = 1
+
+            def step_b(self):
+                self.buf = 2
+
+            def step_c(self):
+                self.buf = 3
+
+            def crash_a(self):
+                with self._lock:
+                    self.buf = None
+
+            def crash_b(self):
+                with self._lock:
+                    self.buf = None
+    """}, rules=["DL4J207"])
+    assert findings == []
+
+
+def test_thread_without_crash_handler(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import threading
+
+        def fragile():
+            work()                   # positive: no handler
+
+        def sturdy():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def spawn():
+            threading.Thread(target=fragile).start()
+            threading.Thread(target=sturdy).start()
+
+        def work():
+            return 1
+    """}, rules=["DL4J208"])
+    assert len(findings) == 1
+    assert "fragile" in findings[0].message
+
+
+def test_thread_rules_exempt_test_files(tmp_path):
+    findings, _ = run_lint(tmp_path, {"test_m.py": """
+        import threading
+
+        def fragile():
+            return 1
+
+        def spawn():
+            threading.Thread(target=fragile).start()
+    """}, rules=["DL4J205", "DL4J206", "DL4J207", "DL4J208"])
+    assert findings == []
+
+
 # ----------------------------------------------------------------------
 # Observability drift rules
 # ----------------------------------------------------------------------
@@ -530,6 +762,69 @@ def test_cli_json_schema_and_exit_codes(tmp_path):
         cwd=str(tmp_path), env=env, capture_output=True, text=True,
         timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_stale_baseline_warned_and_pruned(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import jax
+        import jax.numpy as jnp
+
+        def step(p):
+            return float(jnp.sum(p))
+
+        fast = jax.jit(step)
+    """}, rules=["DL4J101"])
+    bl = tmp_path / "baseline.json"
+    core.Baseline.write(str(bl), findings)
+    # poison the baseline with an entry that fires nowhere
+    doc = json.loads(bl.read_text())
+    doc["findings"].append({
+        "rule": "DL4J101", "path": "gone.py", "symbol": "ghost",
+        "message": "host sync that no longer exists",
+        "fingerprint": "DL4J101::gone.py::ghost::stale"})
+    bl.write_text(json.dumps(doc))
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", "m.py",
+         "--baseline", str(bl), "--rules", "DL4J101",
+         "--format", "json"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["summary"]["stale_baseline"] == \
+        ["DL4J101::gone.py::ghost::stale"]
+    # text mode prints the warning
+    proc_t = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", "m.py",
+         "--baseline", str(bl), "--rules", "DL4J101"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert "stale baseline entry" in proc_t.stdout
+
+    # --prune-baseline drops exactly the stale entry
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", "m.py",
+         "--baseline", str(bl), "--rules", "DL4J101",
+         "--prune-baseline"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "1 stale entry dropped" in proc2.stdout
+    kept = json.loads(bl.read_text())["findings"]
+    assert len(kept) == 1 and kept[0]["path"] == "m.py"
+    # pruned baseline still suppresses the live finding
+    proc3 = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis", "m.py",
+         "--baseline", str(bl), "--rules", "DL4J101",
+         "--format", "json"],
+        cwd=str(tmp_path), env=env, capture_output=True, text=True,
+        timeout=120)
+    out3 = json.loads(proc3.stdout)
+    assert proc3.returncode == 0
+    assert out3["summary"]["stale_baseline"] == []
+    assert out3["summary"]["baselined"] == 1
 
 
 def test_parse_error_is_a_finding(tmp_path):

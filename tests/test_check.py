@@ -1,0 +1,252 @@
+"""dl4j-check (analysis/check/) tests: scheduler determinism (same
+seed ⇒ byte-identical trace), bounded exploration of the serving-stack
+protocols at zero violations (the tier-1 acceptance: ≥500 distinct
+interleavings of the migration and batcher-death protocols), positive
+controls (synthetic double-claim found AND replayable from its saved
+trace; deadlock detected), spec-machine unit checks, end-of-run future
+obligations, CLI exit codes, and harness hygiene (patches restored, no
+leaked threads)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.analysis.check import (
+    DEFAULT_SCENARIOS, Harness, RandomPolicy, Scheduler, SpecMonitor,
+    explore, replay, replay_file, run_once, save_trace)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# Determinism and replay
+# ----------------------------------------------------------------------
+def test_same_seed_byte_identical_trace():
+    a = run_once("migration", RandomPolicy(seed=7))
+    b = run_once("migration", RandomPolicy(seed=7))
+    assert a.trace == b.trace
+    assert a.trace_hash == b.trace_hash
+    assert a.decisions == b.decisions
+    # different seeds actually explore: several seeds, ≥2 schedules
+    hashes = {run_once("migration", RandomPolicy(seed=s)).trace_hash
+              for s in (7, 11, 13, 17)}
+    assert len(hashes) >= 2
+
+
+def test_kill_scenario_trace_deterministic():
+    a = run_once("migration_kill", RandomPolicy(seed=3))
+    b = run_once("migration_kill", RandomPolicy(seed=3))
+    assert a.trace == b.trace
+    assert [v.kind for v in a.violations] == \
+        [v.kind for v in b.violations]
+
+
+def test_double_claim_found_and_replays_from_saved_trace(tmp_path):
+    r = explore("double_claim", schedules=40, seed=0, p_preempt=0.6)
+    assert r.violations, "the synthetic double-claim bug was never found"
+    v = r.violations[0]
+    assert v["kind"] == "invariant"
+    assert "double-claim" in v["message"]
+    assert v["decisions"], "violation carries no replay recipe"
+    path = tmp_path / "failing_schedule.json"
+    save_trace(v, str(path))
+    rr = replay_file(str(path))
+    assert [x.kind for x in rr.violations] == ["invariant"]
+    assert rr.violations[0].message == v["message"]
+    # the replay is the SAME interleaving, byte for byte
+    assert rr.trace_hash == v["trace_hash"]
+
+
+def test_exhaustive_mode_enumerates_deterministically():
+    r1 = explore("double_claim", mode="exhaustive", schedules=200,
+                 seed=0)
+    r2 = explore("double_claim", mode="exhaustive", schedules=200,
+                 seed=0)
+    assert (r1.runs, r1.distinct, len(r1.violations)) == \
+        (r2.runs, r2.distinct, len(r2.violations))
+    assert r1.distinct >= 20, "exhaustive mode barely branched"
+    assert r1.violations, "exhaustive exploration missed the bug"
+
+
+def test_deadlock_detected_and_replayable():
+    r = explore("deadlock", schedules=30, seed=0, p_preempt=0.6)
+    deadlocks = [v for v in r.violations if v["kind"] == "deadlock"]
+    assert deadlocks, "two-lock inversion never deadlocked"
+    v = deadlocks[0]
+    assert "ab" in v["message"] and "ba" in v["message"]
+    rr = replay("deadlock", v["decisions"])
+    assert any(x.kind == "deadlock" for x in rr.violations)
+
+
+def test_leaked_future_flagged_on_every_schedule():
+    r = explore("leaked_future", schedules=3, seed=0)
+    assert len(r.violations) == 3
+    assert all(v["kind"] == "future-unresolved" for v in r.violations)
+
+
+# ----------------------------------------------------------------------
+# Protocol exploration at zero violations
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("scenario", DEFAULT_SCENARIOS)
+def test_protocol_scenarios_clean(scenario):
+    r = explore(scenario, schedules=15, seed=0)
+    assert r.violations == [], (scenario, r.violations[:3])
+    assert r.distinct >= 10, (scenario, r.distinct)
+
+
+def test_tier1_bounded_exploration_500_distinct_interleavings():
+    """The acceptance bar: ≥500 distinct interleavings of the
+    migration and batcher-death protocols, time-budgeted, at zero
+    unsuppressed invariant violations."""
+    total = 0
+    for name in ("migration", "migration_kill", "batcher_death",
+                 "decode_death"):
+        r = explore(name, schedules=160, seed=0, time_budget_s=120.0)
+        assert r.violations == [], (name, r.violations[:3])
+        total += r.distinct
+    assert total >= 500, f"only {total} distinct interleavings"
+
+
+# ----------------------------------------------------------------------
+# Spec machines (unit, via synthetic events)
+# ----------------------------------------------------------------------
+def _run_synthetic(emits):
+    from deeplearning4j_tpu.monitor import events
+    sched = Scheduler(policy=RandomPolicy(0))
+    mon = SpecMonitor(sched)
+
+    def root():
+        for etype, fields in emits:
+            events.emit(etype, **fields)
+
+    with Harness(sched, mon):
+        sched.run(root)
+    return sched.violations
+
+
+def test_breaker_spec_rejects_skipped_cooldown():
+    violations = _run_synthetic([
+        ("breaker.transition", {"breaker": "syn", "to": "half_open"}),
+    ])
+    assert any(v.kind == "spec" and "closed -> half_open" in v.message
+               for v in violations)
+
+
+def test_breaker_spec_accepts_legal_lifecycle():
+    violations = _run_synthetic([
+        ("breaker.transition", {"breaker": "syn", "to": "open"}),
+        ("breaker.transition", {"breaker": "syn", "to": "half_open"}),
+        ("breaker.transition", {"breaker": "syn", "to": "closed"}),
+    ])
+    assert [v for v in violations if v.kind == "spec"] == []
+
+
+def test_lifecycle_spec_rejects_double_open_and_ttl_from_limbo():
+    violations = _run_synthetic([
+        ("decode.session_opened", {"model": "m", "session_id": "s1",
+                                   "slot": 0}),
+        ("decode.session_opened", {"model": "m", "session_id": "s1",
+                                   "slot": 1}),
+        ("decode.session_exported", {"model": "m", "session_id": "s1",
+                                     "slot": 0}),
+        ("decode.session_closed", {"model": "m", "session_id": "s1",
+                                   "reason": "ttl"}),
+    ])
+    msgs = [v.message for v in violations if v.kind == "spec"]
+    assert any("double-claim" in m for m in msgs)
+    assert any("not idleness" in m for m in msgs)
+
+
+def test_lifecycle_spec_rejects_admit_while_draining():
+    violations = _run_synthetic([
+        ("decode.drain", {"model": "m", "sessions": 0}),
+        ("decode.session_opened", {"model": "m", "session_id": "s2",
+                                   "slot": 0}),
+        ("decode.resumed", {"model": "m"}),
+        ("decode.session_opened", {"model": "m", "session_id": "s3",
+                                   "slot": 1}),
+    ])
+    draining = [v for v in violations
+                if v.kind == "spec" and "draining" in v.message]
+    assert len(draining) == 1, violations
+    assert "s2" in draining[0].message
+
+
+# ----------------------------------------------------------------------
+# Harness hygiene
+# ----------------------------------------------------------------------
+def test_harness_restores_patches_and_joins_threads():
+    import queue
+    import threading
+    import time
+    before = threading.active_count()
+    explore("migration", schedules=3, seed=0)
+    assert threading.Thread.__module__ == "threading"
+    assert threading.Condition.__module__ == "threading"
+    assert queue.Queue.__module__ == "queue"
+    assert "fake" not in repr(time.monotonic)
+    from deeplearning4j_tpu.monitor import events
+    assert events.emit.__qualname__.startswith("EventJournal")
+    # clean scenarios stop their pools: managed threads all exited
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before + 1
+
+
+def test_nested_harness_rejected():
+    sched = Scheduler(policy=RandomPolicy(0))
+    with Harness(sched, None):
+        with pytest.raises(RuntimeError, match="active"):
+            with Harness(Scheduler(policy=RandomPolicy(0)), None):
+                pass
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+def _cli(args, timeout=300):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis.check",
+         *args],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def test_cli_clean_scenario_exits_zero_with_json():
+    proc = _cli(["--scenarios", "batcher_death", "--schedules", "6",
+                 "--format", "json"])
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-1000:]
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert doc["ok"] is True
+    assert doc["total_runs"] == 6
+    assert doc["scenarios"]["batcher_death"]["distinct"] >= 1
+    assert doc["violations"] == []
+
+
+def test_cli_violation_exits_one_and_replays(tmp_path):
+    trace = tmp_path / "fail.json"
+    proc = _cli(["--scenarios", "double_claim", "--schedules", "40",
+                 "--save-trace", str(trace), "--format", "json"])
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    assert trace.exists()
+    doc = json.loads(proc.stdout)
+    assert doc["violations"]
+    proc2 = _cli(["--replay", str(trace), "--format", "json"])
+    assert proc2.returncode == 1, proc2.stdout[-2000:]
+    doc2 = json.loads(proc2.stdout)
+    assert doc2["violations"]
+    assert doc2["violations"][0]["kind"] == "invariant"
+
+
+def test_cli_list_names_every_scenario():
+    proc = _cli(["--list"])
+    assert proc.returncode == 0
+    for name in DEFAULT_SCENARIOS + ("double_claim", "deadlock"):
+        assert name in proc.stdout

@@ -559,6 +559,38 @@ def test_decode_manager_adopts_new_model_after_drain(tmp_path):
         mgr.close()
 
 
+def test_ttl_sweep_spares_migration_window():
+    """Regression (dl4j-check session-lifecycle spec): an exported-limbo
+    session is mid-protocol, not idle — the TTL sweep must not reap it,
+    or a failed import has nothing to reinstate and the stream dies."""
+    from deeplearning4j_tpu.analysis.check.scenarios import (
+        CheckDecodePool, _StubModel)
+    pool = CheckDecodePool(_StubModel(), name="ttl-limbo", max_slots=2,
+                           ttl_s=0.05, max_wait_ms=0.0)
+    try:
+        sid = pool.open_session(tenant="t")
+        pool.step(sid, np.zeros((1, 1), np.float32), timeout=30)
+        payload = pool.export_session(sid, timeout=30)
+        assert payload["session_id"] == sid
+        time.sleep(0.15)           # well past ttl_s while in limbo
+        assert pool.sweep() == 0, "TTL reaped an exported session"
+        assert pool.held_slots == 1
+        # the import "failed": reinstate and keep streaming, carry intact
+        assert pool.finish_export(sid, ok=False)
+        out = pool.step(sid, np.zeros((1, 1), np.float32), timeout=30)
+        assert float(np.asarray(out[0]).ravel()[0]) == 2.0
+        evs = monitor.events.get_journal().tail(
+            etype="decode.session_reinstated")
+        assert any(e.get("session_id") == sid for e in evs)
+        # idle non-exported sessions still expire (the idle batcher
+        # loop may beat this explicit sweep to it)
+        time.sleep(0.15)
+        pool.sweep()
+        assert pool.held_slots == 0
+    finally:
+        pool.stop(timeout=10.0)
+
+
 # ---------------------------------------------------------------------------
 # Sharded serving (parallel/fsdp.jit_sharded_output, ROADMAP 3a)
 # ---------------------------------------------------------------------------
