@@ -1536,6 +1536,158 @@ def bench_decode():
     }
 
 
+def bench_spec():
+    """KV-cache + speculative-decode A/B (ISSUE 13, ROADMAP 2).
+
+    Leg A — **cached vs re-run-window attention**: an attention model
+    decodes token-by-token through the slot pool (the KV-ring carry
+    makes each step O(window)) against the only alternative without a
+    cache: re-running ``output()`` over the whole consumed window for
+    every new token (O(T)).  Reports per-token time at T=64 and T=256
+    for both; the cached line's 256/64 ratio must stay ~flat (≤ 1.2)
+    while the re-run line grows ~O(T).
+
+    Leg B — **speculative on vs off**: greedy generation through the
+    fused verify program (one compiled dispatch scores the pending
+    token + K n-gram drafts and commits the agreeing prefix) against
+    plain one-token-per-dispatch greedy decode.  Exact same emitted
+    tokens (greedy parity is exact by construction); reports dispatches
+    per accepted token, acceptance rate, and wall-clock tokens/sec."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.server.decode import DecodePool
+    from deeplearning4j_tpu.server.speculative import (
+        NGramDraft, SpeculativeDecoder, one_hot)
+
+    V, H, K, T = 16, 32, 2, 256
+    CHECKPOINTS = (64, 256)
+    conf = (NeuralNetConfiguration.builder().seed(29).learning_rate(0.01)
+            .shape_bucketing(True)
+            .list()
+            .layer(L.SelfAttentionLayer(n_in=V, n_out=H, n_heads=4,
+                                        causal=True, cache_window=T))
+            .layer(L.RnnOutputLayer(n_in=H, n_out=V, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(41)
+    x = rng.normal(size=(K, T, V)).astype(np.float32)
+
+    # --- leg A1: re-run-window.  Serving one more token without a KV
+    # cache means output() over the full consumed window — per-token
+    # cost IS one whole-window forward (warmed off-clock per rung).
+    rerun = {}
+    for p in CHECKPOINTS:
+        net.output(x[:, :p])
+        reps = [0.0] * 3
+        for i in range(len(reps)):
+            t0 = time.perf_counter()
+            np.asarray(net.output(x[:, :p]))
+            reps[i] = time.perf_counter() - t0
+        rerun[str(p)] = {"per_token_ms":
+                         round(statistics.median(reps) * 1e3, 3)}
+    rerun_ratio = (rerun[str(CHECKPOINTS[-1])]["per_token_ms"]
+                   / max(rerun[str(CHECKPOINTS[0])]["per_token_ms"], 1e-9))
+
+    # --- leg A2: KV-cached slot decode, token-by-token.
+    pool = DecodePool(net, name="bench_spec", max_slots=K,
+                      max_wait_ms=5.0, min_batch=K)
+    sids = [pool.open_session() for _ in range(K)]
+    tok = {"t": 0}
+
+    def step_round():
+        t = tok["t"]
+        futs = [pool.submit_step(sid, x[i, t % T:t % T + 1])
+                for i, sid in enumerate(sids)]
+        for f in futs:
+            f.result(timeout=120)
+        tok["t"] += 1
+
+    step_round()   # compile off-clock
+    cached = {}
+    prev = 1
+    for p in CHECKPOINTS:
+        n = p - prev
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step_round()
+        cached[str(p)] = {"per_token_ms":
+                          round((time.perf_counter() - t0) / n * 1e3, 3)}
+        prev = p
+    flat = (cached[str(CHECKPOINTS[-1])]["per_token_ms"]
+            / max(cached[str(CHECKPOINTS[0])]["per_token_ms"], 1e-9))
+    for sid in sids:
+        pool.close_session(sid)
+
+    # --- leg B: speculative on/off greedy generation.  The untrained
+    # model's greedy feedback loop settles into a repetitive stream —
+    # the draft-friendly regime structured output lives in — so the
+    # n-gram proposer reaches high acceptance after its cold start.
+    N_GEN = 96
+    prompt = one_hot([i % V for i in range(4)], V)
+
+    def greedy_plain():
+        sid = pool.open_session()
+        (o,) = pool.step(sid, prompt)
+        pending = int(np.argmax(o[-1]))
+        toks = []
+        t0 = time.perf_counter()
+        for _ in range(N_GEN):
+            toks.append(pending)
+            (o,) = pool.step(sid, one_hot([pending], V))
+            pending = int(np.argmax(o[-1]))
+        dt = time.perf_counter() - t0
+        pool.close_session(sid)
+        return toks, N_GEN, dt       # one dispatch per token
+
+    def greedy_spec(k):
+        sid = pool.open_session()
+        (o,) = pool.step(sid, prompt)
+        first = int(np.argmax(o[-1]))
+        dec = SpeculativeDecoder(pool, vocab=V, k=k,
+                                 draft=NGramDraft(order=3))
+        t0 = time.perf_counter()
+        res = dec.generate(sid, first, N_GEN)
+        dt = time.perf_counter() - t0
+        pool.close_session(sid)
+        return res["tokens"], res["dispatches"], dt
+
+    greedy_spec(3)   # warm the spec program rungs off-clock
+    toks_off, disp_off, dt_off = greedy_plain()
+    toks_on, disp_on, dt_on = greedy_spec(3)
+    parity = toks_on == toks_off
+    spec_stats = {k: v for k, v in pool.metrics.snapshot().items()
+                  if k.startswith("spec")}
+    st = pool.stats()
+    programs = {"decode": st.get("decode_programs", 0),
+                "spec": st.get("spec_programs", 0)}
+    pool.stop()
+
+    tokens_per_dispatch = N_GEN / max(disp_on, 1)
+    return {
+        "metric": "speculative greedy decode, accepted tokens per "
+                  "compiled dispatch",
+        "value": round(tokens_per_dispatch, 2),
+        "unit": "tokens/dispatch",
+        "cached_per_token_ms": cached,
+        "cached_flat_ratio_256_over_64": round(flat, 3),
+        "cached_flat": flat <= 1.2,
+        "rerun_window_per_token_ms": rerun,
+        "rerun_ratio_256_over_64": round(rerun_ratio, 3),
+        "spec_greedy_parity": parity,
+        "spec_dispatches": disp_on,
+        "plain_dispatches": disp_off,
+        "dispatch_reduction": round(disp_off / max(disp_on, 1), 2),
+        "meets_2x_accept_target": tokens_per_dispatch >= 2.0,
+        "spec_tokens_per_sec": round(N_GEN / max(dt_on, 1e-9), 1),
+        "plain_tokens_per_sec": round(N_GEN / max(dt_off, 1e-9), 1),
+        "pool_spec_counters": spec_stats,
+        "compiled_programs": programs,
+        "kv_cache": st.get("kv_cache"),
+    }
+
+
 def bench_fleet():
     """Fleet scaling A/B (ROADMAP 3 → the fleet tier): K closed-loop
     decode clients streaming through the consistent-hash
@@ -1990,6 +2142,7 @@ def _run_configs(result):
         ("bench_pipeline", bench_pipeline),
         ("bench_serving", bench_serving),
         ("bench_decode", bench_decode),
+        ("bench_spec", bench_spec),
         ("bench_fleet", bench_fleet),
         ("bench_resilience", bench_resilience),
         ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
@@ -2022,7 +2175,8 @@ def _run_configs(result):
         # fallback round still yields charrnn/word2vec evidence
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
                  "bench_kernels", "bench_pipeline", "bench_serving",
-                 "bench_decode", "bench_fleet", "bench_resilience",
+                 "bench_decode", "bench_spec", "bench_fleet",
+                 "bench_resilience",
                  "bench_sharded", "bench_sharded_serving", "charrnn",
                  "word2vec", "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])

@@ -141,6 +141,51 @@ class CheckDecodePool(DecodePool):
         self._step_jit = _counting_pool_step
 
 
+def _kv_pool_step(params, state, pool, idx, fresh, xs, fms):
+    """Counting carry PLUS a KV write-position leaf, keeping the
+    attention ring's contract: ``kv_pos`` is the per-slot count of
+    tokens ever written (monotone; fresh rows zero it in-trace), which
+    is exactly what ``specs._KVRingWatch`` checks at every scheduling
+    point — a slot collision, a stale un-zeroed ring, or a ring that
+    moves in exported limbo shows as a wrong position VALUE."""
+    h = np.asarray(pool["h"])
+    kv = np.asarray(pool["kv_pos"])
+    idx = np.asarray(idx)
+    fresh = np.asarray(fresh)
+    g = h[idx] * (1.0 - fresh)[:, None]
+    gkv = kv[idx] * (1.0 - fresh)[:, None]
+    newh = g + 1.0
+    newkv = gkv + 1.0          # one token appended per step
+    x = np.asarray(xs[0])
+    if x.ndim >= 3:
+        out = np.repeat(newh[:, None, :], x.shape[1], axis=1)
+    else:
+        out = newh
+    h2 = h.copy()
+    h2[idx] = newh
+    kv2 = kv.copy()
+    kv2[idx] = newkv
+    import jax.numpy as jnp
+    return (out,), {"h": jnp.asarray(h2), "kv_pos": jnp.asarray(kv2)}
+
+
+class CheckKVDecodePool(DecodePool):
+    """DecodePool whose stub carry includes a KV ring write position —
+    the miniature of the speculative-serving subsystem's attention
+    carry, driven through the REAL control-queue protocol."""
+
+    def _ensure_device_state(self, tails, dtype) -> None:
+        if self._pool is not None:
+            return
+        import jax.numpy as jnp
+        n = self.max_slots + 1
+        self._pool = {"h": jnp.zeros((n, 1), np.float32),
+                      "kv_pos": jnp.zeros((n, 1), np.float32)}
+        self._tails = tuple(tuple(t[1:]) for t in tails)
+        self._dtype = np.dtype(np.float32)
+        self._step_jit = _kv_pool_step
+
+
 def _x():
     return np.zeros((1, 1), np.float32)
 
@@ -258,6 +303,87 @@ def scenario_migration_kill(ctx: Context) -> None:
         assert _val(out) == 1.0, "post-restart carry not fresh"
     finally:
         src.stop(timeout=30.0)
+
+
+def scenario_kv_migration(ctx: Context) -> None:
+    """KV-ring carry under live migration, driven through the real
+    control-queue protocol: a session with ring state migrates
+    export→import→confirm while it streams, a second session churns its
+    slot (close + fresh claim) on the source.  The ``_KVRingWatch``
+    probes check at EVERY scheduling point that the write position is
+    monotone, frozen in exported limbo, and zeroed on a fresh claim;
+    the counting carry pins that the migrated ring's VALUE continued
+    exactly (1..4 with no gap or repeat)."""
+    faults.reset()
+    src = CheckKVDecodePool(_StubModel(), name="chk-kv-src", max_slots=2,
+                            max_wait_ms=0.0)
+    dst = CheckKVDecodePool(_StubModel(), name="chk-kv-dst", max_slots=2,
+                            max_wait_ms=0.0)
+    ctx.watch_pool(src)
+    ctx.watch_pool(dst)
+    _specs.watch_kv_ring(ctx.sched, src)
+    _specs.watch_kv_ring(ctx.sched, dst)
+    try:
+        sid = src.open_session(tenant="t0")
+        loc = {"pool": src}
+        results = []
+        errors = []
+
+        def stepper():
+            for _i in range(4):
+                for _try in range(50):
+                    pool = loc["pool"]
+                    try:
+                        out = pool.step(sid, _x(), timeout=60)
+                        results.append(_val(out))
+                        break
+                    except (TransientError, KeyError):
+                        time.sleep(0.001)
+                else:
+                    errors.append("step retries exhausted")
+                    return
+
+        def migrator():
+            try:
+                payload = src.export_session(sid, timeout=30)
+            except Exception as e:
+                errors.append(f"export failed: {type(e).__name__}: {e}")
+                return
+            try:
+                dst.import_session(payload)
+            except Exception as e:
+                src.finish_export(sid, ok=False)
+                errors.append(f"import failed: {type(e).__name__}: {e}")
+                return
+            loc["pool"] = dst
+            src.finish_export(sid, ok=True)
+
+        def churner():
+            # slot churn on the source: open → step → close → reopen;
+            # the fresh claim must observe a zeroed ring every time
+            try:
+                for _i in range(2):
+                    s2 = src.open_session(tenant="t1")
+                    out = src.step(s2, _x(), timeout=60)
+                    if _val(out) != 1.0:
+                        errors.append(
+                            f"fresh claim saw stale ring: {_val(out)}")
+                    src.close_session(s2)
+            except (TransientError, KeyError, RuntimeError):
+                pass   # pool churn racing the migration is legal
+
+        t1 = ctx.thread("stepper", stepper)
+        t2 = ctx.thread("migrator", migrator)
+        t3 = ctx.thread("churner", churner)
+        t1.join(120.0)
+        t2.join(120.0)
+        t3.join(120.0)
+        assert not errors, errors
+        assert results == [1.0, 2.0, 3.0, 4.0], \
+            f"kv carry broke across the migration: {results}"
+    finally:
+        src.stop(timeout=30.0)
+        dst.stop(timeout=30.0)
 
 
 def scenario_batcher_death(ctx: Context) -> None:
@@ -498,6 +624,7 @@ def scenario_leaked_future(ctx: Context) -> None:
 SCENARIOS: Dict[str, Callable[[Context], None]] = {
     "migration": scenario_migration,
     "migration_kill": scenario_migration_kill,
+    "kv_migration": scenario_kv_migration,
     "batcher_death": scenario_batcher_death,
     "decode_death": scenario_decode_death,
     "drain": scenario_drain,
@@ -509,5 +636,5 @@ SCENARIOS: Dict[str, Callable[[Context], None]] = {
 
 #: the scenarios a default checker run gates on (positive controls are
 #: excluded — they exist to prove the checker catches bugs)
-DEFAULT_SCENARIOS = ("migration", "migration_kill", "batcher_death",
-                     "decode_death", "drain", "breaker")
+DEFAULT_SCENARIOS = ("migration", "migration_kill", "kv_migration",
+                     "batcher_death", "decode_death", "drain", "breaker")

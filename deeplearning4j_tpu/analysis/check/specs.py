@@ -211,3 +211,94 @@ def watch_decode_pool(sched, pool) -> None:
     checked at every scheduling point of the run."""
     sched.probes.append(
         (f"slots:{pool.name or 'pool'}", lambda: _slot_probe(pool)))
+
+
+# ----------------------------------------------------------------------
+# KV-ring invariants (the speculative-serving subsystem's carry: each
+# attention layer's per-slot ring write position is a monotone token
+# counter — write index = pos % window, valid length = min(pos, W))
+# ----------------------------------------------------------------------
+class _KVRingWatch:
+    """Quiescent-state KV write-position invariants for a decode pool
+    whose carry exposes a per-slot ``kv_pos`` counter:
+
+    * **monotone mod window**: a slot's write position never decreases
+      while the same session holds it (a rewind = overwritten history);
+    * **exported-limbo freezes the ring**: between
+      ``decode.session_exported`` and its ``finish_export``, the slot's
+      position must not move — the snapshot in flight to the target
+      would silently diverge from the source;
+    * **fresh claim zeroes valid-length**: a slot observed under a NEW
+      session must never show more ring writes than that session has
+      stepped — a larger count means the previous tenant's entries are
+      still valid-attendable (stale-ring leak).
+    """
+
+    def __init__(self, pool):
+        self.pool = pool
+        #: slot -> (sid, last seen pos)
+        self._last: Dict[int, Tuple[str, float]] = {}
+        #: slot -> pos frozen at export
+        self._frozen: Dict[int, float] = {}
+
+    def _kv_pos(self, slot: int) -> Optional[float]:
+        dev = self.pool._pool
+        if not isinstance(dev, dict) or "kv_pos" not in dev:
+            return None
+        import numpy as np
+        return float(np.asarray(dev["kv_pos"])[slot].ravel()[0])
+
+    def probe(self) -> Optional[str]:
+        if self.pool._pool is None:
+            self._last.clear()
+            self._frozen.clear()
+            return None
+        by_slot = {s.slot: s for s in self.pool._sessions.values()}
+        for slot in range(self.pool.max_slots):
+            cur = self._kv_pos(slot)
+            if cur is None:
+                return None
+            s = by_slot.get(slot)
+            if s is None:
+                self._last.pop(slot, None)
+                self._frozen.pop(slot, None)
+                continue
+            if s.importing:
+                # the slot is claimed but its carry scatter hasn't
+                # landed — the device state is not this session's yet
+                self._last.pop(slot, None)
+                continue
+            if s.exported:
+                frozen = self._frozen.setdefault(slot, cur)
+                if cur != frozen:
+                    return (f"kv ring moved in exported limbo: slot "
+                            f"{slot} (session {s.sid}) pos {frozen} -> "
+                            f"{cur} — the in-flight snapshot diverged")
+            else:
+                self._frozen.pop(slot, None)
+            prev = self._last.get(slot)
+            if prev is not None and prev[0] == s.sid and cur < prev[1]:
+                return (f"kv write_pos rewound on slot {slot} "
+                        f"(session {s.sid}): {prev[1]} -> {cur}")
+            # fresh-claim zeroing is LAZY (the gather zeroes fresh rows
+            # in-trace): until the session's first dispatch lands
+            # (`started`), the raw buffer legitimately holds the
+            # previous tenant's values — what must hold afterwards is
+            # that the ring never shows more writes than this session
+            # has stepped.  A dispatched step scatters the ring BEFORE
+            # the step counter increments, so allow the in-flight steps.
+            inflight = sum(1 for p in self.pool._inflight
+                           if p.session.sid == s.sid)
+            if s.started and cur > s.steps + inflight:
+                return (f"fresh claim did not zero the ring: slot {slot} "
+                        f"session {s.sid} shows {cur} writes after only "
+                        f"{s.steps} steps (stale entries attendable)")
+            self._last[slot] = (s.sid, cur)
+        return None
+
+
+def watch_kv_ring(sched, pool) -> None:
+    """Register the KV write-position invariants for ``pool`` (no-op
+    probes when the pool's carry has no ``kv_pos`` leaf)."""
+    w = _KVRingWatch(pool)
+    sched.probes.append((f"kv:{pool.name or 'pool'}", w.probe))

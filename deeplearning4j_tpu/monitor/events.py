@@ -60,6 +60,7 @@ EVENT_TYPES = (
     "batcher.died",
     "batcher.restarted",
     "decode.step",
+    "decode.spec_verified",
     "decode.session_opened",
     "decode.session_closed",
     "decode.session_exported",

@@ -652,6 +652,20 @@ class SelfAttentionLayer(Layer):
     ``parallel.sequence.attention``: dense on one device, ring /
     all-to-all sequence-parallel when a mesh with a non-trivial 'seq'
     axis is active (``parallel.sequence.sequence_mesh``).
+
+    Under the engines' carried decode step
+    (``parallel.sequence.kv_decode_scope`` — entered by
+    ``rnn_time_step`` and the serving decode pool), the layer instead
+    decodes INCREMENTALLY against a per-stream KV ring carried in
+    ``rnn_state``: each new token appends its K/V at ``pos % window``
+    and attends over only the valid ring entries
+    (``parallel.sequence.attend_cached``) — O(window) per token, flat
+    in stream length, instead of re-running the whole window.
+    Streaming decode is inherently causal: with ``cache_window >=``
+    the stream length the step-by-step outputs match full causal
+    ``dense_attention``; older tokens fall out of the ring (sliding
+    window).  ``cache_window=None`` resolves to the declared input
+    timesteps at init (128 when variable-length).
     """
 
     n_in: Optional[int] = None
@@ -660,11 +674,15 @@ class SelfAttentionLayer(Layer):
     causal: bool = False
     strategy: str = "auto"      # auto | ring | ulysses | dense
     project_output: bool = True
+    cache_window: Optional[int] = None   # KV-ring length for decode
 
     def initialize(self, key, input_type, dtype=jnp.float32):
         n_in = self.n_in or input_type.size
         if self.n_out % self.n_heads:
             raise ValueError(f"n_out={self.n_out} % n_heads={self.n_heads}")
+        if self.cache_window is None:
+            self.cache_window = int(getattr(input_type, "timesteps", None)
+                                    or 128)
         kq, kk, kv, ko = jax.random.split(key, 4)
         params = {
             "Wq": self._winit(kq, (n_in, self.n_out), dtype),
@@ -691,15 +709,30 @@ class SelfAttentionLayer(Layer):
         q = split(x @ params["Wq"] + params["bq"])
         k = split(x @ params["Wk"] + params["bk"])
         v = split(x @ params["Wv"] + params["bv"])
-        out = seq_ops.attention(q, k, v, causal=self.causal, key_mask=mask,
-                                strategy=self.strategy)
+        new_state = state
+        if seq_ops.kv_decode_active() and not train:
+            # incremental decode: append this chunk's K/V to the
+            # per-stream ring and attend over valid entries only —
+            # O(window)/token instead of O(T)/token re-runs.  The ring
+            # is the layer's rnn_state carry, so it lives on device in
+            # the decode pool's slot buffer and rides migration.
+            W = int(self.cache_window or 128)
+            ring = state.get("rnn_state") if state else None
+            if ring is None:
+                ring = seq_ops.kv_ring_init(B, H, W, Dh, x.dtype)
+            out, ring = seq_ops.attend_cached(q, k, v, ring, key_mask=mask)
+            new_state = dict(state) if state else {}
+            new_state["rnn_state"] = ring
+        else:
+            out = seq_ops.attention(q, k, v, causal=self.causal,
+                                    key_mask=mask, strategy=self.strategy)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         if self.project_output:
             out = out @ params["Wo"] + params["bo"]
         out = self._act(out)
         if mask is not None:
             out = out * mask[:, :, None].astype(out.dtype)
-        return out, state, mask
+        return out, new_state, mask
 
     def output_type(self, input_type):
         return InputType.recurrent(self.n_out, input_type.timesteps)

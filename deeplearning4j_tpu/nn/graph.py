@@ -775,7 +775,11 @@ class ComputationGraph:
         recurrent vertices' names.  Explicit carries keep the traced
         structure closed under iteration: one compiled program serves
         every step of an autoregressive stream (see
-        MultiLayerNetwork._rnn_step_raw)."""
+        MultiLayerNetwork._rnn_step_raw).  The forward traces under
+        ``kv_decode_scope``: attention vertices decode incrementally
+        against a KV-ring carry leaf instead of re-running their
+        window."""
+        from deeplearning4j_tpu.parallel import sequence as seq_ops
         policy = dtype_ops.resolve(self.conf.global_conf.precision)
 
         def rnn_fn(params, state, carries, xs, ms):
@@ -790,8 +794,9 @@ class ComputationGraph:
             ins = dict(zip(self.conf.network_inputs, xs_c))
             masks = ({n: m for n, m in zip(self.conf.network_inputs, ms_c)
                       if m is not None} if ms_c is not None else {})
-            acts, _, new_states, _ = self._forward_all(
-                pc, st, ins, masks, False, jax.random.PRNGKey(0))
+            with seq_ops.kv_decode_scope():
+                acts, _, new_states, _ = self._forward_all(
+                    pc, st, ins, masks, False, jax.random.PRNGKey(0))
             outs = tuple(policy.cast_to_param(acts[n])
                          for n in self.conf.network_outputs)
             new_carries = {n: ns["rnn_state"]

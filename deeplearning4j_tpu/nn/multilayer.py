@@ -1097,7 +1097,11 @@ class MultiLayerNetwork:
         closed under iteration, so ONE jitted trace serves every step
         of an autoregressive stream (arXiv 2603.09555's compiled-carry
         contract — no per-step retrace, no per-step re-dispatch of the
-        whole layer stack)."""
+        whole layer stack).  The forward traces under
+        ``kv_decode_scope``: attention layers swap their re-run-window
+        core for the incremental ring-cached step, so their KV ring is
+        just another carry leaf closed under iteration."""
+        from deeplearning4j_tpu.parallel import sequence as seq_ops
         policy = dtype_ops.resolve(self.conf.global_conf.precision)
 
         def rnn_fn(params, state, carries, x, fmask):
@@ -1109,9 +1113,10 @@ class MultiLayerNetwork:
                 if c is not None:
                     s["rnn_state"] = c
                 st.append(s)
-            out, new_states, _ = self._forward(
-                pc, st, xc, fmc, False, jax.random.PRNGKey(0),
-                stateful_rnn=True)
+            with seq_ops.kv_decode_scope():
+                out, new_states, _ = self._forward(
+                    pc, st, xc, fmc, False, jax.random.PRNGKey(0),
+                    stateful_rnn=True)
             new_carries = [ns.get("rnn_state")
                            if isinstance(ns, dict) else None
                            for ns in new_states]

@@ -79,6 +79,111 @@ def cache_token():
 
 
 # ---------------------------------------------------------------------------
+# KV-cache decode scope: the engines' carried decode step (`_rnn_step_raw`,
+# shared by rnn_time_step and the serving decode pool) traces its forward
+# under this scope, which switches SelfAttentionLayer from "re-run the whole
+# window" to the incremental ring-cached path (`attend_cached`).  Training,
+# TBPTT and plain output() never enter the scope, so their numerics are
+# untouched.  The flag is read at TRACE time — it is baked into the compiled
+# step, exactly like `cache_token()` bakes the sequence-parallel regime.
+_KV_DECODE = False
+
+
+@contextlib.contextmanager
+def kv_decode_scope(enabled: bool = True):
+    """Scope under which attention layers decode incrementally against a
+    per-stream KV ring carried in ``rnn_state`` (the compiled-carry
+    contract: the ring is an explicit, relocatable carry leaf, so it
+    rides the decode pool's device-resident slot buffer and the fleet
+    tier's migration payload)."""
+    global _KV_DECODE  # dl4j: noqa[DL4J103] trace-time regime flag like sequence_mesh: flipped once around a trace, never per step
+    prev = _KV_DECODE
+    _KV_DECODE = bool(enabled)  # dl4j: noqa[DL4J101] `enabled` is a host-side Python bool (a trace-time mode switch), never a tracer
+    try:
+        yield
+    finally:
+        _KV_DECODE = prev
+
+
+def kv_decode_active() -> bool:
+    return _KV_DECODE
+
+
+def kv_ring_init(batch: int, n_heads: int, window: int, head_dim: int,
+                 dtype=jnp.float32):
+    """Zero KV ring for ``batch`` streams: ``k``/``v`` are ``[B, H, W,
+    D]`` circular buffers, ``pos`` is the per-stream count of real
+    tokens ever written (monotone; write index = ``pos % W``, valid
+    length = ``min(pos, W)``) — so a freshly-zeroed ring (``pos == 0``)
+    is self-describing as empty, which is what lets the decode pool
+    reuse a slot by zeroing its gathered carry in-trace."""
+    return {
+        "k": jnp.zeros((batch, n_heads, window, head_dim), dtype),
+        "v": jnp.zeros((batch, n_heads, window, head_dim), dtype),
+        "pos": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def attend_cached(q, k_new, v_new, ring, *, key_mask=None,
+                  scale: Optional[float] = None):
+    """Incremental sliding-window attention over a per-stream KV ring —
+    the O(window)/token decode path (vs ``dense_attention``'s
+    O(T)/token re-run of the whole stream).
+
+    ``q, k_new, v_new``: the NEW chunk's projections ``[B, H, Tc, D]``;
+    ``ring``: ``kv_ring_init``-shaped pytree; ``key_mask``: ``[B, Tc]``
+    with 1 = real token.  Semantics are streaming-causal: chunk token
+    ``t`` first appends its K/V at ``pos % W`` (masked pad tokens write
+    nothing and advance nothing — a bucketed pad chunk carries the ring
+    through unchanged, exact), then attends over the ``min(pos+1, W)``
+    valid entries; entries older than ``window`` are overwritten and
+    masked out (ring wraparound).  For ``window >= stream length`` the
+    step-by-step outputs match full causal ``dense_attention`` to float
+    reassociation (the parity the tests pin at 1e-5).
+
+    Cost per token is O(window) flat in stream length — the lax.scan
+    over the chunk keeps the HLO O(1) in chunk length, and per-step
+    statistics accumulate at >= f32 like the ring-attention core."""
+    B, H, Tc, D = q.shape
+    W = ring["k"].shape[2]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    if key_mask is None:
+        key_mask = jnp.ones((B, Tc), q.dtype)
+    slots = jnp.arange(W)
+
+    def body(carry, inp):
+        kr, vr, pos = carry
+        q_t, k_t, v_t, m_t = inp          # [B,H,D] x3, [B]
+        m_t = m_t.astype(kr.dtype)
+        # append: one-hot write at pos % W, gated by the token mask
+        write = ((slots[None, :] == (pos % W)[:, None]).astype(kr.dtype)
+                 * m_t[:, None])          # [B, W]
+        wr = write[:, None, :, None]      # [B, 1, W, 1]
+        kr = kr * (1.0 - wr) + k_t[:, :, None, :] * wr
+        vr = vr * (1.0 - wr) + v_t[:, :, None, :] * wr
+        count = pos + m_t.astype(pos.dtype)
+        # ring wraparound masking: only the min(count, W) most-recent
+        # entries are attendable (slot indices fill 0..W-1 then wrap,
+        # so validity is a plain length test against the write count)
+        valid = slots[None, :] < jnp.minimum(count, W)[:, None]   # [B, W]
+        scores = jnp.einsum("bhd,bhwd->bhw", q_t, kr,
+                            preferred_element_type=acc_dt) * scale
+        scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o_t = jnp.einsum("bhw,bhwd->bhd", probs, vr,
+                         preferred_element_type=acc_dt)
+        return (kr, vr, count), o_t.astype(q.dtype)
+
+    xs = (jnp.moveaxis(q, 2, 0), jnp.moveaxis(k_new, 2, 0),
+          jnp.moveaxis(v_new, 2, 0), jnp.moveaxis(key_mask, 1, 0))
+    (kr, vr, pos), outs = lax.scan(
+        body, (ring["k"], ring["v"], ring["pos"]), xs)
+    return (jnp.moveaxis(outs, 0, 2),
+            {"k": kr, "v": vr, "pos": pos})
+
+
+# ---------------------------------------------------------------------------
 # Dense reference core (single device / no 'seq' axis).
 
 

@@ -63,6 +63,8 @@ Metered as the ``dl4j_decode_*`` family (docs/OBSERVABILITY.md).
 
 from __future__ import annotations
 
+import base64
+import io
 import logging
 import threading
 import time
@@ -129,10 +131,37 @@ class DecodeMetrics:
         self._c_shed = reg.counter(
             "dl4j_resilience_shed_total",
             "requests shed instead of served", labels=("reason",))
+        # KV-cache residency (set when the pool materializes its carry)
+        self.g_kv_rings = reg.gauge(
+            "dl4j_kv_rings", "KV rings in the pool's carry (attention "
+            "layers x slots share one ring buffer)", ("model",)).labels(**lbl)
+        self.g_kv_bytes = reg.gauge(
+            "dl4j_kv_ring_bytes", "device bytes held by KV ring K/V "
+            "buffers across all slots", ("model",)).labels(**lbl)
+        self.g_kv_window = reg.gauge(
+            "dl4j_kv_window", "widest KV ring window (tokens) in the "
+            "pool's carry", ("model",)).labels(**lbl)
+        # speculative decode (the fused verify path)
+        self._f_spec_steps = reg.counter(
+            "dl4j_spec_steps_total", "fused speculative verify dispatches",
+            ("model", "tenant"))
+        self._f_spec_proposed = reg.counter(
+            "dl4j_spec_tokens_proposed_total",
+            "draft tokens scored by verify steps", ("model", "tenant"))
+        self._f_spec_accepted = reg.counter(
+            "dl4j_spec_tokens_accepted_total",
+            "tokens committed by verify steps (pending + accepted draft "
+            "prefix)", ("model", "tenant"))
+        self.h_spec_accept = reg.histogram(
+            "dl4j_spec_accept_len", "tokens committed per fused verify "
+            "dispatch", ("model",)).labels(**lbl)
         self._lock = threading.Lock()
         self.steps = 0
         self.tokens = 0
         self.batches = 0
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self.batch_size_hist: Dict[int, int] = {}
 
     def record_opened(self, tenant: Optional[str]) -> None:
@@ -148,6 +177,20 @@ class DecodeMetrics:
                                   tenant=tenant or "-").inc(n_tokens)
             with self._lock:
                 self.tokens += n_tokens
+
+    def record_spec(self, tenant: Optional[str], proposed: int,
+                    accepted: int) -> None:
+        t = tenant or "-"
+        self._f_spec_steps.labels(model=self._name, tenant=t).inc()
+        if proposed:
+            self._f_spec_proposed.labels(model=self._name,
+                                         tenant=t).inc(proposed)
+        self._f_spec_accepted.labels(model=self._name, tenant=t).inc(accepted)
+        self.h_spec_accept.observe(float(accepted))
+        with self._lock:
+            self.spec_steps += 1
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
 
     def record_closed(self, reason: str) -> None:
         self._f_closed.labels(model=self._name, reason=reason).inc()
@@ -174,12 +217,18 @@ class DecodeMetrics:
                     else 0.0,
                 "batch_size_hist": {str(k): v for k, v in
                                     sorted(self.batch_size_hist.items())},
+                "spec_steps": self.spec_steps,
+                "spec_tokens_proposed": self.spec_proposed,
+                "spec_tokens_accepted": self.spec_accepted,
+                "spec_accept_per_dispatch":
+                    round(self.spec_accepted / self.spec_steps, 2)
+                    if self.spec_steps else 0.0,
             }
 
 
 class DecodeSession:
     __slots__ = ("sid", "slot", "tenant", "created_at", "last_used",
-                 "steps", "started", "migrating", "exported")
+                 "steps", "started", "migrating", "exported", "importing")
 
     def __init__(self, sid: str, slot: int, tenant: Optional[str]):
         self.sid = sid
@@ -199,14 +248,18 @@ class DecodeSession:
         # import landed) or reinstates it (the import failed)
         self.migrating = False
         self.exported = False
+        # True between an import's slot claim and its carry scatter
+        # landing on the batcher thread — the slot's device state is
+        # not this session's yet (the dl4j-check KV probe reads this)
+        self.importing = False
 
 
 class _PendingStep:
     __slots__ = ("session", "xs", "masks", "future", "t_enqueue",
-                 "deadline", "tenant", "ctx")
+                 "deadline", "tenant", "ctx", "spec_tokens")
 
     def __init__(self, session, xs, masks, future, deadline, tenant,
-                 ctx=None):
+                 ctx=None, spec_tokens=None):
         self.session = session
         self.xs = xs          # tuple of per-input [T, ...] host arrays
         self.masks = masks    # tuple of per-input [T] masks or None
@@ -217,10 +270,73 @@ class _PendingStep:
         # trace context captured at enqueue (request_id etc) — the
         # batcher thread re-attaches it to this step's journal events
         self.ctx = ctx or {}
+        # speculative verify: the fed token ids [T] (pending + drafts);
+        # None = a normal decode step.  Spec and normal steps never
+        # share a dispatch (different compiled programs).
+        self.spec_tokens = spec_tokens
 
     @property
     def request_id(self):
         return self.ctx.get("request_id")
+
+
+# ---------------------------------------------------------------------------
+# Carry payload encoding (the fleet migration hop — docs/FLEET.md).
+# Version 2 ships every carry leaf as base64-npy bytes: exact binary
+# round-trip (npy preserves shape/dtype/bits) at ~1/8 the wire size of
+# the v1 JSON float lists — required now that KV-cache carries make a
+# session's state MB-sized.  Import accepts both versions (v1 payloads
+# from not-yet-upgraded replicas keep migrating); DL4J_CARRY_PAYLOAD=json
+# forces the v1 encoding on export for a mixed-version fleet.
+CARRY_PAYLOAD_VERSION = 2
+
+
+def _encode_carry_leaf(a: np.ndarray, binary: bool) -> dict:
+    a = np.asarray(a)
+    spec = {"shape": list(a.shape), "dtype": str(a.dtype)}
+    if binary:
+        buf = io.BytesIO()
+        np.save(buf, a, allow_pickle=False)
+        spec["npy_b64"] = base64.b64encode(buf.getvalue()).decode("ascii")
+    else:
+        spec["data"] = a.ravel().tolist()
+    return spec
+
+
+def _decode_carry_leaf(spec: dict) -> np.ndarray:
+    if "npy_b64" in spec:
+        a = np.load(io.BytesIO(base64.b64decode(spec["npy_b64"])),
+                    allow_pickle=False)
+    else:
+        a = np.asarray(spec["data"], dtype=np.dtype(spec["dtype"]))
+    a = a.reshape(tuple(spec["shape"]))
+    if str(a.dtype) != spec["dtype"]:
+        a = a.astype(np.dtype(spec["dtype"]))
+    return a
+
+
+def _kv_ring_summary(tree) -> dict:
+    """Walk a carry pytree for KV rings (dicts shaped like
+    ``kv_ring_init``: k/v/pos) and summarize them for the ``dl4j_kv_*``
+    gauges — ring count, K+V device bytes, and the widest window."""
+    out = {"rings": 0, "bytes": 0, "window": 0}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if set(node.keys()) == {"k", "v", "pos"} \
+                    and getattr(node["k"], "ndim", 0) == 4:
+                out["rings"] += 1
+                out["bytes"] += int(node["k"].nbytes + node["v"].nbytes)
+                out["window"] = max(out["window"], int(node["k"].shape[2]))
+                return
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+
+    walk(tree)
+    return out
 
 
 def _pool_step_raw(model, is_graph: bool):
@@ -248,6 +364,73 @@ def _pool_step_raw(model, is_graph: bool):
         return outs, new_pool
 
     return pool_step
+
+
+def _spec_verify_raw(model, is_graph: bool):
+    """The ONE fused speculative-verify program (arXiv 1410.0759's
+    efficient-primitives playbook: fuse the K scoring dispatches into a
+    single compiled call).  The chunk — the known-greedy pending token
+    followed by K draft tokens — runs token-by-token inside a
+    ``lax.scan`` over the engines' carried step, stacking per-step
+    outputs AND carries; the longest draft prefix the target model
+    agrees with (greedy argmax) is computed IN TRACE, and the carry at
+    exactly that acceptance point is selected and scattered back — so
+    the session's device state is as if only the accepted tokens were
+    ever fed (exact greedy parity, no rollback dispatch).
+
+    Signature: ``(params, state, pool, idx, fresh, xs, tok, nv) ->
+    (outs [B,T,C], greedy [B,T], accept [B], new_pool)`` where ``tok``
+    is the fed token ids ``[B, T]`` and ``nv`` the per-row real chunk
+    length (pad rows/steps are masked through, state unchanged)."""
+    rnn_raw = model._rnn_step_raw()
+
+    def spec_step(params, state, pool, idx, fresh, xs, tok, nv):
+        def take(a):
+            g = a[idx]
+            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+            return g * (1.0 - f).astype(g.dtype)
+
+        c0 = tree_map(take, pool)
+        B, T = tok.shape
+        valid = jnp.arange(T)[None, :] < nv[:, None]          # [B, T]
+
+        def body(c, inp):
+            xts, m_t = inp            # tuple of [B, C...], [B]
+            xts = tuple(x[:, None] for x in xts)              # [B, 1, C]
+            m = m_t[:, None].astype(jnp.float32)              # [B, 1]
+            if is_graph:
+                outs_t, c2 = rnn_raw(params, state, c, xts,
+                                     tuple(m for _ in xts))
+                out_t = outs_t[0]
+            else:
+                out_t, c2 = rnn_raw(params, state, c, xts[0], m)
+            return c2, (out_t[:, 0], c2)
+
+        xs_seq = tuple(jnp.moveaxis(x, 1, 0) for x in xs)     # [T, B, C]
+        m_seq = jnp.moveaxis(valid, 1, 0)                     # [T, B]
+        _, (outs, c_stack) = jax.lax.scan(body, c0, (xs_seq, m_seq))
+        outs = jnp.moveaxis(outs, 0, 1)                       # [B, T, C]
+        greedy = jnp.argmax(outs, axis=-1).astype(jnp.int32)  # [B, T]
+        # token 0 is the already-known target-greedy pending token —
+        # always accepted; draft token t (fed at position t) is
+        # accepted iff the target's greedy after position t-1 equals it
+        # and every earlier draft token was accepted (longest agreeing
+        # prefix via a cumulative product)
+        match = jnp.logical_and(greedy[:, :-1] == tok[:, 1:],
+                                valid[:, 1:])                 # [B, T-1]
+        lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        accept = jnp.minimum(1 + jnp.sum(lead, axis=1),
+                             jnp.maximum(nv, 1)).astype(jnp.int32)
+        # carry after exactly `accept` tokens: per-row select from the
+        # stacked per-step carries (pad rows select garbage into the
+        # scratch slot, which is never read)
+        bidx = jnp.arange(B)
+        sel = tree_map(lambda s: s[accept - 1, bidx], c_stack)
+        new_pool = tree_map(lambda p, c: p.at[idx].set(c.astype(p.dtype)),
+                            pool, sel)
+        return outs, greedy, accept, new_pool
+
+    return spec_step
 
 
 class DecodePool:
@@ -302,6 +485,8 @@ class DecodePool:
         self._tails: Optional[Tuple] = None
         self._dtype = np.dtype(np.float32)
         self._step_jit = None
+        self._spec_jit = None
+        self._kv_summary: dict = {}
         self._thread = self._spawn_thread()
 
     # ------------------------------------------------------------------
@@ -428,6 +613,31 @@ class DecodePool:
         """Enqueue one decode step for a session; the future resolves to
         the tuple of per-output ``[T, ...]`` arrays for that session's
         rows.  ``xs`` is one ``[T, ...]`` array per network input."""
+        return self._submit(sid, xs, masks, timeout_ms, tenant, None)
+
+    def submit_spec_step(self, sid: str, xs, token_ids,
+                         timeout_ms: Optional[float] = None,
+                         tenant: Optional[str] = None) -> Future:
+        """Enqueue one fused speculative-verify step: ``xs`` carries the
+        feature rows for the pending token plus K draft tokens,
+        ``token_ids`` their ``[T]`` int ids.  The future resolves to
+        ``(outs [T, C], greedy [T], accepted)`` — ``accepted`` tokens
+        (>= 1: the pending token is known-greedy) were committed to the
+        session's device carry in the ONE dispatch; the rest were
+        rolled back in-trace."""
+        tok = np.asarray(token_ids, np.int32).ravel()
+        xs_n = self._normalize_inputs(xs)
+        if any(a.ndim < 2 for a in xs_n):
+            raise ValueError("speculative decode needs sequence inputs "
+                             "([T, C] per network input)")
+        if any(a.shape[0] != tok.shape[0] for a in xs_n):
+            raise ValueError(
+                f"token_ids has {tok.shape[0]} entries but the feature "
+                f"chunk has {xs_n[0].shape[0]} timesteps")
+        return self._submit(sid, xs, None, timeout_ms, tenant, tok)
+
+    def _submit(self, sid, xs, masks, timeout_ms, tenant,
+                spec_tokens) -> Future:
         xs = self._normalize_inputs(xs)
         masks = self._normalize_masks(masks, xs)
         deadline = (None if timeout_ms is None
@@ -455,7 +665,8 @@ class DecodePool:
             fut = Future()
             p = _PendingStep(s, xs, masks, fut, deadline,
                              tenant if tenant is not None else s.tenant,
-                             ctx=events.current_context())
+                             ctx=events.current_context(),
+                             spec_tokens=spec_tokens)
             self._queue.append(p)
             self._cond.notify_all()
         if restarted:
@@ -468,6 +679,15 @@ class DecodePool:
         """Blocking convenience wrapper around :meth:`submit_step`."""
         return self.submit_step(sid, xs, masks, timeout_ms=timeout_ms,
                                 tenant=tenant).result(timeout)
+
+    def spec_step(self, sid: str, xs, token_ids,
+                  timeout: Optional[float] = 60.0,
+                  timeout_ms: Optional[float] = None,
+                  tenant: Optional[str] = None):
+        """Blocking convenience wrapper around :meth:`submit_spec_step`."""
+        return self.submit_spec_step(
+            sid, xs, token_ids, timeout_ms=timeout_ms,
+            tenant=tenant).result(timeout)
 
     def _normalize_inputs(self, xs) -> Tuple[np.ndarray, ...]:
         """Per-input ``[T, C]`` chunk arrays.  Single-input models take
@@ -564,8 +784,11 @@ class DecodePool:
         }
         tel = getattr(self.model, "compile_telemetry", None)
         if tel is not None:
-            out["decode_programs"] = tel.snapshot()["by_kind"].get(
-                "decode_step", 0)
+            by_kind = tel.snapshot()["by_kind"]
+            out["decode_programs"] = by_kind.get("decode_step", 0)
+            out["spec_programs"] = by_kind.get("spec_step", 0)
+        if self._kv_summary:
+            out["kv_cache"] = dict(self._kv_summary)
         return out
 
     # ------------------------------------------------------------------
@@ -668,6 +891,7 @@ class DecodePool:
             s.steps = int(payload.get("steps", 0) or 0)
             s.started = bool(payload.get("started")) \
                 and payload.get("carry") is not None
+            s.importing = payload.get("carry") is not None
             self._sessions[sid] = s
             self.metrics.record_opened(tenant)
             self.metrics.g_active.set(self._active_locked())
@@ -686,6 +910,8 @@ class DecodePool:
         except BaseException:
             self.close_session(sid, reason="error")
             raise
+        with self._cond:
+            s.importing = False
         return sid
 
     def drain(self, deadline_s: Optional[float] = None) -> dict:
@@ -787,8 +1013,10 @@ class DecodePool:
             s = self._sessions.get(sid)
         if s is None:
             raise KeyError(f"unknown or expired decode session {sid!r}")
+        import os
+        binary = os.environ.get("DL4J_CARRY_PAYLOAD", "").lower() != "json"
         payload = {
-            "version": 1,
+            "version": CARRY_PAYLOAD_VERSION if binary else 1,
             "session_id": sid,
             "model": self.name,
             "tenant": s.tenant,
@@ -802,11 +1030,12 @@ class DecodePool:
             slot_slice = tree_map(lambda a: a[s.slot], self._pool)
             leaves = jax.tree_util.tree_leaves(slot_slice)
             host = jax.device_get(leaves)
+            # v2: base64-npy bytes per leaf — exact binary round trip
+            # at a fraction of the JSON-float-list wire size (KV-cache
+            # carries are MB-sized); v1 JSON lists behind the env knob
+            # for a mixed-version fleet
             payload["carry"] = {"leaves": [
-                {"shape": list(np.shape(a)),
-                 "dtype": str(np.asarray(a).dtype),
-                 "data": np.asarray(a).ravel().tolist()}
-                for a in host]}
+                _encode_carry_leaf(a, binary) for a in host]}
             payload["feature_tails"] = [list(t) for t in self._tails]
         return payload
 
@@ -837,9 +1066,7 @@ class DecodePool:
                 "architectures differ")
         new_leaves = []
         for spec, p in zip(in_leaves, pool_leaves):
-            a = np.asarray(spec["data"],
-                           dtype=np.dtype(spec["dtype"])).reshape(
-                               tuple(spec["shape"]))
+            a = _decode_carry_leaf(spec)   # v1 JSON lists or v2 npy+b64
             if tuple(a.shape) != tuple(p.shape[1:]):
                 raise ValueError(
                     f"migrated carry leaf shape {a.shape} != the pool "
@@ -934,6 +1161,7 @@ class DecodePool:
                     self._dead = True
                     self._pool = None
                     self._step_jit = None
+                    self._spec_jit = None
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="batcher_died")
             for _, _, fut in ctl:
@@ -979,7 +1207,10 @@ class DecodePool:
                 continue
             groups: Dict[Tuple, List[_PendingStep]] = {}
             for p in taken:
-                key = tuple(a.shape for a in p.xs)
+                # spec and normal steps are different compiled programs
+                # — never coalesced into one dispatch
+                key = (tuple(a.shape for a in p.xs),
+                       p.spec_tokens is not None)
                 groups.setdefault(key, []).append(p)
             for group in groups.values():
                 with self._cond:
@@ -1060,6 +1291,18 @@ class DecodePool:
         self._step_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool over a fixed is_graph, cached by the owning batcher thread for the pool's lifetime; locked writes are the crash paths
             _pool_step_raw(self.model, self._is_graph),
             donate_argnums=(2,))
+        kv = _kv_ring_summary(self._pool)
+        self._kv_summary = kv
+        self.metrics.g_kv_rings.set(kv["rings"])
+        self.metrics.g_kv_bytes.set(kv["bytes"])
+        self.metrics.g_kv_window.set(kv["window"])
+
+    def _ensure_spec_jit(self):
+        if self._spec_jit is None:
+            self._spec_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool like _step_jit: built once by the owning batcher thread, cached for the pool's lifetime
+                _spec_verify_raw(self.model, self._is_graph),
+                donate_argnums=(2,))
+        return self._spec_jit
 
     def _base_state(self):
         st = self.model.net_state
@@ -1077,7 +1320,10 @@ class DecodePool:
         rids = [p.request_id for p in group if p.request_id]
         with events.scope(model=self.name or None,
                           request_ids=rids or None):
-            self._dispatch_traced(group)
+            if group[0].spec_tokens is not None:
+                self._dispatch_spec(group)
+            else:
+                self._dispatch_traced(group)
 
     def _dispatch_traced(self, group: List[_PendingStep]) -> None:
         t_dispatch = time.perf_counter()
@@ -1178,6 +1424,105 @@ class DecodePool:
                 with self._cond:
                     self._pool = None
                     self._step_jit = None
+                    self._spec_jit = None
+                    for sid in list(self._sessions):
+                        self._close_locked(sid, reason="error")
+
+    def _dispatch_spec(self, group: List[_PendingStep]) -> None:
+        """One fused speculative-verify dispatch for a group of spec
+        steps: same gather→…→scatter shape as the normal program, but
+        the chunk runs token-by-token in-trace, the accepted prefix is
+        computed on device, and each slot's carry lands at exactly its
+        acceptance point (``_spec_verify_raw``)."""
+        t_dispatch = time.perf_counter()
+        compute_entered = False
+        try:
+            faults.check("decode.step")
+            g = self.model.conf.global_conf
+            K = len(group)
+            Kb = bucketing.bucket_size(K, self._ladder)
+            scratch = self.max_slots
+            tails = [tuple(a.shape) for a in group[0].xs]
+            if any(len(t) < 2 for t in tails):
+                raise ValueError("speculative decode needs sequence "
+                                 "inputs ([T, C] per network input)")
+            feat_tails = tuple(tuple(t[1:]) for t in tails)
+            if self._tails is not None and feat_tails != self._tails:
+                raise ValueError(
+                    f"decode feature shape {feat_tails} != the pool's "
+                    f"{self._tails} (one pool serves one input layout)")
+            with monitor.span("serve/decode", phase="gather_pad"):
+                self._ensure_device_state(tails, group[0].xs[0].dtype)
+                self._ensure_spec_jit()
+                T = int(tails[0][0])
+                Tb = bucketing.bucket_size(T, g.bucket_time_sizes)
+                idx = np.full((Kb,), scratch, np.int32)
+                fresh = np.ones((Kb,), np.float32)
+                nv = np.zeros((Kb,), np.int32)
+                tok = np.zeros((Kb, Tb), np.int32)
+                xs_h = []
+                for i, tail in enumerate(tails):
+                    x = np.zeros((Kb, Tb) + tuple(tail[1:]), np.float32)
+                    for r, p in enumerate(group):
+                        x[r, :T] = p.xs[i]
+                    xs_h.append(x)
+                for r, p in enumerate(group):
+                    idx[r] = p.session.slot
+                    fresh[r] = 0.0 if p.session.started else 1.0
+                    nv[r] = T
+                    tok[r, :T] = p.spec_tokens
+                idx_d = jnp.asarray(idx)
+                fresh_d = jnp.asarray(fresh)
+                xs_d = tuple(jnp.asarray(x) for x in xs_h)
+                tok_d = jnp.asarray(tok)
+                nv_d = jnp.asarray(nv)
+            tel = getattr(self.model, "compile_telemetry", None)
+            compiling = False
+            if tel is not None:
+                compiling = tel.record(
+                    "spec_step", (idx_d, fresh_d, xs_d, tok_d, nv_d))
+            t0 = time.perf_counter()
+            compute_entered = True
+            with monitor.span("serve/decode", phase="compute"), \
+                    sanitizer.guard_step(compiling=compiling):
+                outs, greedy, accept, self._pool = self._spec_jit(
+                    self.model.net_params, self._base_state(), self._pool,
+                    idx_d, fresh_d, xs_d, tok_d, nv_d)
+                outs = np.asarray(jax.device_get(outs))
+                greedy = np.asarray(jax.device_get(greedy))
+                accept = np.asarray(jax.device_get(accept))
+            t1 = time.perf_counter()
+            now = time.monotonic()
+            for r, p in enumerate(group):
+                acc = int(accept[r])
+                p.session.started = True
+                p.session.steps += 1
+                p.session.last_used = now
+                p.future.set_result((outs[r, :T], greedy[r, :T], acc))
+                # tokens counted at the step = tokens COMMITTED (the
+                # session's stream advanced by `acc`, not by the chunk)
+                self.metrics.record_step(p.tenant, n_tokens=acc)
+                self.metrics.record_spec(p.tenant, proposed=T - 1,
+                                         accepted=acc)
+                self.metrics.h_queue.observe(t_dispatch - p.t_enqueue)
+                self.metrics.h_step.observe(t1 - t0)
+                events.emit("decode.spec_verified", model=self.name,
+                            session_id=p.session.sid, slot=p.session.slot,
+                            tenant=p.tenant, request_id=p.request_id,
+                            proposed=T - 1, accepted=acc,
+                            step=p.session.steps)
+            self.metrics.record_batch(K)
+        except Exception as e:
+            for p in group:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            if compute_entered:
+                # donated-buffer contract: fail closed like the normal
+                # dispatch — the pool's contents are unreliable
+                with self._cond:
+                    self._pool = None
+                    self._step_jit = None
+                    self._spec_jit = None
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="error")
 
@@ -1186,10 +1531,17 @@ class DecodeManager:
     """Gateway-facing orchestration: session ids → per-model
     :class:`DecodePool`\\ s, sharing the gateway's :class:`ModelCache`.
 
-    A blue/green model flip (``server/model_cache.py``) does not disturb
-    a pool with live sessions — their carries were computed under the
-    old weights; the pool adopts the new model instance once it has
-    drained to zero sessions."""
+    Pools are keyed by ``(model path, carry LAYOUT)`` — the carry
+    pytree's treedef + per-slot leaf shapes — not by path alone: one
+    pool's ``[S+1, ...]`` device buffer serves exactly one carry
+    structure, so an attention model (KV-ring carry leaves) and an RNN
+    model, or two rollouts of the same path whose carry structure
+    changed (say a new attention layer), get SEPARATE pools.  A
+    blue/green flip with an UNCHANGED layout still adopts the new model
+    instance once the pool drains to zero sessions; a flip with a
+    CHANGED layout adopts into a fresh pool immediately — new sessions
+    never wait on the drain of an incompatible layout (the old pool
+    keeps serving its remaining sessions and is retired once empty)."""
 
     def __init__(self, model_cache, max_slots: int = 32,
                  ttl_s: float = 600.0, max_wait_ms: float = 2.0,
@@ -1201,31 +1553,73 @@ class DecodeManager:
         self.min_batch = int(min_batch)
         self.retry_after_s = float(retry_after_s)
         self._lock = threading.Lock()
-        self._pools: Dict[str, DecodePool] = {}
+        #: model path -> carry-layout fingerprint -> pool
+        self._pools: Dict[str, Dict[str, DecodePool]] = {}
         self._by_sid: Dict[str, DecodePool] = {}
         self._draining = False
+
+    @staticmethod
+    def _carry_layout(model) -> str:
+        """Fingerprint of the model's decode-carry structure: treedef +
+        per-slot leaf shapes/dtypes of ``rnn_carry_template`` — the
+        pool-compatibility key.  Models whose template cannot be built
+        (no recurrent input type) share the ``-`` bucket (the
+        path-keyed behavior they had before)."""
+        cached = getattr(model, "_dl4j_carry_layout", None)
+        if cached is not None:
+            return cached
+        try:
+            tmpl = model.rnn_carry_template(1)
+            leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+            desc = f"{treedef}|" + ";".join(
+                f"{tuple(a.shape[1:])}:{a.dtype}" for a in leaves)
+            import hashlib
+            layout = hashlib.blake2b(desc.encode(),
+                                     digest_size=6).hexdigest()
+        except Exception:
+            layout = "-"
+        try:
+            model._dl4j_carry_layout = layout
+        except Exception:
+            pass
+        return layout
 
     def _pool_for(self, model_path: str) -> DecodePool:
         import os
         key = os.path.abspath(str(model_path))
         model = self.model_cache.get(key)
-        retired = None
+        layout = self._carry_layout(model)
+        retired = []
         with self._lock:
-            pool = self._pools.get(key)
+            by_layout = self._pools.setdefault(key, {})
+            pool = by_layout.get(layout)
             if pool is not None and pool.model is not model \
                     and pool.held_slots == 0 and pool.queue_rows() == 0:
-                # rolled-out model: adopt the new instance once drained
-                retired = pool
+                # rolled-out model, same carry layout: adopt the new
+                # instance once drained
+                retired.append(pool)
                 pool = None
             if pool is None:
                 pool = DecodePool(
                     model, name=os.path.basename(key),
                     max_slots=self.max_slots, ttl_s=self.ttl_s,
                     max_wait_ms=self.max_wait_ms, min_batch=self.min_batch)
-                self._pools[key] = pool
-        if retired is not None:
-            retired.stop(timeout=5.0)
+                by_layout[layout] = pool
+            # retire fully-drained pools of OTHER layouts whose model
+            # is no longer cache-current (the changed-layout rollout's
+            # tail end)
+            for lay, p in list(by_layout.items()):
+                if lay != layout and p.model is not model \
+                        and p.held_slots == 0 and p.queue_rows() == 0:
+                    retired.append(by_layout.pop(lay))
+        for p in retired:
+            p.stop(timeout=5.0)
         return pool
+
+    def _all_pools(self) -> List[DecodePool]:
+        with self._lock:
+            return [p for by_layout in self._pools.values()
+                    for p in by_layout.values()]
 
     def open_session(self, model_path: str,
                      tenant: Optional[str] = None) -> dict:
@@ -1259,6 +1653,22 @@ class DecodeManager:
         try:
             return pool.step(session_id, x, masks=mask, timeout=timeout,
                              timeout_ms=timeout_ms, tenant=tenant)
+        except KeyError:
+            with self._lock:
+                self._by_sid.pop(session_id, None)
+            raise
+
+    def spec_step(self, session_id: str, xs, token_ids,
+                  timeout_ms: Optional[float] = None,
+                  tenant: Optional[str] = None,
+                  timeout: Optional[float] = 60.0):
+        """One fused speculative-verify step for a session (see
+        :meth:`DecodePool.spec_step`)."""
+        pool = self._pool_of(session_id)
+        try:
+            return pool.spec_step(session_id, xs, token_ids,
+                                  timeout=timeout, timeout_ms=timeout_ms,
+                                  tenant=tenant)
         except KeyError:
             with self._lock:
                 self._by_sid.pop(session_id, None)
@@ -1324,40 +1734,38 @@ class DecodeManager:
         re-admits."""
         with self._lock:
             self._draining = True
-            pools = list(self._pools.items())
-        return {key: pool.drain(deadline_s) for key, pool in pools}
+            items = [(key, lay, p)
+                     for key, by_layout in self._pools.items()
+                     for lay, p in by_layout.items()]
+        out: Dict[str, dict] = {}
+        for key, lay, pool in items:
+            k = key if key not in out else f"{key}#{lay}"
+            out[k] = pool.drain(deadline_s)
+        return out
 
     def resume(self) -> None:
         with self._lock:
             self._draining = False
-            pools = list(self._pools.values())
-        for p in pools:
+        for p in self._all_pools():
             p.resume()
 
     def queue_rows(self) -> int:
-        with self._lock:
-            pools = list(self._pools.values())
-        return sum(p.queue_rows() for p in pools)
+        return sum(p.queue_rows() for p in self._all_pools())
 
     def queue_rows_by_tenant(self) -> Dict[str, int]:
-        with self._lock:
-            pools = list(self._pools.values())
         out: Dict[str, int] = {}
-        for p in pools:
+        for p in self._all_pools():
             for t, n in p.queue_rows_by_tenant().items():
                 out[t] = out.get(t, 0) + n
         return out
 
     def batchers_alive(self) -> bool:
-        with self._lock:
-            pools = [p for p in self._pools.values()
-                     if p.held_slots > 0 or p.queue_rows() > 0]
+        pools = [p for p in self._all_pools()
+                 if p.held_slots > 0 or p.queue_rows() > 0]
         return all(p.thread_alive for p in pools)
 
     def sweep(self) -> int:
-        with self._lock:
-            pools = list(self._pools.values())
-        n = sum(p.sweep() for p in pools)
+        n = sum(p.sweep() for p in self._all_pools())
         self._gc_sids()
         return n
 
@@ -1370,8 +1778,17 @@ class DecodeManager:
 
     def stats(self) -> dict:
         with self._lock:
-            items = list(self._pools.items())
-        return {key: pool.stats() for key, pool in items}
+            items = [(key, lay, p)
+                     for key, by_layout in self._pools.items()
+                     for lay, p in by_layout.items()]
+        out: Dict[str, dict] = {}
+        for key, lay, pool in items:
+            # single-layout paths keep the plain-path key (the common
+            # case and the pre-layout-keying stats surface); a path
+            # mid-rollout with two live layouts disambiguates
+            k = key if key not in out else f"{key}#{lay}"
+            out[k] = pool.stats()
+        return out
 
     def invalidate(self, model_path: Optional[str] = None) -> int:
         """Stop pool(s) — sessions fail, slots free (the cache-
@@ -1379,12 +1796,13 @@ class DecodeManager:
         import os
         with self._lock:
             if model_path is None:
-                dropped = list(self._pools.values())
+                dropped = [p for by_layout in self._pools.values()
+                           for p in by_layout.values()]
                 self._pools.clear()
             else:
                 key = os.path.abspath(str(model_path))
-                p = self._pools.pop(key, None)
-                dropped = [p] if p is not None else []
+                by_layout = self._pools.pop(key, None) or {}
+                dropped = list(by_layout.values())
             self._by_sid = {sid: p for sid, p in self._by_sid.items()
                             if p not in dropped}
         for p in dropped:

@@ -104,6 +104,10 @@ class DeepLearning4jEntryPoint:
         self._t_start = time.time()
         self._batchers: dict = {}
         self._batcher_lock = threading.Lock()
+        # speculative decoders, one per (vocab, k, draft config) — the
+        # per-session drafting state lives inside them
+        self._spec_decoders: dict = {}
+        self._spec_lock = threading.Lock()
         self._last_ready: Optional[bool] = None
         self._c_shed = monitor.get_registry().counter(
             "dl4j_resilience_shed_total",
@@ -291,28 +295,74 @@ class DeepLearning4jEntryPoint:
                     mask=None, tenant: Optional[str] = None,
                     deadline_ms: Optional[float] = None,
                     top_k: Optional[int] = None,
-                    argmax_only: bool = False) -> dict:
+                    argmax_only: bool = False,
+                    spec=None, draft=None) -> dict:
         """Feed one ``[T, C]`` chunk (``T=1`` token-by-token; longer
         chunks are the prefill path) to a session and return the
         ``[T, ...]`` outputs.  Concurrent sessions' steps coalesce into
         one jitted slot-pool dispatch (continuous batching); admission
         control and per-tenant fair share apply exactly as for
         ``predict`` (one step = one queue row, matching the decode
-        queue's accounting)."""
+        queue's accounting).
+
+        ``spec=`` turns on speculative continuation AFTER the chunk:
+        ``spec=N`` (or ``{"tokens": N, "k": K}``) greedily generates N
+        more tokens via the fused verify program — draft proposals
+        (``draft=`` — ``"ngram"`` by default, see
+        ``server/speculative.py``) are scored K at a time in ONE
+        compiled dispatch each, with exact greedy parity.  The response
+        gains ``spec``: the generated token ids, the pending next
+        token, and dispatch/acceptance counts."""
         with events.request_scope(tenant=tenant, session_id=session_id):
             self._admit(1, tenant=tenant)
             outs = self.decode.decode_step(
                 session_id, features, mask=mask, timeout_ms=deadline_ms,
                 tenant=tenant)
+            spec_out = None
+            if spec:
+                spec_out = self._spec_continue(
+                    session_id, outs, spec, draft, tenant=tenant,
+                    deadline_ms=deadline_ms)
         result = self._format_predictions(outs[0], top_k, argmax_only)
         if len(outs) > 1:
             result["outputs"] = [np.asarray(o).tolist() for o in outs]
         result["session_id"] = session_id
+        if spec_out is not None:
+            result["spec"] = spec_out
         return result
+
+    def _spec_continue(self, session_id: str, outs, spec, draft,
+                       tenant=None, deadline_ms=None) -> dict:
+        """Run the speculative greedy continuation for ``decode_step``'s
+        ``spec=`` knob (one :class:`SpeculativeDecoder` per
+        vocab/k/draft config, session state keyed inside it)."""
+        from deeplearning4j_tpu.server import speculative
+        cfg = {"tokens": int(spec)} if not isinstance(spec, dict) else spec
+        n_tokens = int(cfg.get("tokens", 0))
+        if n_tokens <= 0:
+            return {"tokens": [], "dispatches": 0}
+        k = int(cfg.get("k", 4))
+        last = np.asarray(outs[0])[-1]
+        vocab = int(last.shape[-1])
+        key = (vocab, k, json.dumps(draft, sort_keys=True)
+               if isinstance(draft, dict) else str(draft))
+        with self._spec_lock:
+            dec = self._spec_decoders.get(key)
+            if dec is None:
+                dec = speculative.SpeculativeDecoder(
+                    self.decode, vocab=vocab, k=k, draft=draft)
+                self._spec_decoders[key] = dec
+        first = int(np.argmax(last))
+        return dec.generate(session_id, first, n_tokens, tenant=tenant,
+                            timeout_ms=deadline_ms)
 
     def close_session(self, session_id: str) -> dict:
         """Release a decode session's slot (its device carry is
         reclaimed for the next session)."""
+        with self._spec_lock:
+            decoders = list(self._spec_decoders.values())
+        for dec in decoders:
+            dec.forget(session_id)
         return {"closed": self.decode.close_session(session_id)}
 
     # ------------------------------------------------------------------

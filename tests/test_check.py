@@ -102,8 +102,8 @@ def test_tier1_bounded_exploration_500_distinct_interleavings():
     migration and batcher-death protocols, time-budgeted, at zero
     unsuppressed invariant violations."""
     total = 0
-    for name in ("migration", "migration_kill", "batcher_death",
-                 "decode_death"):
+    for name in ("migration", "migration_kill", "kv_migration",
+                 "batcher_death", "decode_death"):
         r = explore(name, schedules=160, seed=0, time_budget_s=120.0)
         assert r.violations == [], (name, r.violations[:3])
         total += r.distinct

@@ -181,6 +181,49 @@ def test_pool_migration_parity_mid_stream_chunks_and_masks(model_path):
         poolB.stop()
 
 
+def test_binary_carry_payload_exact_round_trip(model_path):
+    """Satellite (ROADMAP 3): the migration hop ships carries as
+    base64-npy bytes (v2) — BIT-exact round trip through a real JSON
+    wire encode/decode, leaf by leaf, and the imported stream continues
+    exactly.  The v1 JSON-float-list fallback stays importable."""
+    from deeplearning4j_tpu.server.decode import _decode_carry_leaf
+    net = load_model(model_path)
+    poolA = DecodePool(net, name="binA", max_slots=2, max_wait_ms=0.5)
+    poolB = DecodePool(net, name="binB", max_slots=2, max_wait_ms=0.5)
+    try:
+        import jax
+        x = _seq(1, 4, seed=9)
+        sid = poolA.open_session()
+        for t in range(3):
+            poolA.step(sid, x[0, t:t + 1])
+        payload = poolA.export_session(sid)
+        assert payload["version"] == 2
+        wire = json.loads(json.dumps(payload))     # the router hop
+        slot = poolA._sessions[sid].slot
+        dev = jax.device_get(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda a: a[slot], poolA._pool)))
+        assert len(dev) == len(wire["carry"]["leaves"])
+        for leaf, spec in zip(dev, wire["carry"]["leaves"]):
+            assert "npy_b64" in spec and "data" not in spec
+            back = _decode_carry_leaf(spec)
+            assert back.dtype == np.asarray(leaf).dtype
+            np.testing.assert_array_equal(np.asarray(leaf), back)
+        # a v1 payload (older replica) still imports: rewrite the
+        # leaves as JSON float lists with the same values
+        v1 = json.loads(json.dumps(payload))
+        v1["version"] = 1
+        v1["carry"]["leaves"] = [
+            {"shape": list(np.shape(a)), "dtype": str(np.asarray(a).dtype),
+             "data": np.asarray(a).ravel().tolist()} for a in dev]
+        assert poolB.import_session(v1) == sid
+        poolA.finish_export(sid, ok=True)
+        (o,) = poolB.step(sid, x[0, 3:4])
+        assert np.all(np.isfinite(o))
+    finally:
+        poolA.stop()
+        poolB.stop()
+
+
 def test_export_limbo_excluded_from_stats_and_reinstates(model_path):
     """Satellite: exported slots leave stats()/active counts while the
     migration is pending; an aborted export reinstates the session with
