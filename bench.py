@@ -1397,6 +1397,20 @@ def bench_serving():
         lambda off: _tracing.set_enabled(False if off else None))
     journal_overhead, legs["journal_ab"] = overhead_ab(
         lambda off: _events.set_enabled(False if off else None))
+    # SLO-evaluator A/B (same paired methodology): a live tracker
+    # evaluates the stock serving objectives against the process
+    # registry at a tight cadence through both legs; the lever is the
+    # DL4J_SLO kill switch (evaluate() becomes a no-op), so the ratio
+    # isolates exactly what always-on burn-rate evaluation costs the
+    # serving path.  Required ≤ 5% like spans and the journal.
+    from deeplearning4j_tpu.monitor import slo as _slo
+    tracker = _slo.SloTracker(_slo.default_objectives())
+    tracker.start(interval_s=0.05)
+    try:
+        slo_overhead, legs["slo_ab"] = overhead_ab(
+            lambda off: _slo.set_enabled(False if off else None))
+    finally:
+        tracker.stop()
     speedup = (legs["coalesced"]["requests_per_sec"]
                / max(legs["per_request"]["requests_per_sec"], 1e-9))
     ladder = legs["coalesced"]["warmed_buckets"]
@@ -1405,6 +1419,8 @@ def bench_serving():
         "span_overhead_within_5pct": span_overhead <= 0.05,
         "journal_overhead_pct": round(journal_overhead * 100.0, 2),
         "journal_overhead_within_5pct": journal_overhead <= 0.05,
+        "slo_overhead_pct": round(slo_overhead * 100.0, 2),
+        "slo_overhead_within_5pct": slo_overhead <= 0.05,
         "metric": f"serving predict requests/sec, {CONCURRENCY} concurrent "
                   "clients, dynamic micro-batching",
         "value": legs["coalesced"]["requests_per_sec"],
@@ -2228,6 +2244,35 @@ def _run_configs(result):
         log(f"dl4j-check gate: exit 0, {chk_doc['total_runs']} "
             f"schedules, {chk_doc['total_distinct']} distinct, "
             "0 violations")
+        # federated-scrape smoke: the fleet router's ?scope=fleet
+        # surface must return text the exposition parser round-trips
+        # (two in-process gateway replicas over real HTTP — no model,
+        # no jit, cheap enough for tier-1)
+        from deeplearning4j_tpu import monitor as _monitor
+        from deeplearning4j_tpu.fleet import SessionRouter
+        from deeplearning4j_tpu.server import (
+            DeepLearning4jEntryPoint, Server)
+        fed_servers = [Server(DeepLearning4jEntryPoint(), port=0).start()
+                       for _ in range(2)]
+        fed_router = SessionRouter()
+        try:
+            for i, s in enumerate(fed_servers):
+                fed_router.add_replica(f"r{i}",
+                                       f"http://{s.host}:{s.port}")
+            scraped = fed_router.federation_scrape()
+            assert all(scraped.values()), scraped
+            fed = fed_router.metrics(scope="fleet")
+            parsed = _monitor.parse_prometheus(fed["body"])
+            assert "dl4j_federation_scrape_age_seconds" in parsed, \
+                sorted(parsed)[:8]
+            result["federation"] = {"replicas": len(fed_servers),
+                                    "families": len(parsed),
+                                    "parse_ok": True}
+        finally:
+            fed_router.close()
+            for s in fed_servers:
+                s.stop()
+        log(f"federated-scrape smoke: {result['federation']}")
 
     for name, fn in config_list:
         if dry_run:

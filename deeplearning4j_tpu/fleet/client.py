@@ -100,6 +100,26 @@ class ReplicaClient:
             return DeadlineExceededError(msg)
         return ReplicaError(msg, code=e.code, method=method)
 
+    def get_text(self, path: str,
+                 timeout_s: Optional[float] = None) -> str:
+        """A bare GET returning the raw response body — the federation
+        scrape hop (``GET /metrics`` serves Prometheus text, not JSON).
+        Non-200 raises :class:`ReplicaError`; transport failures raise
+        :class:`ReplicaUnavailableError` like every other call."""
+        url = self.base_url + "/" + path.lstrip("/")
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=timeout_s or self.timeout_s) as r:
+                return r.read().decode("utf-8", "replace")
+        except urllib.error.HTTPError as e:
+            raise ReplicaError(f"GET /{path.lstrip('/')} -> HTTP {e.code}",
+                               code=e.code, method=f"GET {path}") from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise ReplicaUnavailableError(
+                f"replica {self.base_url} unreachable for GET {path}: "
+                f"{getattr(e, 'reason', e)}") from None
+
     def get_json(self, path: str,
                  timeout_s: Optional[float] = None) -> Tuple[int, dict]:
         """A bare GET probe (``/healthz``, ``/readyz``, ``/trace``,

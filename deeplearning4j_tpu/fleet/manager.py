@@ -19,13 +19,25 @@ rollout orchestration.
   → ``undrain`` → back on the ring.  Every session keeps streaming
   through the whole pass; a final :meth:`SessionRouter.rebalance`
   shifts the ring's share back.
+
+* **Federation + SLOs** (docs/OBSERVABILITY.md "Fleet federation &
+  SLOs"): each poll tick also scrapes every replica's ``/metrics``
+  into the router's :class:`~deeplearning4j_tpu.monitor.federation.
+  MetricsFederation` and, when ``slo_objectives`` is set, evaluates
+  the objectives fleet-wide (on the merged snapshot) AND per replica
+  (on each replica's own scrape).  With ``park_on_slo_burn=True`` a
+  replica whose per-replica SLO is ``burning`` while the fleet-wide
+  one is healthy is parked off the placement ring (its sessions keep
+  serving; it just takes no new placements) and re-ringed when its
+  objectives recover — objective-driven placement, not just
+  liveness-driven.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
 from deeplearning4j_tpu.fleet.client import ReplicaUnavailableError
 from deeplearning4j_tpu.monitor import events
@@ -36,10 +48,23 @@ class FleetManager:
     """Supervises a :class:`~.router.SessionRouter`'s replicas."""
 
     def __init__(self, router, poll_interval_s: float = 1.0,
-                 probe_timeout_s: float = 5.0):
+                 probe_timeout_s: float = 5.0, federate: bool = True,
+                 slo_objectives: Optional[List] = None,
+                 park_on_slo_burn: bool = False):
         self.router = router
         self.poll_interval_s = max(0.05, float(poll_interval_s))
         self.probe_timeout_s = float(probe_timeout_s)
+        self.federate = bool(federate)
+        self.park_on_slo_burn = bool(park_on_slo_burn)
+        self._slo_objectives = (list(slo_objectives)
+                                if slo_objectives else None)
+        self._slo_fleet = None
+        self._slo_replica: dict = {}
+        self._slo_parked: set = set()
+        if self._slo_objectives:
+            from deeplearning4j_tpu.monitor.slo import SloTracker
+            self._slo_fleet = SloTracker(self._slo_objectives,
+                                         series_prefix="fleet|")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         router.manager = self
@@ -102,7 +127,72 @@ class FleetManager:
                 self.poll_once()
             except Exception:
                 pass   # the poll loop must outlive any probe surprise
+            try:
+                if self.federate:
+                    self.router.federation_scrape()
+                if self._slo_objectives:
+                    self.evaluate_slo()
+            except Exception:
+                pass   # ...and any federation/SLO surprise
             self._stop.wait(self.poll_interval_s)
+
+    # ------------------------------------------------------------------
+    # SLO evaluation + objective-driven placement
+    # ------------------------------------------------------------------
+    def evaluate_slo(self, now: Optional[float] = None) -> dict:
+        """One fleet-wide + per-replica SLO evaluation pass over the
+        federation's current scrapes (also runs every poll tick).
+        Returns ``{"fleet": ..., "replicas": {name: ...}}``."""
+        if self._slo_fleet is None:
+            return {}
+        from deeplearning4j_tpu.monitor.slo import SloTracker
+        fed = self.router.federation
+        out = {"fleet": self._slo_fleet.evaluate(
+            fed.merged(local_name="router"), now=now), "replicas": {}}
+        per = fed.replica_snapshots()
+        for name, snap in per.items():
+            tr = self._slo_replica.get(name)
+            if tr is None:
+                tr = self._slo_replica[name] = SloTracker(
+                    self._slo_objectives,
+                    series_prefix=f"replica={name}|",
+                    flight_dump=False)
+            out["replicas"][name] = tr.evaluate(snap, now=now)
+        for name in list(self._slo_replica):
+            if name not in per:
+                del self._slo_replica[name]
+        if self.park_on_slo_burn:
+            self._apply_slo_placement()
+        return out
+
+    def _apply_slo_placement(self) -> None:
+        """Park a replica whose OWN SLO is burning while the fleet-wide
+        objective is healthy (the problem is that box, not the
+        workload); re-ring it when its objectives recover.  Only
+        touches placements THIS hook parked."""
+        fleet = self._slo_fleet
+        for name, tr in list(self._slo_replica.items()):
+            burning = tr.burning_objectives()
+            if burning and name not in self._slo_parked:
+                fleet_healthy = all(
+                    fleet.healthy(obj) for obj in burning)
+                if fleet_healthy:
+                    try:
+                        self.router.set_placement(name, False)
+                    except KeyError:
+                        continue
+                    self._slo_parked.add(name)
+                    events.emit("slo.replica_parked", severity="warn",
+                                replica=name, parked=True,
+                                objectives=sorted(burning))
+            elif not burning and name in self._slo_parked:
+                try:
+                    self.router.set_placement(name, True)
+                except KeyError:
+                    pass
+                self._slo_parked.discard(name)
+                events.emit("slo.replica_parked", replica=name,
+                            parked=False)
 
     # ------------------------------------------------------------------
     # Drain-free blue/green rollout

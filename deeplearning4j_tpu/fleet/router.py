@@ -51,6 +51,7 @@ from deeplearning4j_tpu.fleet.client import (
     ReplicaClient, ReplicaError, ReplicaUnavailableError)
 from deeplearning4j_tpu.fleet.ring import HashRing
 from deeplearning4j_tpu.monitor import events, flight
+from deeplearning4j_tpu.monitor.federation import MetricsFederation
 from deeplearning4j_tpu.resilience import CircuitBreaker, RetryPolicy
 from deeplearning4j_tpu.resilience.errors import (
     OverloadedError, TransientError)
@@ -169,6 +170,12 @@ class SessionRouter:
             max_attempts=3, base_delay_ms=20, max_delay_ms=250,
             retry_on=(TransientError,), name="fleet.route")
         self._metrics = FleetMetrics()
+        # metrics federation: per-replica /metrics scrapes merged into
+        # the one fleet snapshot served at ?scope=fleet (the attached
+        # FleetManager's poll loop scrapes periodically; a fleet-scope
+        # read refreshes on demand when the last scrape is stale)
+        self.federation = MetricsFederation()
+        self.federation_max_age_s = 10.0
         self._seq = itertools.count(1)
         self._t_start = time.time()
         self.manager = None   # a FleetManager attaches itself here
@@ -354,6 +361,11 @@ class SessionRouter:
                     retry_after_s=self.retry_after_s)
             self._inflight_rows += rows
             self._tenant_rows[t] = self._tenant_rows.get(t, 0) + rows
+            queued = self._inflight_rows
+        # the router-side half of the cross-replica timeline: without
+        # this event the assembled fleet trace has no router-lane entry
+        # carrying the request ID the replica hop adopts
+        events.emit("request.admitted", rows=rows, queued=queued)
 
     def _release(self, rows: int, tenant: Optional[str]) -> None:
         t = tenant or "-"
@@ -761,13 +773,42 @@ class SessionRouter:
                 },
             }
 
-    def metrics(self, format: str = "prometheus"):
-        """The scrape endpoint as an RPC (same registry the replicas
-        mirror their own families into when co-hosted; a separate
-        router process scrapes its own ``dl4j_router_*``/``dl4j_fleet_*``
-        families here and the replicas' ``/metrics`` directly)."""
+    # -- federation (docs/OBSERVABILITY.md "Fleet federation & SLOs") --
+    def _federation_sources(self) -> Dict[str, callable]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        return {r.name: (lambda c=r.client: c.get_text("metrics",
+                                                       timeout_s=5.0))
+                for r in reps}
+
+    def federation_scrape(self) -> Dict[str, bool]:
+        """Scrape every replica's ``GET /metrics`` into the federation
+        (the FleetManager poll loop calls this each tick; ``?scope=
+        fleet`` reads call it on demand when the last scrape is older
+        than ``federation_max_age_s``)."""
+        return self.federation.scrape(self._federation_sources())
+
+    def metrics(self, format: str = "prometheus",
+                scope: str = "process"):
+        """The scrape endpoint as an RPC.  ``scope="process"`` (default)
+        is the router process's own registry; ``scope="fleet"`` merges
+        every replica's federated scrape with it — counters/histograms
+        summed fleet-wide, gauges per-replica under ``replica=``, each
+        replica's staleness visible as
+        ``dl4j_federation_scrape_age_seconds`` (also served raw at
+        ``GET /metrics?scope=fleet``)."""
         fmt = str(format).lower()
-        snap = monitor.get_registry().snapshot()
+        scope = str(scope).lower()
+        if scope not in ("process", "fleet"):
+            raise ValueError(f"scope must be process or fleet, "
+                             f"got {scope!r}")
+        if scope == "fleet":
+            age = self.federation.last_scrape_age()
+            if age is None or age > self.federation_max_age_s:
+                self.federation_scrape()
+            snap = self.federation.merged(local_name="router")
+        else:
+            snap = monitor.get_registry().snapshot()
         if fmt == "json":
             return snap
         if fmt != "prometheus":
@@ -778,25 +819,71 @@ class SessionRouter:
 
     def trace_dump(self, last_n: Optional[int] = None,
                    format: str = "events", request_id: Optional[str] = None,
-                   dump: bool = False, reason: str = "manual") -> dict:
-        """The router process's own journal (the replica hops carry the
-        same request IDs — fetch a replica's ``GET /trace`` with the
-        same ``request_id`` for the other half of the flow)."""
+                   dump: bool = False, reason: str = "manual",
+                   scope: str = "fleet") -> dict:
+        """Cross-replica trace assembly (default ``scope="fleet"``):
+        fetches every replica's journal over its ``GET /trace`` plus
+        the router's own, and merges them by process —
+        ``format="chrome"`` returns ONE Perfetto-loadable file with a
+        lane per replica, so a migrated decode stream reads as one
+        timeline (source replica → router → target replica, joined by
+        the session/request IDs the hops propagate).  ``scope="local"``
+        is the router process's own journal only."""
         fmt = str(format).lower()
         if fmt not in ("events", "chrome"):
             raise ValueError(f"format must be events or chrome, got "
                              f"{format!r}")
+        scope = str(scope).lower()
+        if scope not in ("fleet", "local"):
+            raise ValueError(f"scope must be fleet or local, "
+                             f"got {scope!r}")
         journal = events.get_journal()
-        evts = journal.tail(n=last_n, request_id=request_id)
-        out: dict = {"count": len(evts),
-                     "total_emitted": journal.total_emitted,
+        own = journal.tail(n=last_n, request_id=request_id)
+        out: dict = {"total_emitted": journal.total_emitted,
                      "dropped": journal.dropped}
+        if scope == "local":
+            out["count"] = len(own)
+            if dump:
+                out["path"] = flight.dump(reason, force=True)
+            if fmt == "chrome":
+                out["trace"] = events.chrome_trace(own)
+            else:
+                out["events"] = own
+            return out
+        per: Dict[str, List[dict]] = {"router": own}
+        errors: Dict[str, str] = {}
+        query = []
+        if last_n is not None:
+            query.append(f"last_n={int(last_n)}")
+        if request_id is not None:
+            query.append(f"request_id={request_id}")
+        path = "trace" + ("?" + "&".join(query) if query else "")
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            try:
+                code, body = rep.client.get_json(path, timeout_s=10.0)
+                if code == 200:
+                    per[rep.name] = body.get("events") or []
+                else:
+                    per[rep.name] = []
+                    errors[rep.name] = f"HTTP {code}"
+            except Exception as e:
+                per[rep.name] = []
+                errors[rep.name] = f"{type(e).__name__}: {e}"
+        out["count"] = sum(len(v) for v in per.values())
+        out["processes"] = {k: len(v) for k, v in per.items()}
+        if errors:
+            out["errors"] = errors
         if dump:
             out["path"] = flight.dump(reason, force=True)
         if fmt == "chrome":
-            out["trace"] = events.chrome_trace(evts)
+            out["trace"] = events.chrome_trace_fleet(per)
         else:
-            out["events"] = evts
+            merged = [dict(e, process=name)
+                      for name, evts in per.items() for e in evts]
+            merged.sort(key=lambda e: e.get("ts", 0.0))
+            out["events"] = merged
         return out
 
     def close(self) -> None:

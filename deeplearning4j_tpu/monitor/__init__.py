@@ -35,15 +35,15 @@ places flight-recorder dumps.  Full catalog: docs/OBSERVABILITY.md.
 
 from deeplearning4j_tpu.monitor import events, flight  # noqa: F401
 from deeplearning4j_tpu.monitor.events import (  # noqa: F401
-    EventJournal, chrome_trace, get_journal, new_request_id,
-    request_scope)
+    EventJournal, chrome_trace, chrome_trace_fleet, get_journal,
+    new_request_id, request_scope)
 from deeplearning4j_tpu.monitor.registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry)
 from deeplearning4j_tpu.monitor.tracing import (  # noqa: F401
     Span, current, enable_jax_annotations, profile_if_configured, span)
 from deeplearning4j_tpu.monitor.exposition import (  # noqa: F401
-    CONTENT_TYPE, parse_prometheus, render_json, render_prometheus,
-    summarize)
+    CONTENT_TYPE, merge_snapshots, parse_prometheus, render_json,
+    render_prometheus, snapshot_from_parsed, summarize)
 from deeplearning4j_tpu.monitor.system import (  # noqa: F401
     memory_collector, memory_snapshot)
 

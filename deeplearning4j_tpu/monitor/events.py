@@ -76,6 +76,8 @@ EVENT_TYPES = (
     "fleet.migrated",
     "fleet.migrate_failed",
     "fleet.rollout",
+    "slo.state_changed",
+    "slo.replica_parked",
     "cache.load",
     "cache.evicted",
     "rollout.flip",
@@ -383,19 +385,13 @@ emit = _JOURNAL.emit
 _META_KEYS = ("type", "severity", "ts", "tid", "seq")
 
 
-def chrome_trace(events: Optional[List[dict]] = None) -> dict:
-    """Render journal events as a Chrome trace-event JSON object
-    (https://ui.perfetto.dev loads it directly; ``chrome://tracing``
-    too).  ``span.close`` events become complete ("X") slices placed at
-    their start time with their measured duration; every other event is
-    an instant ("i") mark.  Correlation fields (request_id, session_id,
-    tenant, ...) ride in ``args`` so a slice can be found by searching
-    for its request ID."""
-    if events is None:
-        events = get_journal().tail()
-    pid = os.getpid()
+def _chrome_entries(events: List[dict], pid: int) -> tuple:
+    """(trace entries, tids seen) for one process lane — the shared
+    conversion: ``span.close`` → complete ("X") slices placed at their
+    start time, everything else → instant ("i") marks, correlation
+    fields in ``args``."""
     out: List[dict] = []
-    tids = {}
+    tids: dict = {}
     for e in events:
         tid = e.get("tid", 0)
         tids.setdefault(tid, None)
@@ -414,9 +410,57 @@ def chrome_trace(events: Optional[List[dict]] = None) -> dict:
                         "cat": str(e.get("type", "event")).split(".")[0],
                         "ph": "i", "s": "t", "ts": ts_us,
                         "pid": pid, "tid": tid, "args": args})
+    return out, tids
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> dict:
+    """Render journal events as a Chrome trace-event JSON object
+    (https://ui.perfetto.dev loads it directly; ``chrome://tracing``
+    too).  ``span.close`` events become complete ("X") slices placed at
+    their start time with their measured duration; every other event is
+    an instant ("i") mark.  Correlation fields (request_id, session_id,
+    tenant, ...) ride in ``args`` so a slice can be found by searching
+    for its request ID."""
+    if events is None:
+        events = get_journal().tail()
+    pid = os.getpid()
+    out, tids = _chrome_entries(events, pid)
     meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
              "args": {"name": "deeplearning4j_tpu"}}]
     for tid in tids:
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid, "args": {"name": f"thread-{tid}"}})
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def _match_request_id(e: dict, request_id: str) -> bool:
+    return (e.get("request_id") == request_id
+            or request_id in (e.get("request_ids") or ()))
+
+
+def chrome_trace_fleet(events_by_process: Dict[str, List[dict]],
+                       request_id: Optional[str] = None) -> dict:
+    """ONE Perfetto-loadable Chrome trace over several processes'
+    journal events — the fleet-trace assembly (docs/OBSERVABILITY.md
+    "Fleet federation & SLOs").  Each source (the router, each replica)
+    becomes its own process lane (``pid`` 1..N, named by its key), so a
+    migrated decode stream reads as one timeline: its `decode.step`
+    events appear in the source replica's lane, the `fleet.migrated`
+    hop in the router's, and the continuation in the target's — all
+    correlated by the session/request IDs in ``args``.  Wall-clock
+    timestamps are emitted as-is; replicas on one host share a clock,
+    cross-host skew shows as lane offset (documented caveat)."""
+    meta: List[dict] = []
+    out: List[dict] = []
+    for pid, pname in enumerate(sorted(events_by_process), 1):
+        evts = events_by_process[pname]
+        if request_id is not None:
+            evts = [e for e in evts if _match_request_id(e, request_id)]
+        entries, tids = _chrome_entries(evts, pid)
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": pname}})
+        for tid in tids:
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": f"thread-{tid}"}})
+        out.extend(entries)
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}

@@ -152,6 +152,153 @@ def parse_prometheus(text: str) -> Dict[str, dict]:
 
 
 # ---------------------------------------------------------------------------
+# Federation merge helpers (monitor/federation.py builds on these): turn a
+# parsed text scrape back into the snapshot shape every renderer walks, and
+# merge N snapshot-shaped sources into ONE federated snapshot.
+# ---------------------------------------------------------------------------
+def _le_key(le: str) -> float:
+    return math.inf if le == "+Inf" else float(le)
+
+
+def snapshot_from_parsed(parsed: Dict[str, dict]) -> Dict[str, dict]:
+    """Reconstruct a ``MetricsRegistry.snapshot()``-shaped dict from
+    :func:`parse_prometheus` output, so a scraped replica's families can
+    be merged and re-rendered with the same code that serves the local
+    registry.  Histogram ``_bucket``/``_sum``/``_count`` samples regroup
+    by their non-``le`` label set; reservoir percentiles are not carried
+    by the text format, so rebuilt histogram samples omit them (the
+    renderer skips absent quantiles)."""
+    out: Dict[str, dict] = {}
+    for fam, doc in parsed.items():
+        kind = doc.get("type", "untyped")
+        if kind != "histogram":
+            samples = [{"labels": dict(labels), "value": value}
+                       for _name, labels, value in doc.get("samples", ())]
+            out[fam] = {
+                "type": kind, "help": "",
+                "label_names": sorted({k for s in samples
+                                       for k in s["labels"]}),
+                "samples": samples}
+            continue
+        groups: Dict[Tuple, dict] = {}
+        for name, labels, value in doc.get("samples", ()):
+            key_labels = {k: v for k, v in labels.items() if k != "le"}
+            key = tuple(sorted(key_labels.items()))
+            g = groups.setdefault(key, {"labels": key_labels, "buckets": {},
+                                        "sum": 0.0, "count": 0.0})
+            if name.endswith("_bucket"):
+                g["buckets"][labels.get("le", "+Inf")] = value
+            elif name.endswith("_sum"):
+                g["sum"] = value
+            elif name.endswith("_count"):
+                g["count"] = value
+        samples = []
+        for key in sorted(groups):
+            g = groups[key]
+            g["buckets"] = dict(sorted(g["buckets"].items(),
+                                       key=lambda kv: _le_key(kv[0])))
+            samples.append(g)
+        out[fam] = {
+            "type": kind, "help": "",
+            "label_names": sorted({k for s in samples
+                                   for k in s["labels"]}),
+            "samples": samples}
+    return out
+
+
+def _merged_buckets(srcs: List[dict]) -> Dict[str, float]:
+    """Sum cumulative bucket counts over the union of each source's
+    ``le`` ladder: a source missing an ``le`` contributes its count at
+    its greatest bucket at-or-below it (buckets are cumulative, so that
+    carry-forward is exact for its own ladder)."""
+    les: set = set()
+    per_src: List[List[Tuple[float, float]]] = []
+    for s in srcs:
+        b = s.get("buckets") or {}
+        les.update(b)
+        per_src.append(sorted(((_le_key(le), v) for le, v in b.items())))
+    out: Dict[str, float] = {}
+    for le in sorted(les, key=_le_key):
+        lv, total = _le_key(le), 0.0
+        for pairs in per_src:
+            cum = 0.0
+            for sle, v in pairs:
+                if sle <= lv:
+                    cum = v
+                else:
+                    break
+            total += cum
+        out[le] = total
+    return out
+
+
+def merge_snapshots(sources: Dict[str, Dict[str, dict]]) -> Dict[str, dict]:
+    """Merge snapshot-shaped sources (replica name → snapshot) into one
+    federated snapshot (docs/OBSERVABILITY.md "Fleet federation & SLOs"):
+
+    * **counters** sum across sources per label set — fleet totals;
+    * **histograms** sum bucket counts (cumulative, union ladder),
+      ``sum`` and ``count`` per label set — fleet-aggregatable;
+    * **gauges** (and untyped/summary samples) keep one sample per
+      source under an added ``replica`` label — a gauge is a per-process
+      reading, summing it would fabricate a meaningless number.  A
+      sample that ALREADY carries a ``replica`` label keeps it (the
+      federation's own per-replica staleness gauges).
+
+    A family whose type disagrees across sources keeps the first type
+    seen and drops conflicting sources' samples (re-declaration bug,
+    surfaced by the missing series rather than a crash)."""
+    merged: Dict[str, dict] = {}
+    for src in sorted(sources):
+        snap = sources[src]
+        for fam, doc in snap.items():
+            kind = doc.get("type", "untyped")
+            m = merged.setdefault(fam, {"type": kind,
+                                        "help": doc.get("help", ""),
+                                        "_names": set(), "_acc": {}})
+            if m["type"] != kind:
+                continue
+            if not m["help"] and doc.get("help"):
+                m["help"] = doc["help"]
+            for s in doc.get("samples", ()):
+                labels = dict(s.get("labels") or {})
+                if kind not in ("counter", "histogram"):
+                    labels.setdefault("replica", src)
+                key = tuple(sorted(labels.items()))
+                m["_names"].update(labels)
+                acc = m["_acc"].get(key)
+                if kind == "histogram":
+                    if acc is None:
+                        acc = m["_acc"][key] = {
+                            "labels": labels, "sum": 0.0, "count": 0.0,
+                            "_srcs": []}
+                    acc["sum"] += float(s.get("sum") or 0.0)
+                    acc["count"] += float(s.get("count") or 0.0)
+                    acc["_srcs"].append(s)
+                else:
+                    if acc is None:
+                        acc = m["_acc"][key] = {"labels": labels,
+                                                "value": 0.0}
+                    if kind == "counter":
+                        acc["value"] += float(s.get("value") or 0.0)
+                    else:
+                        acc["value"] = float(s.get("value") or 0.0)
+    out: Dict[str, dict] = {}
+    for fam, m in merged.items():
+        samples = []
+        for key in sorted(m["_acc"]):
+            acc = m["_acc"][key]
+            srcs = acc.pop("_srcs", None)
+            if srcs is not None:
+                acc["buckets"] = _merged_buckets(srcs)
+            samples.append(acc)
+        out[fam] = {"type": m["type"], "help": m["help"],
+                    "label_names": sorted(m["_names"]),
+                    "samples": samples}
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Compact summary (bench.py embeds this in every BENCH_*.json record)
 # ---------------------------------------------------------------------------
 def summarize(snapshot: Dict[str, dict]) -> dict:

@@ -1,0 +1,146 @@
+"""Metrics federation — one pane of glass over an N-replica fleet
+(docs/OBSERVABILITY.md "Fleet federation & SLOs").
+
+Each gateway replica owns a process-wide registry and serves it at
+``GET /metrics``; before this module an operator of an N-replica fleet
+scraped (and eyeballed) N endpoints.  :class:`MetricsFederation` pulls
+each replica's text-format scrape through the existing
+``exposition.parse_prometheus`` parser, keeps the parsed families
+per replica, and merges them into ONE snapshot-shaped dict
+(``exposition.merge_snapshots``): counters and histogram buckets sum
+into fleet totals, gauges keep one sample per replica under a
+``replica`` label.  The fleet router serves the merge at
+``GET /metrics?scope=fleet`` (and the ``metrics`` RPC with
+``scope="fleet"``) next to its own ``dl4j_router_*``/``dl4j_fleet_*``
+families.
+
+**Staleness is explicit**: a dead replica's LAST successful scrape
+stays in the merge (its series would otherwise silently vanish from
+dashboards), and ``dl4j_federation_scrape_age_seconds{replica=}``
+says exactly how old each replica's contribution is — a frozen counter
+with a growing age is a dead replica, not a quiet one.  Scrape
+attempts are counted per outcome in
+``dl4j_federation_scrapes_total{replica,outcome}``.
+
+The module is transport-agnostic: ``scrape()`` takes
+``{name: fetch_fn}`` where each ``fetch_fn() -> str`` returns one
+Prometheus text body.  The fleet tier supplies fetchers built on
+``ReplicaClient.get_text`` (fleet/router.py); tests feed canned text.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from deeplearning4j_tpu.monitor.exposition import (
+    merge_snapshots, parse_prometheus, snapshot_from_parsed)
+from deeplearning4j_tpu.monitor.registry import get_registry
+
+
+class MetricsFederation:
+    """Scrape-state store + merger for one fleet's replicas."""
+
+    def __init__(self, registry=None):
+        self._registry = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        #: name -> {"snapshot", "ts" (last OK wall time), "ok", "error"}
+        self._scrapes: Dict[str, dict] = {}
+        self._last_attempt: Optional[float] = None
+        self._g_age = self._registry.gauge(
+            "dl4j_federation_scrape_age_seconds",
+            "age of each replica's last successful /metrics scrape — a "
+            "growing age means that replica's federated series are "
+            "stale, not current", ("replica",))
+        self._c_scrapes = self._registry.counter(
+            "dl4j_federation_scrapes_total",
+            "federation scrape attempts per replica, by outcome",
+            ("replica", "outcome"))
+
+    # ------------------------------------------------------------------
+    # Scraping
+    # ------------------------------------------------------------------
+    def scrape(self, sources: Dict[str, Callable[[], str]]) -> Dict[str, bool]:
+        """Fetch + parse every source's Prometheus text.  A fetch or
+        parse failure KEEPS the replica's previous snapshot (visibly
+        stale via the age gauge) and records the error; a replica no
+        longer in ``sources`` is dropped from the merge entirely.
+        Returns ``{name: ok}``."""
+        results: Dict[str, bool] = {}
+        now = time.time()
+        for name, fetch in sources.items():
+            try:
+                snap = snapshot_from_parsed(parse_prometheus(fetch()))
+                ok, err = True, None
+            except Exception as e:
+                snap, ok = None, False
+                err = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self._last_attempt = now
+                cur = self._scrapes.get(name)
+                if ok:
+                    self._scrapes[name] = {"snapshot": snap, "ts": now,
+                                           "ok": True, "error": None}
+                elif cur is not None:
+                    cur["ok"] = False
+                    cur["error"] = err
+                else:
+                    self._scrapes[name] = {"snapshot": None, "ts": None,
+                                           "ok": False, "error": err}
+            self._c_scrapes.labels(
+                replica=name, outcome="ok" if ok else "error").inc()
+            results[name] = ok
+        with self._lock:
+            for name in list(self._scrapes):
+                if name not in sources:
+                    del self._scrapes[name]
+        self._refresh_ages()
+        return results
+
+    def last_scrape_age(self) -> Optional[float]:
+        """Seconds since the last scrape ATTEMPT (None = never) — the
+        on-demand-refresh freshness check for ``?scope=fleet``."""
+        with self._lock:
+            t = self._last_attempt
+        return None if t is None else max(0.0, time.time() - t)
+
+    def _refresh_ages(self) -> None:
+        now = time.time()
+        with self._lock:
+            items = [(n, s["ts"]) for n, s in self._scrapes.items()]
+        for name, ts in items:
+            if ts is not None:
+                self._g_age.labels(replica=name).set(round(now - ts, 3))
+
+    # ------------------------------------------------------------------
+    # Reading
+    # ------------------------------------------------------------------
+    def replica_snapshots(self) -> Dict[str, dict]:
+        """Each replica's last successfully parsed snapshot (the
+        per-replica SLO evaluation input)."""
+        with self._lock:
+            return {n: s["snapshot"] for n, s in self._scrapes.items()
+                    if s["snapshot"] is not None}
+
+    def status(self) -> Dict[str, dict]:
+        now = time.time()
+        with self._lock:
+            return {n: {"ok": s["ok"], "error": s["error"],
+                        "age_s": (None if s["ts"] is None
+                                  else round(now - s["ts"], 3))}
+                    for n, s in self._scrapes.items()}
+
+    def merged(self, local_name: Optional[str] = "router") -> Dict[str, dict]:
+        """The federated snapshot: every replica's last parse plus (by
+        default) the local process registry under ``local_name`` — so a
+        fleet scrape carries the router's own ``dl4j_router_*`` /
+        ``dl4j_fleet_*`` / federation-staleness families alongside the
+        replicas'.  Ages are refreshed first, so the rendered
+        ``dl4j_federation_scrape_age_seconds`` is current as of THIS
+        merge."""
+        self._refresh_ages()
+        sources = self.replica_snapshots()
+        if local_name is not None:
+            sources[local_name] = self._registry.snapshot()
+        return merge_snapshots(sources)

@@ -1112,6 +1112,58 @@ class DecodePool:
         return {"slot_ladder": list(self._ladder),
                 "warmup_sec": round(time.perf_counter() - t0, 3)}
 
+    def warmup_spec(self, feature_tails, k: int = 4,
+                    dtype=np.float32) -> dict:
+        """Mirror of :meth:`warmup` for the fused speculative-verify
+        program: pre-compile ``_spec_jit`` for every slot-ladder rung at
+        the spec chunk length (the pending token + ``k`` drafts, padded
+        to its time bucket) so the first ``decode_step(spec=...)``
+        never pays a cold XLA compile.  Warmup verify steps ride the
+        normal batcher queue on scratch-slot sessions exactly like the
+        plain warmup — no real session state is touched."""
+        t_chunk = 1 + max(0, int(k))
+        tails = self._broadcast_tails(feature_tails, t_chunk)
+        if any(len(t) < 2 for t in tails):
+            raise ValueError("speculative warmup needs sequence inputs "
+                             "([T, C] per network input)")
+        # a live spec step's chunk is 1..1+k tokens long (the drafter
+        # may propose fewer than k), and each distinct TIME bucket of
+        # that range is its own compiled program — warm one chunk
+        # length per distinct bucket, at every slot-ladder rung
+        g = self.model.conf.global_conf
+        chunks, seen = [], set()
+        for t in range(1, t_chunk + 1):
+            tb = bucketing.bucket_size(t, g.bucket_time_sizes)
+            if tb not in seen:
+                seen.add(tb)
+                chunks.append(t)
+        t0 = time.perf_counter()
+        for t in chunks:
+            xs = tuple(np.zeros((t,) + tuple(tail[1:]), dtype)
+                       for tail in tails)
+            masks = tuple(None for _ in tails)
+            tok = np.zeros((t,), np.int32)
+            for rung in self._ladder:
+                futs = []
+                with self._cond:
+                    if not self._running:
+                        break
+                    for i in range(rung):
+                        fut = Future()
+                        s = DecodeSession(f"warmup-spec-{t}-{rung}-{i}",
+                                          self.max_slots, None)
+                        s.started = True   # gather the (zero) scratch row
+                        self._queue.append(
+                            _PendingStep(s, xs, masks, fut, None, None,
+                                         spec_tokens=tok))
+                        futs.append(fut)
+                    self._cond.notify_all()
+                for fut in futs:
+                    fut.result(timeout=600)
+        return {"slot_ladder": list(self._ladder), "k": max(0, int(k)),
+                "chunks": chunks,
+                "warmup_sec": round(time.perf_counter() - t0, 3)}
+
     def _broadcast_tails(self, feature_tails, t_steps: int):
         dims = list(feature_tails)
         if not dims or not isinstance(dims[0], (tuple, list)):
@@ -1657,6 +1709,14 @@ class DecodeManager:
             with self._lock:
                 self._by_sid.pop(session_id, None)
             raise
+
+    def warmup_spec(self, model_path: str, feature_tails,
+                    k: int = 4) -> dict:
+        """Pre-compile the fused speculative-verify program for
+        ``model_path``'s pool (see :meth:`DecodePool.warmup_spec`) —
+        the gateway ``warmup(spec_k=...)`` path."""
+        pool = self._pool_for(model_path)
+        return pool.warmup_spec(feature_tails, k=k)
 
     def spec_step(self, session_id: str, xs, token_ids,
                   timeout_ms: Optional[float] = None,

@@ -76,7 +76,8 @@ class DeepLearning4jEntryPoint:
                  tenant_quota_rows: Optional[int] = None,
                  decode_slots: int = 32, decode_ttl_s: float = 600.0,
                  decode_max_wait_ms: float = 2.0,
-                 blue_green: bool = False):
+                 blue_green: bool = False,
+                 slo=None, slo_interval_s: float = 5.0):
         if model_cache is None:
             model_cache = ModelCache(
                 load_retry=RetryPolicy(max_attempts=3, base_delay_ms=25,
@@ -112,6 +113,18 @@ class DeepLearning4jEntryPoint:
         self._c_shed = monitor.get_registry().counter(
             "dl4j_resilience_shed_total",
             "requests shed instead of served", labels=("reason",))
+        # SLO monitoring (docs/OBSERVABILITY.md "Fleet federation &
+        # SLOs"): slo=True arms the stock serving objectives, a list of
+        # Objectives (or a ready SloTracker) customizes them; the
+        # evaluator thread watches this process's registry and meters
+        # dl4j_slo_* / journals slo.state_changed / flight-dumps on a
+        # fast-burn flip
+        self.slo = None
+        if slo:
+            from deeplearning4j_tpu.monitor.slo import SloTracker
+            self.slo = (slo if isinstance(slo, SloTracker)
+                        else SloTracker(None if slo is True else slo))
+            self.slo.start(interval_s=slo_interval_s)
 
     def _load_model(self, model_path: str):
         return self.model_cache.get(model_path)
@@ -253,13 +266,22 @@ class DeepLearning4jEntryPoint:
         return self._format_predictions(stacked, top_k, argmax_only)
 
     def warmup(self, model_path: str, feature_dims,
-               max_batch: Optional[int] = None) -> dict:
+               max_batch: Optional[int] = None,
+               spec_k: Optional[int] = None) -> dict:
         """Explicitly pre-compile the serving bucket ladder for
         ``model_path`` (``feature_dims`` is the per-example feature
-        shape) — what the first ``features=`` predict does implicitly."""
+        shape) — what the first ``features=`` predict does implicitly.
+        ``spec_k=K`` additionally warms the decode pool's fused
+        speculative-verify program per slot-ladder rung
+        (``DecodePool.warmup_spec``) so the first
+        ``decode_step(spec=...)`` never pays a cold compile."""
         model = self.model_cache.get(model_path)
-        return model.warmup_inference(
+        out = model.warmup_inference(
             feature_dims, max_batch=int(max_batch or self.max_batch))
+        if spec_k is not None:
+            out["spec"] = self.decode.warmup_spec(
+                model_path, feature_dims, k=int(spec_k))
+        return out
 
     def invalidate(self, model_path: Optional[str] = None) -> dict:
         """Drop cached model(s) — and their batchers and decode pools
@@ -530,16 +552,27 @@ class DeepLearning4jEntryPoint:
                 s["compile_telemetry"] = tel.snapshot()
             out["serving"][key] = s
         out["decode"] = self.decode.stats()
+        if self.slo is not None:
+            out["slo"] = self.slo.states()
         out["registry"] = monitor.get_registry().snapshot()
         return out
 
-    def metrics(self, format: str = "prometheus"):
+    def metrics(self, format: str = "prometheus",
+                scope: str = "process"):
         """The scrape endpoint as an RPC.  ``format="prometheus"``
         (default) returns ``{"content_type", "body"}`` with text-format
         v0.0.4 (also served raw at ``GET /metrics`` for a stock
         Prometheus scraper / ``curl``); ``format="json"`` returns the
-        registry snapshot dict itself."""
+        registry snapshot dict itself.  ``scope`` is accepted for
+        surface parity with the fleet router — a single gateway only
+        has ``"process"`` scope (``"fleet"`` is served by
+        ``fleet.SessionRouter``)."""
         fmt = str(format).lower()
+        if str(scope).lower() != "process":
+            raise ValueError(
+                f"scope {scope!r} is not served by a single gateway — "
+                "fleet scope is the fleet router's surface "
+                "(fleet/router.py)")
         snap = monitor.get_registry().snapshot()
         if fmt == "json":
             return snap
@@ -551,7 +584,8 @@ class DeepLearning4jEntryPoint:
 
     def trace_dump(self, last_n: Optional[int] = None,
                    format: str = "events", request_id: Optional[str] = None,
-                   dump: bool = False, reason: str = "manual") -> dict:
+                   dump: bool = False, reason: str = "manual",
+                   scope: str = "local") -> dict:
         """Live access to the structured event journal (the flight
         recorder's source).  ``format="events"`` (default) returns the
         newest ``last_n`` journal events (optionally filtered to one
@@ -560,11 +594,19 @@ class DeepLearning4jEntryPoint:
         ``trace`` (save ``.trace`` to a file and open it in Perfetto /
         ``chrome://tracing`` to see a serving burst or a slow fit epoch
         as real slices).  ``dump=True`` also writes a flight-recorder
-        file and returns its path."""
+        file and returns its path.  ``scope`` is accepted for surface
+        parity with the fleet router (which assembles every replica's
+        journal); a single gateway only serves its ``"local"``
+        journal."""
         fmt = str(format).lower()
         if fmt not in ("events", "chrome"):
             raise ValueError(f"format must be events or chrome, got "
                              f"{format!r}")
+        if str(scope).lower() not in ("local", "process"):
+            raise ValueError(
+                f"scope {scope!r} is not served by a single gateway — "
+                "fleet trace assembly is the fleet router's surface "
+                "(fleet/router.py)")
         journal = events.get_journal()
         evts = journal.tail(n=last_n, request_id=request_id)
         out: dict = {"count": len(evts),
@@ -581,6 +623,8 @@ class DeepLearning4jEntryPoint:
     def close(self) -> None:
         """Stop all batcher threads and decode pools (server
         shutdown; open decode sessions fail cleanly)."""
+        if self.slo is not None:
+            self.slo.stop()
         with self._batcher_lock:
             dropped = list(self._batchers.values())
             self._batchers.clear()
@@ -698,14 +742,17 @@ class Server:
                 ``?request_id=`` filters to one request's events)."""
                 path, _, query = self.path.partition("?")
                 try:
+                    from urllib.parse import parse_qs
+                    q = {k: v[-1] for k, v in parse_qs(query).items()}
                     if path == "/trace":
-                        from urllib.parse import parse_qs
-                        q = {k: v[-1] for k, v in parse_qs(query).items()}
                         fmt = q.get("format", "events")
                         last_n = (int(q["last_n"]) if "last_n" in q
                                   else None)
+                        kw = ({"scope": q["scope"]} if "scope" in q
+                              else {})
                         r = ep.trace_dump(last_n=last_n, format=fmt,
-                                          request_id=q.get("request_id"))
+                                          request_id=q.get("request_id"),
+                                          **kw)
                         # chrome format serves the bare trace object so
                         # the response body IS a Perfetto-loadable file
                         body = r["trace"] if fmt == "chrome" else r
@@ -714,7 +761,12 @@ class Server:
                             200, json.dumps(body, default=str).encode(),
                             "application/json")
                     elif path == "/metrics":
-                        m = ep.metrics()
+                        # ?scope=fleet on a fleet router serves the
+                        # federated merge; a single gateway only has
+                        # process scope
+                        kw = ({"scope": q["scope"]} if "scope" in q
+                              else {})
+                        m = ep.metrics(**kw)
                         server._count_request("GET /metrics", 200)
                         self._respond(200, m["body"].encode(),
                                       m["content_type"])

@@ -1,0 +1,528 @@
+"""Fleet observability plane (ISSUE 14): metrics federation, cross-
+replica trace assembly, and SLO burn-rate monitoring.
+
+Covers the exposition merge helpers (parse → snapshot → merge → render
+round trip), the router's federated ``/metrics?scope=fleet`` surface
+with staleness markers, the 2-replica SUBPROCESS e2e (genuinely
+separate registries/journals: federated counters sum across replicas,
+the merged Perfetto trace spans a live migration with per-replica
+process lanes), the SLO tracker's state machine + the fault-injected
+``ok → burning`` flip with its flight dump, the FleetManager's
+park-on-burn placement hook, and ``DecodePool.warmup_spec``'s
+no-cold-compile guarantee.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.fleet import SessionRouter
+from deeplearning4j_tpu.fleet.manager import FleetManager
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.monitor import slo as slo_mod
+from deeplearning4j_tpu.monitor.federation import MetricsFederation
+from deeplearning4j_tpu.monitor.slo import Objective, SloTracker
+from deeplearning4j_tpu.nn.conf import layers as L
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.serialization import write_model
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F = 4  # vocab == n_in so speculative self-feeding decode fits
+
+
+def _lstm(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+            .shape_bucketing(True).list()
+            .layer(L.GravesLSTM(n_in=F, n_out=10, activation="tanh"))
+            .layer(L.RnnOutputLayer(n_in=10, n_out=F, activation="softmax",
+                                    loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fleet_obs") / "lstm.zip")
+    write_model(_lstm(), path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def dense_path(tmp_path_factory):
+    conf = (NeuralNetConfiguration.builder().seed(9).learning_rate(0.01)
+            .shape_bucketing(True).list()
+            .layer(L.DenseLayer(n_in=F, n_out=16, activation="relu"))
+            .layer(L.OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                 loss="mcxent"))
+            .build())
+    path = str(tmp_path_factory.mktemp("fleet_obs_dense") / "dense.zip")
+    write_model(MultiLayerNetwork(conf).init(), path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Exposition merge helpers
+# ---------------------------------------------------------------------------
+TEXT_A = """# TYPE dl4j_t_reqs_total counter
+dl4j_t_reqs_total{model="m",tenant="acme"} 3
+dl4j_t_reqs_total{model="m",tenant="-"} 1
+# TYPE dl4j_t_depth gauge
+dl4j_t_depth 7
+# TYPE dl4j_t_lat histogram
+dl4j_t_lat_bucket{le="0.1"} 2
+dl4j_t_lat_bucket{le="1"} 5
+dl4j_t_lat_bucket{le="+Inf"} 6
+dl4j_t_lat_sum 4.2
+dl4j_t_lat_count 6
+"""
+TEXT_B = """# TYPE dl4j_t_reqs_total counter
+dl4j_t_reqs_total{model="m",tenant="acme"} 4
+# TYPE dl4j_t_depth gauge
+dl4j_t_depth 9
+# TYPE dl4j_t_lat histogram
+dl4j_t_lat_bucket{le="0.1"} 1
+dl4j_t_lat_bucket{le="0.5"} 1
+dl4j_t_lat_bucket{le="+Inf"} 2
+dl4j_t_lat_sum 1.1
+dl4j_t_lat_count 2
+"""
+
+
+def test_snapshot_from_parsed_round_trip():
+    snap = monitor.snapshot_from_parsed(monitor.parse_prometheus(TEXT_A))
+    c = {tuple(sorted(s["labels"].items())): s["value"]
+         for s in snap["dl4j_t_reqs_total"]["samples"]}
+    assert c[(("model", "m"), ("tenant", "acme"))] == 3.0
+    h = snap["dl4j_t_lat"]["samples"][0]
+    assert h["buckets"] == {"0.1": 2.0, "1": 5.0, "+Inf": 6.0}
+    assert h["count"] == 6.0 and abs(h["sum"] - 4.2) < 1e-9
+    # the rebuilt snapshot renders and re-parses cleanly
+    reparsed = monitor.parse_prometheus(monitor.render_prometheus(snap))
+    assert set(reparsed) == {"dl4j_t_reqs_total", "dl4j_t_depth",
+                             "dl4j_t_lat"}
+
+
+def test_merge_snapshots_semantics():
+    sources = {
+        "r0": monitor.snapshot_from_parsed(monitor.parse_prometheus(TEXT_A)),
+        "r1": monitor.snapshot_from_parsed(monitor.parse_prometheus(TEXT_B)),
+    }
+    merged = monitor.merge_snapshots(sources)
+    # counters sum per label set across replicas
+    c = {tuple(sorted(s["labels"].items())): s["value"]
+         for s in merged["dl4j_t_reqs_total"]["samples"]}
+    assert c[(("model", "m"), ("tenant", "acme"))] == 7.0
+    assert c[(("model", "m"), ("tenant", "-"))] == 1.0
+    # gauges keep one sample per replica under a replica label
+    g = {s["labels"]["replica"]: s["value"]
+         for s in merged["dl4j_t_depth"]["samples"]}
+    assert g == {"r0": 7.0, "r1": 9.0}
+    # histogram buckets sum cumulatively over the UNION le ladder:
+    # r0 has no 0.5 bucket — its count there is its 0.1 cumulative
+    h = merged["dl4j_t_lat"]["samples"][0]
+    assert h["buckets"] == {"0.1": 3.0, "0.5": 3.0, "1": 6.0, "+Inf": 8.0}
+    assert h["count"] == 8.0 and abs(h["sum"] - 5.3) < 1e-9
+    # the merged snapshot round-trips through the text parser
+    assert "dl4j_t_lat" in monitor.parse_prometheus(
+        monitor.render_prometheus(merged))
+    # a sample that already carries replica= keeps it (staleness gauges)
+    pre = {"dl4j_t_age": {"type": "gauge", "help": "", "label_names":
+           ["replica"], "samples": [{"labels": {"replica": "r9"},
+                                     "value": 5.0}]}}
+    merged2 = monitor.merge_snapshots({"router": pre})
+    assert merged2["dl4j_t_age"]["samples"][0]["labels"]["replica"] == "r9"
+
+
+def test_federation_keeps_stale_snapshot_and_marks_age():
+    fed = MetricsFederation()
+    assert fed.scrape({"r0": lambda: TEXT_A,
+                       "r1": lambda: TEXT_B}) == {"r0": True, "r1": True}
+
+    def dead():
+        raise OSError("connection refused")
+
+    assert fed.scrape({"r0": lambda: TEXT_A,
+                       "r1": dead}) == {"r0": True, "r1": False}
+    # the dead replica's last samples stay in the merge — visibly stale
+    merged = fed.merged(local_name="router")
+    c = sum(s["value"] for s in merged["dl4j_t_reqs_total"]["samples"])
+    assert c == 8.0
+    status = fed.status()
+    assert status["r1"]["ok"] is False
+    assert "connection refused" in status["r1"]["error"]
+    ages = {s["labels"]["replica"]
+            for s in merged["dl4j_federation_scrape_age_seconds"]["samples"]}
+    assert {"r0", "r1"} <= ages
+    errs = monitor.get_registry().get("dl4j_federation_scrapes_total")
+    bad = sum(s["value"] for s in errs.samples()
+              if s["labels"] == {"replica": "r1", "outcome": "error"})
+    assert bad >= 1
+
+
+# ---------------------------------------------------------------------------
+# Router surface: ?scope=fleet over real HTTP replicas
+# ---------------------------------------------------------------------------
+def test_router_fleet_scope_metrics_over_http(model_path):
+    eps = [DeepLearning4jEntryPoint(decode_slots=8, max_wait_ms=1.0)
+           for _ in range(2)]
+    servers = [Server(ep, port=0).start() for ep in eps]
+    router = SessionRouter()
+    try:
+        for i, s in enumerate(servers):
+            router.add_replica(f"r{i}", f"http://{s.host}:{s.port}")
+        sid = router.open_session(model_path)["session_id"]
+        x = np.random.default_rng(0).normal(size=(1, F)).astype(np.float32)
+        router.decode_step(sid, x.tolist(), tenant="acme")
+        body = router.metrics(scope="fleet")["body"]
+        parsed = monitor.parse_prometheus(body)   # round-trip clean
+        assert "dl4j_federation_scrape_age_seconds" in parsed
+        assert "dl4j_router_requests_total" in parsed
+        # gauges carry replica labels for every replica + the router
+        reps = {lbl["replica"] for _, lbl, _ in
+                parsed["dl4j_decode_slot_capacity"]["samples"]}
+        assert {"r0", "r1"} <= reps
+        # spec/decode counters keep model+tenant in the federated view
+        # (label parity satellite): the acme step is attributable
+        steps = [(lbl, v) for _, lbl, v in
+                 parsed["dl4j_decode_steps_total"]["samples"]
+                 if lbl.get("tenant") == "acme"]
+        assert steps and all("model" in lbl for lbl, _ in steps)
+        # JSON scope=fleet RPC form
+        snap = router.metrics(format="json", scope="fleet")
+        assert "dl4j_federation_scrapes_total" in snap
+        # a plain gateway rejects fleet scope (router-only surface)
+        with pytest.raises(ValueError):
+            eps[0].metrics(scope="fleet")
+        # staleness path: stop one replica, rescrape — error counted,
+        # last samples retained
+        servers[1].stop()
+        scraped = router.federation_scrape()
+        assert scraped["r0"] is True and scraped["r1"] is False
+        parsed2 = monitor.parse_prometheus(
+            router.metrics(scope="fleet")["body"])
+        reps2 = {lbl["replica"] for _, lbl, _ in
+                 parsed2["dl4j_decode_slot_capacity"]["samples"]}
+        assert "r1" in reps2   # stale, not vanished
+        assert router.federation.status()["r1"]["ok"] is False
+    finally:
+        router.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# 2-replica subprocess e2e: separate registries + journals for real
+# ---------------------------------------------------------------------------
+_SERVE = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from deeplearning4j_tpu.server import DeepLearning4jEntryPoint, Server
+s = Server(DeepLearning4jEntryPoint(decode_slots=8, max_wait_ms=1.0),
+           port=0).start()
+print(json.dumps({"port": s.port}), flush=True)
+sys.stdin.read()    # serve until the parent closes our stdin
+s.stop()
+"""
+
+
+def _spawn_replica():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen([sys.executable, "-c", _SERVE],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True, cwd=REPO,
+                         env=env)
+    line = p.stdout.readline()
+    if not line:
+        err = p.stderr.read()
+        raise RuntimeError(f"replica failed to start: {err[-2000:]}")
+    return p, json.loads(line)["port"]
+
+
+def test_two_replica_federation_and_trace_assembly(model_path):
+    """THE acceptance e2e: two real gateway PROCESSES (own registries,
+    own journals) behind the router — one federated /metrics whose
+    counters sum across the replicas, and one merged Perfetto trace in
+    which a live-migrated session's events appear in BOTH replica
+    lanes."""
+    procs = []
+    try:
+        procs = [_spawn_replica() for _ in range(2)]
+        router = SessionRouter()
+        for i, (_, port) in enumerate(procs):
+            router.add_replica(f"r{i}", f"http://127.0.0.1:{port}")
+        x = np.random.default_rng(1).normal(size=(4, F)).astype(np.float32)
+        sid = router.open_session(model_path)["session_id"]
+        router.decode_step(sid, x[0:1].tolist())
+        mig = router.migrate_session(sid)
+        assert mig["to"] != mig["from"]
+        router.decode_step(sid, x[1:2].tolist())
+
+        # -- federated metrics: counters sum across the replicas ------
+        router.federation_scrape()
+        per = router.federation.replica_snapshots()
+        def steps_of(snap):
+            fam = snap.get("dl4j_decode_steps_total") or {"samples": []}
+            return sum(s["value"] for s in fam["samples"])
+        r0, r1 = steps_of(per["r0"]), steps_of(per["r1"])
+        assert r0 >= 1 and r1 >= 1, (r0, r1)   # the stream ran on BOTH
+        merged = router.metrics(format="json", scope="fleet")
+        fleet_total = sum(
+            s["value"]
+            for s in merged["dl4j_decode_steps_total"]["samples"])
+        local_fam = monitor.get_registry().get("dl4j_decode_steps_total")
+        local = (sum(s["value"] for s in local_fam.samples())
+                 if local_fam else 0.0)
+        assert fleet_total == pytest.approx(r0 + r1 + local)
+        body = router.metrics(scope="fleet")["body"]
+        monitor.parse_prometheus(body)   # parser round-trip clean
+
+        # -- merged chrome trace: per-replica process lanes ------------
+        trace = router.trace_dump(format="chrome")["trace"]
+        evts = trace["traceEvents"]
+        lanes = {e["args"]["name"]: e["pid"] for e in evts
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert set(lanes) == {"router", "r0", "r1"}
+        real = [e for e in evts if e.get("ph") != "M"]
+        assert real and all(e["pid"] in lanes.values() for e in real)
+        assert all(isinstance(e.get("ts"), float) or
+                   isinstance(e.get("ts"), int) for e in real)
+        # the migrated session's events appear in BOTH replica lanes
+        sid_pids = {e["pid"] for e in real
+                    if e.get("args", {}).get("session_id") == sid}
+        assert {lanes["r0"], lanes["r1"]} <= sid_pids, (sid_pids, lanes)
+        # one request ID spans the router lane AND a replica lane
+        # (the X-DL4J-Request-ID hop): collect per-lane request IDs
+        rids_by_pid = {}
+        for e in real:
+            rid = e.get("args", {}).get("request_id")
+            if rid:
+                rids_by_pid.setdefault(e["pid"], set()).add(rid)
+        cross = (rids_by_pid.get(lanes["router"], set())
+                 & (rids_by_pid.get(lanes["r0"], set())
+                    | rids_by_pid.get(lanes["r1"], set())))
+        assert cross, rids_by_pid
+        # events form carries the process tag and is time-sorted
+        te = router.trace_dump(format="events", last_n=2048)
+        assert {"router", "r0", "r1"} <= {e["process"] for e in
+                                          te["events"]}
+        ts = [e.get("ts", 0.0) for e in te["events"]]
+        assert ts == sorted(ts)
+    finally:
+        for p, _ in procs:
+            try:
+                p.stdin.close()
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+def _avail_text(good, bad):
+    return (f"# TYPE dl4j_t_good_total counter\ndl4j_t_good_total {good}\n"
+            f"# TYPE dl4j_t_bad_total counter\ndl4j_t_bad_total {bad}\n")
+
+
+def _avail_snap(good, bad):
+    return monitor.snapshot_from_parsed(
+        monitor.parse_prometheus(_avail_text(good, bad)))
+
+
+def test_slo_state_machine_and_budget():
+    obj = Objective("avail", "availability", 0.99,
+                    good_family="dl4j_t_good_total",
+                    bad_family="dl4j_t_bad_total",
+                    fast_window_s=2.0, slow_window_s=10.0)
+    tr = SloTracker([obj], flight_dump=False)
+    t0 = 1000.0
+    out = tr.evaluate(_avail_snap(100, 0), now=t0)
+    assert out["avail"]["-"]["state"] == "ok"
+    # 3% bad over the next second: burn 3.0 >= warn 2.0, < 14.4
+    out = tr.evaluate(_avail_snap(197, 3), now=t0 + 1)
+    assert out["avail"]["-"]["state"] == "warning"
+    # all-bad second: fast burn 100 -> burning; budget blown
+    out = tr.evaluate(_avail_snap(197, 103), now=t0 + 2)
+    s = out["avail"]["-"]
+    assert s["state"] == "burning" and s["burn_fast"] > 14.4
+    assert s["budget_remaining"] < 0
+    # quiet stretch pushes the bad interval out of the fast window;
+    # the slow window still remembers -> warning, then ok
+    out = tr.evaluate(_avail_snap(1197, 103), now=t0 + 6)
+    assert out["avail"]["-"]["state"] == "warning"
+    out = tr.evaluate(_avail_snap(10197, 103), now=t0 + 30)
+    assert out["avail"]["-"]["state"] == "ok"
+    # every flip journaled
+    flips = [(e["old"], e["new"]) for e in events.get_journal().tail(
+        etype="slo.state_changed") if e.get("objective") == "avail"]
+    assert ("warning", "burning") in flips and ("burning", "warning") \
+        in flips
+    # gauges metered
+    fam = monitor.get_registry().get("dl4j_slo_state")
+    vals = {s["labels"]["series"]: s["value"] for s in fam.samples()
+            if s["labels"]["objective"] == "avail"}
+    assert vals["-"] == 0
+
+
+def test_slo_latency_objective_per_model_series():
+    text = """# TYPE dl4j_t_lat2 histogram
+dl4j_t_lat2_bucket{model="a",le="0.1"} 9
+dl4j_t_lat2_bucket{model="a",le="+Inf"} 10
+dl4j_t_lat2_sum{model="a"} 1
+dl4j_t_lat2_count{model="a"} 10
+dl4j_t_lat2_bucket{model="b",le="0.1"} 1
+dl4j_t_lat2_bucket{model="b",le="+Inf"} 10
+dl4j_t_lat2_sum{model="b"} 9
+dl4j_t_lat2_count{model="b"} 10
+"""
+    snap = monitor.snapshot_from_parsed(monitor.parse_prometheus(text))
+    obj = Objective("lat", "latency", 0.5, family="dl4j_t_lat2",
+                    threshold_s=0.1)
+    series = obj.series(snap)
+    assert series == {"model=a": (1.0, 10.0), "model=b": (9.0, 10.0)}
+
+
+def test_slo_flips_burning_under_latency_fault(dense_path, tmp_path,
+                                               monkeypatch):
+    """The acceptance flip: a fault-injected latency plan
+    (resilience/faults.py) drags predicts past the objective threshold
+    — the tracker flips ok → burning and writes the slo_fast_burn
+    flight dump."""
+    monkeypatch.setenv("DL4J_FLIGHT_DIR", str(tmp_path / "flight"))
+    obj = Objective("predict_fast", "latency", 0.99,
+                    family="dl4j_serving_total_seconds", threshold_s=0.05,
+                    fast_window_s=30.0, slow_window_s=120.0)
+    tr = SloTracker([obj])
+    ep = DeepLearning4jEntryPoint(max_batch=8, max_wait_ms=1.0)
+    try:
+        x = np.random.default_rng(2).normal(size=(1, F)).astype(np.float32)
+        ep.predict(dense_path, features=x.tolist())   # warm off-clock
+        t0 = time.time()
+        tr.evaluate(now=t0)
+        faults.arm({"site": "batcher.compute", "mode": "latency",
+                    "latency_ms": 120, "probability": 1.0})
+        try:
+            for _ in range(4):
+                ep.predict(dense_path, features=x.tolist())
+        finally:
+            faults.disarm("batcher.compute")
+        out = tr.evaluate(now=t0 + 1.0)
+        key = [k for k in out["predict_fast"] if "dense.zip" in k]
+        assert key, out
+        s = out["predict_fast"][key[0]]
+        assert s["state"] == "burning", s
+        dumps = list((tmp_path / "flight").glob("flight_slo_fast_burn*"))
+        assert dumps, list((tmp_path / "flight").glob("*"))
+        payload = json.loads(dumps[0].read_text())
+        assert payload["extra"]["objective"]["name"] == "predict_fast"
+        flips = [e for e in events.get_journal().tail(
+            etype="slo.state_changed")
+            if e.get("objective") == "predict_fast"]
+        assert flips and flips[-1]["new"] == "burning"
+    finally:
+        ep.close()
+
+
+def test_slo_kill_switch_and_gateway_attachment(dense_path):
+    ep = DeepLearning4jEntryPoint(slo=True, slo_interval_s=30.0)
+    try:
+        assert ep.slo is not None
+        slo_mod.set_enabled(False)
+        try:
+            assert ep.slo.evaluate() == {}
+        finally:
+            slo_mod.set_enabled(None)
+        x = np.random.default_rng(3).normal(size=(1, F)).astype(np.float32)
+        ep.predict(dense_path, features=x.tolist())
+        ep.slo.evaluate()
+        assert "slo" in ep.stats()
+        fam = monitor.get_registry().get("dl4j_slo_state")
+        assert fam is not None and fam.samples()
+    finally:
+        ep.close()
+
+
+def test_fleet_manager_slo_park_and_recover():
+    """A replica whose own availability burns while the fleet-wide
+    objective stays healthy is parked off the ring, and re-ringed when
+    its objective recovers."""
+    router = SessionRouter()
+    for name in ("r0", "r1"):
+        router.add_replica(name, "http://127.0.0.1:1")
+    obj = Objective("avail_park", "availability", 0.99,
+                    good_family="dl4j_t_park_good_total",
+                    bad_family="dl4j_t_park_bad_total",
+                    fast_window_s=2.0, slow_window_s=10.0)
+    mgr = FleetManager(router, slo_objectives=[obj],
+                       park_on_slo_burn=True)
+
+    def texts(g0, b0, g1, b1):
+        def mk(g, b):
+            return (f"# TYPE dl4j_t_park_good_total counter\n"
+                    f"dl4j_t_park_good_total {g}\n"
+                    f"# TYPE dl4j_t_park_bad_total counter\n"
+                    f"dl4j_t_park_bad_total {b}\n")
+        return {"r0": (lambda t=mk(g0, b0): t),
+                "r1": (lambda t=mk(g1, b1): t)}
+
+    t0 = 2000.0
+    router.federation.scrape(texts(100, 0, 100000, 0))
+    mgr.evaluate_slo(now=t0)
+    assert router.stats()["replicas"]["r0"]["placeable"] is True
+    # r0 goes all-bad; r1 (and therefore the fleet) stays healthy
+    router.federation.scrape(texts(100, 100, 200000, 0))
+    mgr.evaluate_slo(now=t0 + 1)
+    stats = router.stats()["replicas"]
+    assert stats["r0"]["placeable"] is False
+    assert stats["r1"]["placeable"] is True
+    parked = [e for e in events.get_journal().tail(
+        etype="slo.replica_parked") if e.get("replica") == "r0"]
+    assert parked and parked[-1]["parked"] is True
+    # recovery: bad interval leaves the fast window -> unparked
+    router.federation.scrape(texts(200, 100, 300000, 0))
+    mgr.evaluate_slo(now=t0 + 6)
+    assert router.stats()["replicas"]["r0"]["placeable"] is True
+    parked = [e for e in events.get_journal().tail(
+        etype="slo.replica_parked") if e.get("replica") == "r0"]
+    assert parked[-1]["parked"] is False
+
+
+# ---------------------------------------------------------------------------
+# DecodePool.warmup_spec (satellite: ROADMAP item 2 leftover)
+# ---------------------------------------------------------------------------
+def test_warmup_spec_eliminates_cold_compiles(model_path):
+    ep = DeepLearning4jEntryPoint(decode_slots=8, max_wait_ms=1.0)
+    try:
+        r = ep.warmup(model_path, (8, F), spec_k=4)
+        assert r["spec"]["k"] == 4
+        assert r["spec"]["chunks"][-1] == 5   # pending + 4 drafts
+        model = ep.model_cache.peek(model_path)
+        before = model.compile_telemetry.snapshot()["by_kind"].get(
+            "spec_step", 0)
+        assert before >= 1
+        x = np.random.default_rng(4).normal(size=(4, F)).astype(np.float32)
+        sid = ep.open_session(model_path)["session_id"]
+        ep.decode_step(sid, x[:1].tolist())
+        out = ep.decode_step(sid, x[0:1].tolist(),
+                             spec={"tokens": 6, "k": 4})
+        assert len(out["spec"]["tokens"]) == 6
+        after = model.compile_telemetry.snapshot()["by_kind"].get(
+            "spec_step", 0)
+        assert after == before, (before, after)
+    finally:
+        ep.close()
