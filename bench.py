@@ -1819,6 +1819,167 @@ def bench_fleet():
     }
 
 
+def bench_elastic():
+    """Elastic-cluster training A/B (ROADMAP 1 → distributed/): the
+    SAME model+stream trained single-host vs as a 2-worker
+    coordinator-backed cluster (in-process worker threads — real
+    barrier, real membership protocol, localhost-free transport), plus
+    the preemption headline: TIME-TO-RECOVER from a fault-injected
+    worker kill (``dist.worker``), measured as the survivor's wall time
+    for the step that spans detection (lease+grace lapse) → generation
+    roll → reshard → first post-resize commit.  On a 1-core CPU the
+    workers share the core so steady-state mostly measures barrier
+    overhead; on real multi-host hardware the cluster leg is the
+    horizontal-scale headline."""
+    import threading
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.distributed import Coordinator, DistSession
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.resilience import faults as faults_mod
+
+    ROWS, FEAT, HID, CLASSES = 64, 32, 96, 8
+    STEPS = 8
+    LEASE_MS = 250.0
+
+    def make_net(dist):
+        b = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.01)
+             .updater("adam"))
+        if dist:
+            b.distributed(processes=2, heartbeat_ms=50, lease_ms=LEASE_MS)
+        conf = (b.list()
+                .layer(L.DenseLayer(n_in=FEAT, n_out=HID,
+                                    activation="relu"))
+                .layer(L.OutputLayer(n_out=CLASSES, activation="softmax",
+                                     loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(17)
+
+    def batches(n):
+        return [DataSet(
+            rng.normal(size=(ROWS, FEAT)).astype(np.float32),
+            np.eye(CLASSES, dtype=np.float32)[
+                rng.integers(0, CLASSES, ROWS)]) for _ in range(n)]
+
+    window_sets = [batches(STEPS) for _ in range(WINDOWS)]
+
+    # -- leg 1: single host -------------------------------------------
+    net = make_net(dist=False)
+    net.fit(ListDataSetIterator(batches(2)))   # compile, off-clock
+    single_times = []
+    for ws in window_sets:
+        t0 = time.perf_counter()
+        net.fit(ListDataSetIterator(list(ws)))
+        single_times.append(time.perf_counter() - t0)
+    single = window_stats(single_times, ROWS, STEPS)
+
+    # -- leg 2: 2-worker cluster steady state -------------------------
+    faults_mod.reset()
+    co = Coordinator(expected=2, lease_ms=LEASE_MS)
+    cluster_times = []
+    errors = []
+
+    def steady_worker(wid):
+        try:
+            wnet = make_net(dist=True)
+            sess = DistSession(co, wid, heartbeat_ms=50)
+            sess.connect()
+            wnet._dist_session = sess
+            wnet.fit(ListDataSetIterator(batches(2)))   # warm
+            for ws in window_sets:
+                t0 = time.perf_counter()
+                wnet.fit(ListDataSetIterator(list(ws)))
+                if wid == "w0":
+                    cluster_times.append(time.perf_counter() - t0)
+            sess.close()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(f"{wid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=steady_worker, args=(f"w{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    assert not errors, errors
+    cluster = window_stats(cluster_times, ROWS, STEPS)
+
+    # -- leg 3: time-to-recover from a killed worker ------------------
+    faults_mod.reset()
+    co2 = Coordinator(expected=2, lease_ms=LEASE_MS,
+                      suspect_grace_ms=LEASE_MS)
+    step_times = {}
+    KILL_AT = 6
+
+    class _StepClock:
+        def __init__(self):
+            self.marks = []
+            self.last = time.perf_counter()
+
+        def iteration_done(self, model, iteration):
+            now = time.perf_counter()
+            self.marks.append((iteration, now - self.last))
+            self.last = now
+
+    def chaos_worker(wid):
+        try:
+            wnet = make_net(dist=True)
+            clock = _StepClock()
+            wnet.add_listener(clock)
+            sess = DistSession(co2, wid, heartbeat_ms=50)
+            sess.connect()
+            wnet._dist_session = sess
+            wnet.fit(ListDataSetIterator(batches(16)))
+            step_times[wid] = clock.marks
+            sess.close()
+        except BaseException:  # noqa: BLE001 — the preempted worker
+            step_times.setdefault("killed", []).append(wid)
+
+    faults_mod.arm({"site": "dist.worker", "mode": "kill",
+                    "on_call": 2 * KILL_AT, "max_injections": 1})
+    threads = [threading.Thread(target=chaos_worker, args=(f"c{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    faults_mod.reset()
+    survivor = [w for w in ("c0", "c1") if w in step_times]
+    assert survivor and step_times.get("killed"), step_times
+    marks = step_times[survivor[0]]
+    # the recovery step is the one that waited out the dead lease and
+    # recomputed under the shrunk generation: the max post-warmup step
+    post = [dt for it, dt in marks if it > 2]
+    steady_ms = statistics.median(post) * 1e3
+    recover_s = max(post)
+
+    overhead = (cluster["step_time_ms_median"]
+                / max(single["step_time_ms_median"], 1e-9))
+    return {
+        "metric": "elastic 2-worker cluster examples/sec (steady "
+                  "state) + time-to-recover from a worker kill",
+        "value": round(cluster["items_per_sec_median"], 1),
+        "unit": "examples/sec",
+        "single_host": single,
+        "cluster_2w": cluster,
+        "barrier_overhead_x": round(overhead, 3),
+        "recover_from_kill_s": round(recover_s, 3),
+        "recovery_vs_steady_step_ms": [round(recover_s * 1e3, 1),
+                                       round(steady_ms, 1)],
+        "lease_ms": LEASE_MS,
+        "generations": co2.status()["generation"],
+        **{k: v for k, v in cluster.items()
+           if k.startswith("items_per_sec") or k in (
+               "window_rel_spread", "best_of", "window_sec",
+               "steps_per_window")},
+    }
+
+
 def bench_sharded_serving(n_chips):
     """Sharded-inference A/B (ROADMAP 3a): the same wide-MLP ``output()``
     replica-style vs under ``conf.sharding(data=1, fsdp=n_chips)`` — the
@@ -2160,6 +2321,7 @@ def _run_configs(result):
         ("bench_decode", bench_decode),
         ("bench_spec", bench_spec),
         ("bench_fleet", bench_fleet),
+        ("bench_elastic", bench_elastic),
         ("bench_resilience", bench_resilience),
         ("bench_sharded", lambda: bench_sharded(n_chips, peak)),
         ("bench_sharded_serving", lambda: bench_sharded_serving(n_chips)),
@@ -2192,7 +2354,7 @@ def _run_configs(result):
         order = ["lenet", "lenet_etl", "lenet_f32", "bench_ragged",
                  "bench_kernels", "bench_pipeline", "bench_serving",
                  "bench_decode", "bench_spec", "bench_fleet",
-                 "bench_resilience",
+                 "bench_elastic", "bench_resilience",
                  "bench_sharded", "bench_sharded_serving", "charrnn",
                  "word2vec", "vgg16", "resnet50"]
         config_list.sort(key=lambda nv: order.index(nv[0])

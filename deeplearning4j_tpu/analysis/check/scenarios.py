@@ -53,6 +53,8 @@ def warm() -> None:
     pool.stop(timeout=5.0)
     from deeplearning4j_tpu.server.batcher import MicroBatcher
     MicroBatcher(lambda x: x, name="chk-warm").stop(timeout=5.0)
+    from deeplearning4j_tpu.distributed.coordinator import Coordinator
+    Coordinator(expected=0)   # registers the dl4j_dist_* families
     _WARM["done"] = True
 
 
@@ -553,6 +555,167 @@ def scenario_breaker(ctx: Context) -> None:
     assert br.state == CircuitBreaker.CLOSED
 
 
+def scenario_dist_membership(ctx: Context) -> None:
+    """The REAL elastic-cluster Coordinator (distributed/coordinator.py)
+    driven through a preemption story under every interleaving: two
+    workers form generation 1 and train; one dies mid-run (stops
+    heartbeating) RACING the survivor's in-flight barrier — the
+    lease/generation machinery must roll and release the waiter, never
+    strand it; the dead worker then rejoins (breaker gate), resyncs
+    from the survivor's snapshot, and is absorbed.  Checked: every
+    barrier call returns (no stranded waiter), each committed step
+    reduces under exactly ONE generation (no two live generations — the
+    :class:`specs.WorkerLifecycleSpec` additionally pins generation
+    monotonicity and the joined→active→suspect→dead|rejoined machine on
+    the ``dist.*`` events the coordinator emits)."""
+    from deeplearning4j_tpu.distributed.coordinator import Coordinator
+    faults.reset()
+    clk = {"t": 0.0}
+    co = Coordinator(expected=2, lease_ms=1000.0, suspect_grace_ms=500.0,
+                     allreduce_timeout_s=5.0,
+                     breaker={"min_calls": 2, "cooldown_s": 0.0},
+                     clock=lambda: clk["t"])
+    N = 4
+    committed: Dict[int, int] = {}   # step -> generation it reduced under
+    errors = []
+    done = {"wa": False, "wb": False}
+
+    def record(resp) -> None:
+        step, gen = resp["step"], resp["generation"]
+        prev = committed.get(step)
+        if prev is not None and prev != gen:
+            errors.append(f"step {step} committed under two live "
+                          f"generations: {prev} and {gen}")
+        committed[step] = gen
+
+    def run_steps(wid: str, start_step: int) -> None:
+        """Drive the worker protocol loop: contribute each next step,
+        riding out rolls/fences, answering snapshot-upload requests."""
+        step = start_step
+        for _try in range(500):
+            if step >= N:
+                return
+            place = co.placement(wid)
+            if place.get("generation", 0) < 1:
+                # cluster still forming: no data plane yet (mirrors
+                # DistSession.placement_tuple's wait)
+                time.sleep(0.001)
+                continue
+            if place.get("state") == "dead":
+                if not co.join(wid)["admitted"]:
+                    time.sleep(0.001)
+                    continue
+                place = co.placement(wid)
+            if place.get("state") == "joined":
+                # resync: poll the snapshot (activation rides on it)
+                snap = co.get_snapshot(wid, min_step=0)
+                if snap is None:
+                    time.sleep(0.001)
+                    continue
+                step = snap["step"]
+                continue
+            resp = co.allreduce(wid, place["generation"], step + 1, 1.0,
+                                np.ones(1, np.float32))
+            if resp.get("evicted") or resp.get("rolled") \
+                    or resp.get("timeout"):
+                continue
+            if resp.get("stale_step"):
+                step = int(resp["committed"])
+                continue
+            record(resp)
+            step += 1
+            if resp.get("upload_state"):
+                co.put_snapshot(wid, step,
+                                np.zeros(2, np.float32), None,
+                                {"epoch": 0, "iteration_in_epoch": step})
+        errors.append(f"{wid}: protocol loop never converged")
+
+    def wa():
+        assert co.join("wa")["admitted"]
+        co.sync_done("wa")
+        co.heartbeat("wa")
+        run_steps("wa", 0)
+        # final state relay so a late rejoiner always absorbs
+        co.put_snapshot("wa", co.status()["step"],
+                        np.zeros(2, np.float32), None,
+                        {"epoch": 0, "iteration_in_epoch": N})
+        done["wa"] = True
+
+    def wb():
+        assert co.join("wb")["admitted"]
+        co.sync_done("wb")
+        for _try in range(50):    # contribute step 1, riding out rolls
+            place = co.placement("wb")
+            if place["generation"] < 1:
+                time.sleep(0.001)
+                continue
+            resp = co.allreduce("wb", place["generation"], 1, 1.0,
+                                np.ones(1, np.float32))
+            if "vec" in resp:
+                record(resp)
+                break
+            if resp.get("stale_step") or resp.get("evicted"):
+                break
+        # ... and dies: no heartbeats, no more contributions (first
+        # incarnation).  The rejoin incarnation:
+        for _try in range(200):
+            if done["wa"] and co.status()["step"] >= N:
+                break
+            if co.placement("wb").get("state") == "dead":
+                break
+            time.sleep(0.001)
+        joined = co.join("wb")
+        if joined["admitted"]:
+            co.heartbeat("wb")
+            run_steps("wb", int(joined.get("step", 0)))
+        done["wb"] = True
+
+    def reaper():
+        # the cluster's clock: advance leases, keep the live workers'
+        # leases fresh, sweep — death detection races the barrier here
+        for _i in range(400):
+            if done["wa"] and done["wb"]:
+                return
+            clk["t"] += 0.4
+            for wid in ("wa", "wb"):
+                st = co.placement(wid).get("state")
+                if st in ("active", "suspect", "joined") \
+                        and not _is_dead_phase(wid):
+                    co.heartbeat(wid)
+            time.sleep(0.001)
+        errors.append("reaper budget exhausted before both workers "
+                      "finished")
+
+    dead_phase = {"wb": False}
+
+    def _is_dead_phase(wid: str) -> bool:
+        # wb's first incarnation stops heartbeating after its step-1
+        # contribution: the reaper must NOT keep its lease alive.  The
+        # phase flips when wb is declared dead (rejoin path re-enables).
+        if wid != "wb":
+            return False
+        if co.status()["step"] >= 1 and not dead_phase["wb"]:
+            st = co.placement("wb").get("state")
+            if st == "dead":
+                dead_phase["wb"] = True
+                return False
+            return True
+        return False
+
+    t1 = ctx.thread("dist-wa", wa)
+    t2 = ctx.thread("dist-wb", wb)
+    t3 = ctx.thread("dist-reaper", reaper)
+    t1.join(300.0)
+    t2.join(300.0)
+    t3.join(300.0)
+    assert not errors, errors
+    assert done["wa"] and done["wb"], (done, co.status())
+    assert set(committed) == set(range(1, N + 1)), committed
+    gens = [committed[s] for s in sorted(committed)]
+    assert gens == sorted(gens), f"generations regressed: {gens}"
+    assert co.status()["step"] >= N
+
+
 # ----------------------------------------------------------------------
 # Positive controls: the checker MUST catch these
 # ----------------------------------------------------------------------
@@ -629,6 +792,7 @@ SCENARIOS: Dict[str, Callable[[Context], None]] = {
     "decode_death": scenario_decode_death,
     "drain": scenario_drain,
     "breaker": scenario_breaker,
+    "dist_membership": scenario_dist_membership,
     "double_claim": scenario_double_claim,
     "deadlock": scenario_deadlock,
     "leaked_future": scenario_leaked_future,
@@ -637,4 +801,5 @@ SCENARIOS: Dict[str, Callable[[Context], None]] = {
 #: the scenarios a default checker run gates on (positive controls are
 #: excluded — they exist to prove the checker catches bugs)
 DEFAULT_SCENARIOS = ("migration", "migration_kill", "kv_migration",
-                     "batcher_death", "decode_death", "drain", "breaker")
+                     "batcher_death", "decode_death", "drain", "breaker",
+                     "dist_membership")

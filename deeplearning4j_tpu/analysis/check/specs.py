@@ -149,6 +149,69 @@ class SessionLifecycleSpec:
             self._state[key] = "closed"
 
 
+#: legal elastic-worker lifecycle transitions (distributed/coordinator)
+#: — joined → active → suspect → dead | rejoined; None is pre-join.
+#: A dead worker re-enters only through a fresh join (the breaker
+#: gate); there is no resurrection edge dead → active.
+WORKER_LEGAL = (
+    (None, "joined"),
+    ("dead", "joined"),          # rejoin after eviction
+    ("joined", "active"),
+    ("suspect", "active"),       # heartbeat recovery
+    ("active", "suspect"),
+    ("joined", "suspect"),       # a syncing worker can lapse too
+    ("suspect", "dead"),
+    ("active", "dead"),          # graceful leave / zombie replacement
+    ("joined", "dead"),
+)
+
+
+class WorkerLifecycleSpec:
+    """Elastic-runtime worker lifecycle over the ``dist.*`` journal
+    events, plus generation monotonicity: ``dist.generation_rolled``
+    must carry strictly increasing generation numbers — two live
+    generations (or a rollback) is the split-brain the fencing
+    protocol exists to prevent."""
+
+    name = "dist-worker-lifecycle"
+
+    def __init__(self, sched):
+        self._sched = sched
+        self._state: Dict[str, str] = {}
+        self._generation: Optional[int] = None
+
+    def _fail(self, msg: str) -> None:
+        self._sched.violation("spec", f"[{self.name}] {msg}")
+
+    _EDGE = {"dist.worker_joined": "joined",
+             "dist.worker_active": "active",
+             "dist.worker_suspect": "suspect",
+             "dist.worker_dead": "dead"}
+
+    def on_event(self, etype: str, fields: dict) -> None:
+        if etype == "dist.generation_rolled":
+            gen = fields.get("generation")
+            if self._generation is not None and gen is not None \
+                    and gen <= self._generation:
+                self._fail(f"generation rolled {self._generation} -> "
+                           f"{gen} (must be strictly increasing — two "
+                           "live generations)")
+            if gen is not None:
+                self._generation = gen
+            return
+        to = self._EDGE.get(etype)
+        if to is None:
+            return
+        worker = fields.get("worker") or "-"
+        frm = self._state.get(worker)
+        if (frm, to) not in WORKER_LEGAL and frm != to:
+            legal = ", ".join(f"{a or '(new)'}->{b}"
+                              for a, b in WORKER_LEGAL)
+            self._fail(f"worker {worker!r} transitioned {frm} -> {to} "
+                       f"(legal: {legal})")
+        self._state[worker] = to
+
+
 class BreakerSpec:
     """CircuitBreaker legality over ``breaker.transition`` events."""
 
@@ -178,7 +241,8 @@ class SpecMonitor:
     def __init__(self, sched, specs=None):
         self.sched = sched
         self.specs = list(specs) if specs is not None else [
-            SessionLifecycleSpec(sched), BreakerSpec(sched)]
+            SessionLifecycleSpec(sched), BreakerSpec(sched),
+            WorkerLifecycleSpec(sched)]
 
     def on_event(self, etype: str, severity: str, fields: dict) -> None:
         for spec in self.specs:

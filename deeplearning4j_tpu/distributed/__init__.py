@@ -1,0 +1,29 @@
+"""Elastic multi-host distributed runtime (docs/DISTRIBUTED.md).
+
+The cluster tier the reference ran on Spark (``TrainingMaster``,
+ref: spark/impl/paramavg/ParameterAveragingTrainingMaster.java),
+rebuilt preemption-tolerant:
+
+* :mod:`~deeplearning4j_tpu.distributed.coordinator` — membership
+  registry, heartbeat leases, generation-numbered cluster epochs, the
+  per-step barrier + weighted gradient all-reduce, and the in-memory
+  state-snapshot relay that absorbs returning workers;
+* :mod:`~deeplearning4j_tpu.distributed.worker` — the per-process
+  :class:`DistSession` and the distributed step the engines' fit loops
+  route through under ``conf.distributed(processes=N)``;
+* :mod:`~deeplearning4j_tpu.distributed.launch` — coordinator + N
+  supervised worker processes with automatic respawn;
+* :mod:`~deeplearning4j_tpu.distributed.rpc` — the HTTP wire (gateway
+  JSON-RPC shape, base64-npy vectors).
+"""
+
+from deeplearning4j_tpu.distributed.coordinator import (  # noqa: F401
+    Coordinator)
+from deeplearning4j_tpu.distributed.launch import (  # noqa: F401
+    launch_cluster)
+from deeplearning4j_tpu.distributed.rpc import (  # noqa: F401
+    CoordinatorClient, CoordinatorServer)
+from deeplearning4j_tpu.distributed.worker import (  # noqa: F401
+    ClusterFormationError, DistSession, GenerationRolled,
+    WorkerEvictedError, active_session, install_session, maybe_session,
+    shard_bounds, shutdown_session)

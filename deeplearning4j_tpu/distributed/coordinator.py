@@ -1,0 +1,536 @@
+"""Elastic cluster coordinator — membership, leases, generation-numbered
+cluster epochs, and the step barrier/all-reduce of the coordinator data
+plane (docs/DISTRIBUTED.md).
+
+The modern equivalent of the reference's Spark ``TrainingMaster`` driver
+(ref: spark/impl/paramavg/ParameterAveragingTrainingMaster.java): workers
+register here, renew a **lease** by heartbeating, and drive training
+through a per-step **barrier + weighted all-reduce** of their gradient
+contributions.  Membership is versioned by a **generation** number: every
+visible membership change (a worker dying, a worker being absorbed) rolls
+the cluster to a new generation with freshly assigned ranks, and every
+data-plane call is *fenced* by the generation it was made under — a stale
+worker's step is rejected, never silently merged (arXiv 2112.01075's
+redistribution model: state moves at epoch boundaries, the collective
+itself is portable across cluster shapes).
+
+Worker lifecycle (the dl4j-check spec machine,
+``analysis/check/specs.WorkerLifecycleSpec``)::
+
+    (join) -> joined -> active -> suspect -> dead
+                ^         ^---------'          |
+                '------- rejoin --------------'
+
+* ``joined``  — admitted, syncing state (not counted in the barrier);
+* ``active``  — barrier-participating member of the current generation;
+* ``suspect`` — lease expired (missed heartbeats); recovers to active on
+  the next heartbeat, or
+* ``dead``    — suspect past the grace window: evicted, breaker charged,
+  generation rolled so the survivors continue without it.
+
+Re-admission goes through a per-worker :class:`CircuitBreaker` — a
+flapping worker (repeated quick deaths) is refused with a retry-after
+instead of thrashing the cluster with generation rolls.
+
+The class is transport-agnostic and thread-safe (one condition variable;
+timed waits + an injectable ``clock`` keep it deterministic under the
+dl4j-check harness).  ``distributed/rpc.py`` serves it over HTTP.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.monitor.registry import get_registry
+from deeplearning4j_tpu.resilience.errors import CircuitOpenError
+from deeplearning4j_tpu.resilience.policy import CircuitBreaker
+
+JOINED, ACTIVE, SUSPECT, DEAD = "joined", "active", "suspect", "dead"
+
+
+class Member:
+    """One registered worker: identity, lifecycle state, lease."""
+
+    __slots__ = ("id", "state", "lease_deadline", "join_seq", "rank",
+                 "restarts")
+
+    def __init__(self, worker_id: str, join_seq: int, lease_deadline: float):
+        self.id = worker_id
+        self.state = JOINED
+        self.lease_deadline = lease_deadline
+        self.join_seq = join_seq
+        self.rank = -1
+        self.restarts = 0
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "state": self.state, "rank": self.rank,
+                "restarts": self.restarts}
+
+
+class Coordinator:
+    """Membership registry + generation epochs + the step all-reduce.
+
+    ``expected`` gates INITIAL formation only: generation 1 is rolled
+    once that many workers have joined and activated (an elastic resize
+    later never waits for a count).  ``lease_ms`` is the heartbeat
+    lease; a member whose lease lapses turns ``suspect`` and, after
+    ``suspect_grace_ms`` more, ``dead`` — which rolls the generation so
+    the survivors' next barrier completes without it.  ``clock`` is
+    injectable (tests, the dl4j-check scenario) so liveness decisions
+    are a pure function of the driven time."""
+
+    def __init__(self, expected: int = 0, lease_ms: float = 2000.0,
+                 suspect_grace_ms: Optional[float] = None,
+                 allreduce_timeout_s: float = 120.0,
+                 breaker: Optional[dict] = None,
+                 clock=time.monotonic):
+        self.expected = max(0, int(expected))
+        self.lease_s = max(0.01, float(lease_ms) / 1e3)
+        self.suspect_grace_s = (self.lease_s if suspect_grace_ms is None
+                                else max(0.0, float(suspect_grace_ms) / 1e3))
+        self.allreduce_timeout_s = float(allreduce_timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._members: Dict[str, Member] = {}
+        self._join_seq = 0
+        self.generation = 0
+        self.step = 0                      # last COMMITTED global step
+        #: in-flight contributions for step ``self.step + 1`` of the
+        #: current generation: worker_id -> (weight, float64 vector)
+        self._contrib: Dict[str, tuple] = {}
+        #: completed reductions: step -> {"vec", "weight", "generation"}
+        self._done: Dict[int, dict] = {}
+        self._snapshot: Optional[dict] = None
+        self._snapshot_wanted = False
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._breaker_conf = dict(breaker or {})
+        self._breaker_conf.setdefault("failure_threshold", 0.5)
+        self._breaker_conf.setdefault("window", 4)
+        self._breaker_conf.setdefault("min_calls", 2)
+        self._breaker_conf.setdefault("cooldown_s", 2.0)
+        self.closed = False
+        reg = get_registry()
+        self._g_generation = reg.gauge(
+            "dl4j_dist_generation",
+            "current cluster generation (bumped on every membership "
+            "change)")
+        self._g_members = reg.gauge(
+            "dl4j_dist_members", "cluster members by lifecycle state",
+            labels=("state",))
+        self._c_rolls = reg.counter(
+            "dl4j_dist_generation_rolls_total",
+            "generation rolls by trigger", labels=("reason",))
+        self._c_allreduce = reg.counter(
+            "dl4j_dist_allreduce_total",
+            "step all-reduce calls by outcome (ok / rolled / fenced)",
+            labels=("outcome",))
+        self._h_allreduce = reg.histogram(
+            "dl4j_dist_allreduce_seconds",
+            "barrier + reduce wall time per completed step")
+        self._c_evictions = reg.counter(
+            "dl4j_dist_evictions_total",
+            "workers declared dead after their lease and grace lapsed")
+        self._c_rejoins = reg.counter(
+            "dl4j_dist_rejoins_total",
+            "workers re-admitted after an earlier death/eviction")
+        self._c_snapshots = reg.counter(
+            "dl4j_dist_snapshot_transfers_total",
+            "in-memory state snapshots relayed to absorbing workers")
+        self._g_generation.set(0)
+
+    # ------------------------------------------------------------------
+    # Internals (call with the lock held)
+    # ------------------------------------------------------------------
+    def _breaker_for(self, worker_id: str) -> CircuitBreaker:
+        br = self._breakers.get(worker_id)
+        if br is None:
+            br = CircuitBreaker(name=f"dist-admit:{worker_id}",
+                                clock=self._clock, **self._breaker_conf)
+            self._breakers[worker_id] = br
+        return br
+
+    def _gauges_locked(self) -> None:
+        counts = {JOINED: 0, ACTIVE: 0, SUSPECT: 0}
+        for m in self._members.values():
+            counts[m.state] = counts.get(m.state, 0) + 1
+        for state, n in counts.items():
+            self._g_members.labels(state=state).set(n)
+        self._g_generation.set(self.generation)
+
+    def _active_locked(self) -> List[Member]:
+        out = [m for m in self._members.values()
+               if m.state in (ACTIVE, SUSPECT)]
+        out.sort(key=lambda m: m.join_seq)
+        return out
+
+    def _roll_locked(self, reason: str) -> None:
+        """Start a new generation: re-rank the live members, discard the
+        in-flight barrier (contributors will be told to recompute), and
+        wake every waiter."""
+        self.generation += 1
+        for rank, m in enumerate(self._active_locked()):
+            m.rank = rank
+        self._contrib.clear()
+        self._c_rolls.labels(reason=reason).inc()
+        self._gauges_locked()
+        events.emit("dist.generation_rolled", severity="warn",
+                    generation=self.generation, reason=reason,
+                    world=len(self._active_locked()))
+        self._cond.notify_all()
+
+    def _sweep_locked(self) -> None:
+        """Lease accounting: expired leases turn members suspect, and a
+        suspect past the grace window dies — charging its admission
+        breaker and rolling the generation."""
+        now = self._clock()
+        rolled = False
+        for m in list(self._members.values()):
+            if m.state in (ACTIVE, JOINED) and now > m.lease_deadline:
+                m.state = SUSPECT
+                events.emit("dist.worker_suspect", severity="warn",
+                            worker=m.id, generation=self.generation)
+            if (m.state == SUSPECT
+                    and now > m.lease_deadline + self.suspect_grace_s):
+                m.state = DEAD
+                del self._members[m.id]
+                self._breaker_for(m.id).record(False)
+                self._c_evictions.inc()
+                events.emit("dist.worker_dead", severity="error",
+                            worker=m.id, generation=self.generation)
+                rolled = True
+        if rolled:
+            self._roll_locked("worker_dead")
+        else:
+            self._gauges_locked()
+
+    def _placement_locked(self, worker_id: Optional[str] = None) -> dict:
+        active = self._active_locked()
+        out = {"generation": self.generation, "world": len(active),
+               "step": self.step,
+               "snapshot_wanted": self._snapshot_wanted,
+               "members": [m.id for m in active]}
+        if worker_id is not None:
+            m = self._members.get(worker_id)
+            out["rank"] = m.rank if m is not None else -1
+            out["state"] = m.state if m is not None else DEAD
+        return out
+
+    # ------------------------------------------------------------------
+    # Membership RPCs
+    # ------------------------------------------------------------------
+    def join(self, worker_id: str) -> dict:
+        """Admit a worker (through its admission breaker) into the
+        ``joined`` (syncing) state.  A worker re-using the id of a
+        still-listed member replaces it — the old incarnation is a
+        zombie by definition.  Returns admission + whether the joiner
+        must await a state snapshot before activating (training already
+        under way)."""
+        with self._lock:
+            self._sweep_locked()
+            br = self._breaker_for(worker_id)
+            try:
+                br.acquire()
+            except CircuitOpenError as e:
+                return {"admitted": False,
+                        "retry_after_s": float(e.retry_after_s),
+                        "reason": "breaker_open"}
+            rejoin = False
+            old = self._members.get(worker_id)
+            if old is not None:
+                # a replacement for a zombie incarnation: evict the old
+                # one now rather than waiting out its lease
+                del self._members[worker_id]
+                events.emit("dist.worker_dead", severity="warn",
+                            worker=worker_id,
+                            generation=self.generation, replaced=True)
+                self._roll_locked("worker_replaced")
+                rejoin = True
+            if br.state != CircuitBreaker.CLOSED or self._was_dead(worker_id):
+                rejoin = True
+            self._join_seq += 1
+            m = Member(worker_id, self._join_seq,
+                       self._clock() + self.lease_s)
+            self._members[worker_id] = m
+            if rejoin:
+                self._c_rejoins.inc()
+            await_snapshot = self.step > 0
+            if await_snapshot:
+                self._snapshot_wanted = True
+            events.emit("dist.worker_joined", worker=worker_id,
+                        generation=self.generation, rejoin=rejoin)
+            self._gauges_locked()
+            self._cond.notify_all()
+            return {"admitted": True, "await_snapshot": await_snapshot,
+                    **self._placement_locked(worker_id)}
+
+    def _was_dead(self, worker_id: str) -> bool:
+        br = self._breakers.get(worker_id)
+        if br is None:
+            return False
+        snap = br.snapshot()
+        return bool(snap["window_failures"]) or snap["state"] != "closed"
+
+    def sync_done(self, worker_id: str) -> dict:
+        """A joined worker finished syncing state (restored the snapshot
+        or had nothing to restore): promote it to ``active``.  During
+        initial formation the roll to generation 1 waits for
+        ``expected`` active workers; afterwards every activation rolls
+        immediately — absorption is a membership change."""
+        with self._lock:
+            m = self._members.get(worker_id)
+            if m is None:
+                return {"evicted": True}
+            m.state = ACTIVE
+            m.lease_deadline = self._clock() + self.lease_s
+            self._breaker_for(worker_id).record(True)
+            events.emit("dist.worker_active", worker=worker_id,
+                        generation=self.generation)
+            if self.generation == 0:
+                n_active = sum(1 for x in self._members.values()
+                               if x.state == ACTIVE)
+                if n_active >= max(1, self.expected):
+                    self._roll_locked("formation")
+            else:
+                self._roll_locked("worker_absorbed")
+            self._gauges_locked()
+            return self._placement_locked(worker_id)
+
+    def heartbeat(self, worker_id: str, generation: int = -1) -> dict:
+        """Renew a worker's lease.  The response doubles as the
+        out-of-band control channel: current generation (so a worker
+        learns of a roll between steps), eviction notice, and the
+        snapshot-upload request for the lowest-ranked member."""
+        with self._lock:
+            self._sweep_locked()
+            m = self._members.get(worker_id)
+            if m is None:
+                return {"evicted": True}
+            m.lease_deadline = self._clock() + self.lease_s
+            if m.state == SUSPECT:
+                m.state = ACTIVE if m.rank >= 0 else JOINED
+                events.emit("dist.worker_active", worker=worker_id,
+                            generation=self.generation, recovered=True)
+                self._gauges_locked()
+            return {"generation": self.generation, "step": self.step,
+                    "upload_state": self._upload_wanted_locked(m)}
+
+    def leave(self, worker_id: str) -> dict:
+        """Graceful departure (end of script): no breaker charge, but
+        the survivors still roll to a new generation."""
+        with self._lock:
+            m = self._members.pop(worker_id, None)
+            if m is not None:
+                events.emit("dist.worker_dead", worker=worker_id,
+                            generation=self.generation, graceful=True)
+                self._roll_locked("worker_left")
+            return {"left": m is not None}
+
+    def placement(self, worker_id: Optional[str] = None) -> dict:
+        with self._lock:
+            self._sweep_locked()
+            return self._placement_locked(worker_id)
+
+    # ------------------------------------------------------------------
+    # Data plane: the step barrier + weighted all-reduce
+    # ------------------------------------------------------------------
+    def _upload_wanted_locked(self, m: Member) -> bool:
+        if not self._snapshot_wanted or m.state != ACTIVE:
+            return False
+        active = self._active_locked()
+        return bool(active) and active[0].id == m.id
+
+    def allreduce(self, worker_id: str, generation: int, step: int,
+                  weight: float, vec) -> dict:
+        """One worker's contribution to global step ``step`` (must be
+        the next uncommitted step).  Blocks until every active member of
+        the CURRENT generation has contributed, then returns the
+        weighted mean (float64 accumulation in rank order — bit-stable
+        across runs).  If the generation rolls while waiting (a peer
+        died, a peer was absorbed), returns ``{"rolled": True}`` with
+        the fresh placement and the caller recomputes its shard under
+        the new world."""
+        t0 = time.perf_counter()
+        vec64 = np.asarray(vec, np.float64).ravel()
+        with self._lock:
+            self._sweep_locked()
+            m = self._members.get(worker_id)
+            if m is None:
+                self._c_allreduce.labels(outcome="fenced").inc()
+                return {"evicted": True}
+            if self.generation == 0:
+                # still forming: there is no data plane yet — a partial
+                # membership must never complete a barrier
+                self._c_allreduce.labels(outcome="fenced").inc()
+                return {"rolled": True,
+                        **self._placement_locked(worker_id)}
+            # a SUSPECT member may still contribute (its shard is still
+            # assigned to it until death) — only the heartbeat channel
+            # renews the lease, so a truly dead worker still ages out
+            if generation != self.generation \
+                    or m.state not in (ACTIVE, SUSPECT):
+                self._c_allreduce.labels(outcome="fenced").inc()
+                events.emit("dist.step_fenced", severity="warn",
+                            worker=worker_id, generation=generation,
+                            step=step)
+                return {"rolled": True,
+                        **self._placement_locked(worker_id)}
+            if self.step == 0 and not self._done and step > 1:
+                # a freshly started coordinator meeting workers that
+                # resumed from a checkpoint: adopt their position (every
+                # worker restores the same manifest, so the first
+                # contribution names the cluster's committed step)
+                self.step = step - 1
+            if step != self.step + 1:
+                # a desynced worker (zombie resubmitting a committed
+                # step, or one that skipped ahead): fence it out — it
+                # must resync, never merge
+                self._c_allreduce.labels(outcome="fenced").inc()
+                events.emit("dist.step_fenced", severity="warn",
+                            worker=worker_id, generation=generation,
+                            step=step, committed=self.step)
+                return {"stale_step": True, "committed": self.step,
+                        **self._placement_locked(worker_id)}
+            entry_gen = self.generation
+            self._contrib[worker_id] = (float(weight), vec64)
+            self._maybe_reduce_locked()
+            deadline = time.monotonic() + self.allreduce_timeout_s
+            while True:
+                done = self._done.get(step)
+                if done is not None and done["generation"] == entry_gen:
+                    self._c_allreduce.labels(outcome="ok").inc()
+                    self._h_allreduce.observe(time.perf_counter() - t0)
+                    return {"vec": done["vec"], "weight": done["weight"],
+                            "step": step, "generation": entry_gen,
+                            "upload_state": self._upload_wanted_locked(m)}
+                if self.generation != entry_gen:
+                    self._c_allreduce.labels(outcome="rolled").inc()
+                    return {"rolled": True,
+                            **self._placement_locked(worker_id)}
+                if time.monotonic() > deadline:
+                    self._c_allreduce.labels(outcome="timeout").inc()
+                    self._contrib.pop(worker_id, None)
+                    return {"timeout": True,
+                            **self._placement_locked(worker_id)}
+                # short slices so lease expiry of a dead peer is noticed
+                # by the waiters themselves (no background reaper)
+                self._cond.wait(min(0.05, self.lease_s / 4))
+                self._sweep_locked()
+
+    def _maybe_reduce_locked(self) -> None:
+        """Complete the barrier when every RANKED member (active or
+        momentarily suspect — a suspect still owns its batch shard until
+        it is declared dead) has contributed: weighted sum in rank order
+        (float64) over the total weight."""
+        ready = self._active_locked()
+        if not ready or any(m.id not in self._contrib for m in ready):
+            return
+        total_w = sum(self._contrib[m.id][0] for m in ready)
+        acc = None
+        for m in ready:                      # rank order: bit-stable
+            w, v = self._contrib[m.id]
+            acc = w * v if acc is None else acc + w * v
+        vec = (acc / total_w if total_w > 0 else acc).astype(np.float32)
+        step = self.step + 1
+        self._done[step] = {"vec": vec, "weight": total_w,
+                            "generation": self.generation}
+        for old in [s for s in self._done if s < step - 2]:
+            del self._done[old]
+        self.step = step
+        self._contrib.clear()
+        self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # State snapshot relay (absorbing a worker without a checkpoint)
+    # ------------------------------------------------------------------
+    def _activate_joiners_locked(self) -> None:
+        """Promote every syncing (JOINED) member to ACTIVE and roll —
+        called ATOMICALLY with snapshot availability so the cluster's
+        committed step freezes at exactly the step the joiners restore:
+        the survivors' next barrier includes them, and their first
+        contribution (snapshot step + 1) is the cluster's next step.
+        Without this atomicity a joiner restores state the survivors
+        have already trained past (the stale-restore deadlock)."""
+        absorbed = [m for m in self._members.values()
+                    if m.state == JOINED]
+        if not absorbed:
+            return
+        for m in absorbed:
+            m.state = ACTIVE
+            self._breaker_for(m.id).record(True)
+            events.emit("dist.worker_active", worker=m.id,
+                        generation=self.generation, absorbed=True)
+        self._roll_locked("worker_absorbed")
+
+    def put_snapshot(self, worker_id: str, step: int, params,
+                     updater, meta: Optional[dict] = None) -> dict:
+        """The lowest-ranked survivor uploads its post-step state; the
+        coordinator relays it to syncing joiners (in-memory absorption —
+        the restore side redistributes it onto the joiner's own mesh
+        through the reshape-tolerant flat-vector path) and activates
+        them in the same locked operation (see
+        :meth:`_activate_joiners_locked`)."""
+        params = np.asarray(params, np.float32)
+        updater = (None if updater is None
+                   else np.asarray(updater, np.float32))
+        with self._lock:
+            self._snapshot = {"step": int(step), "params": params,
+                              "updater": updater,
+                              "meta": dict(meta or {}),
+                              "from": worker_id}
+            self._snapshot_wanted = False
+            self._c_snapshots.inc()
+            events.emit("dist.snapshot_transferred", worker=worker_id,
+                        step=int(step),
+                        bytes=int(params.nbytes
+                                  + (updater.nbytes
+                                     if updater is not None else 0)))
+            if int(step) >= self.step:
+                self._activate_joiners_locked()
+            self._cond.notify_all()
+            return {"stored": True}
+
+    def get_snapshot(self, worker_id: str,
+                     min_step: int = 0) -> Optional[dict]:
+        """The joiner's poll.  Returns the stored snapshot only while it
+        matches the cluster's CURRENT committed step (and ``min_step``)
+        — and, for a still-syncing caller, activates it in the same
+        locked read, so restore-and-continue is race-free against the
+        survivors' stepping.  Otherwise records that a fresh snapshot is
+        wanted (the next barrier response asks rank 0 to upload) and
+        returns None."""
+        with self._lock:
+            self._sweep_locked()
+            m = self._members.get(worker_id)
+            if m is not None:
+                m.lease_deadline = self._clock() + self.lease_s
+            snap = self._snapshot
+            if snap is not None and snap["step"] >= int(min_step) \
+                    and snap["step"] >= self.step:
+                if m is not None and m.state == JOINED:
+                    self._activate_joiners_locked()
+                return snap
+            self._snapshot_wanted = True
+            return None
+
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            self._sweep_locked()
+            return {"generation": self.generation, "step": self.step,
+                    "expected": self.expected,
+                    "members": [m.to_dict() for m in sorted(
+                        self._members.values(), key=lambda m: m.join_seq)],
+                    "snapshot_step": (self._snapshot or {}).get("step"),
+                    "breakers": {k: b.snapshot()
+                                 for k, b in self._breakers.items()}}
+
+    def close(self) -> None:
+        with self._lock:
+            self.closed = True
+            self._cond.notify_all()

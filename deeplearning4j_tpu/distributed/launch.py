@@ -1,0 +1,196 @@
+"""Elastic cluster launcher — coordinator + N supervised worker
+processes (docs/DISTRIBUTED.md).
+
+The modern ``TrainingMaster`` entry point: one process hosts the
+:class:`Coordinator` (membership/leases/generations + the step
+all-reduce) over HTTP, spawns N copies of the user's training script,
+and SUPERVISES them — a worker that dies (preemption, a ``dist.worker``
+kill fault, OOM) is evicted by its lapsed lease, the survivors roll to
+a new generation and keep training on N−1, and the launcher respawns
+the dead rank which re-admits through the coordinator's breaker,
+restores the survivors' state snapshot, and is absorbed back.  No
+operator action at any point.
+
+Worker contract (what the spawned script sees)::
+
+    DL4J_DIST_COORDINATOR   http://host:port of the coordinator
+    DL4J_DIST_WORKER_ID     stable per-rank id (w0..wN-1), kept across
+                            respawns so re-admission hits the breaker
+    DL4J_DIST_EXPECTED      initial formation size N
+
+The script builds a conf with ``.distributed(processes=N)`` and calls
+``fit()`` — the engines route every batch through the cluster step
+(``distributed/worker.fit_batch``).  On accelerator platforms that
+support cross-process XLA collectives the same script may additionally
+join ``jax.distributed`` (``scaleout.multislice.initialize_distributed``)
+for in-step ICI/DCN collectives; on CPU the coordinator barrier IS the
+data plane (the jax CPU backend implements no multi-process
+computations).
+
+CLI::
+
+    python -m deeplearning4j_tpu.distributed.launch \
+        --processes 2 [--no-respawn] [--max-restarts K] script.py [args]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.distributed.coordinator import Coordinator
+from deeplearning4j_tpu.distributed.rpc import CoordinatorServer
+from deeplearning4j_tpu.distributed.worker import (
+    ENV_COORDINATOR, ENV_EXPECTED, ENV_WORKER_ID)
+
+
+class WorkerProc:
+    """One supervised rank: the live process plus its respawn history."""
+
+    def __init__(self, worker_id: str, argv: List[str],
+                 env: Dict[str, str]):
+        self.worker_id = worker_id
+        self.argv = argv
+        self.env = env
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+        self.outputs: List[dict] = []     # per incarnation
+
+    def spawn(self) -> None:
+        # each incarnation knows its respawn ordinal — chaos tests use
+        # it to arm fault plans on the FIRST incarnation only
+        env = dict(self.env, DL4J_DIST_RESTART=str(self.restarts))
+        self.proc = subprocess.Popen(
+            self.argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+
+    def reap(self) -> Optional[int]:
+        """Non-blocking: the exit code when this incarnation finished
+        (output captured), else None."""
+        if self.proc is None or self.proc.poll() is None:
+            return None
+        out, err = self.proc.communicate()
+        rc = self.proc.returncode
+        self.outputs.append({"rc": rc, "stdout": out, "stderr": err})
+        self.proc = None
+        return rc
+
+
+class LaunchResult:
+    def __init__(self, workers: List[WorkerProc], status: dict):
+        self.workers = workers
+        self.coordinator_status = status
+
+    @property
+    def ok(self) -> bool:
+        return all(w.outputs and w.outputs[-1]["rc"] == 0
+                   for w in self.workers)
+
+    def stdout(self, worker_id: str) -> str:
+        for w in self.workers:
+            if w.worker_id == worker_id:
+                return "".join(o["stdout"] for o in w.outputs)
+        return ""
+
+    def all_stdout(self) -> str:
+        return "".join(o["stdout"] for w in self.workers
+                       for o in w.outputs)
+
+    def describe_failures(self) -> str:
+        msgs = []
+        for w in self.workers:
+            for i, o in enumerate(w.outputs):
+                if o["rc"] != 0:
+                    msgs.append(f"--- {w.worker_id} incarnation {i} "
+                                f"(rc={o['rc']}):\n{o['stdout'][-2000:]}\n"
+                                f"{o['stderr'][-3000:]}")
+        return "\n".join(msgs) or "(all workers exited 0)"
+
+
+def launch_cluster(argv: List[str], processes: int,
+                   respawn: bool = True, max_restarts: int = 2,
+                   lease_ms: float = 1500.0,
+                   env_extra: Optional[Dict[str, str]] = None,
+                   per_worker_env: Optional[
+                       Callable[[int], Dict[str, str]]] = None,
+                   timeout_s: float = 600.0,
+                   cwd: Optional[str] = None) -> LaunchResult:
+    """Run ``argv`` as an elastic N-worker cluster and supervise it to
+    completion.  ``per_worker_env(i)`` layers rank-specific env on top
+    of ``env_extra`` (how chaos tests arm a ``DL4J_FAULT_PLAN`` on one
+    rank only).  Returns once every rank's final incarnation exited
+    (workers that exhaust ``max_restarts`` stay failed)."""
+    co = Coordinator(expected=processes, lease_ms=lease_ms)
+    server = CoordinatorServer(co).start()
+    workers: List[WorkerProc] = []
+    try:
+        for i in range(processes):
+            env = dict(os.environ)
+            env.update(env_extra or {})
+            env.update((per_worker_env or (lambda _i: {}))(i))
+            env[ENV_COORDINATOR] = server.address
+            env[ENV_WORKER_ID] = f"w{i}"
+            env[ENV_EXPECTED] = str(processes)
+            w = WorkerProc(f"w{i}", list(argv), env)
+            if cwd is not None:
+                w.argv = list(argv)
+            w.spawn()
+            workers.append(w)
+        deadline = time.monotonic() + timeout_s
+        pending = set(range(processes))
+        while pending:
+            if time.monotonic() > deadline:
+                for i in pending:
+                    p = workers[i].proc
+                    if p is not None:
+                        p.kill()
+                        workers[i].reap()
+                break
+            for i in list(pending):
+                w = workers[i]
+                rc = w.reap()
+                if rc is None:
+                    continue
+                if rc != 0 and respawn and w.restarts < max_restarts:
+                    w.restarts += 1
+                    w.spawn()     # same id: re-admission via breaker
+                else:
+                    pending.discard(i)
+            time.sleep(0.05)
+        status = co.status()
+    finally:
+        server.stop()
+    return LaunchResult(workers, status)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.distributed.launch",
+        description="Launch an elastic coordinator + N-worker cluster")
+    ap.add_argument("--processes", "-n", type=int, default=2)
+    ap.add_argument("--no-respawn", action="store_true",
+                    help="do not respawn dead workers (no elasticity)")
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--lease-ms", type=float, default=1500.0)
+    ap.add_argument("--timeout-s", type=float, default=3600.0)
+    ap.add_argument("script", help="worker training script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    result = launch_cluster(
+        [sys.executable, args.script] + args.script_args,
+        processes=args.processes, respawn=not args.no_respawn,
+        max_restarts=args.max_restarts, lease_ms=args.lease_ms,
+        timeout_s=args.timeout_s)
+    sys.stdout.write(result.all_stdout())
+    if not result.ok:
+        sys.stderr.write(result.describe_failures() + "\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""HTTP transport for the elastic coordinator — the same JSON-RPC wire
+shape as ``server/gateway.Server`` (``POST / {"method", "params"}``),
+with gradient/param vectors shipped as base64 ``.npy`` payloads (the
+bit-exact binary codec the fleet tier's carry migration uses).
+
+``CoordinatorServer`` wraps a :class:`Coordinator` in a
+``ThreadingHTTPServer`` (one blocked barrier call per worker rides one
+handler thread); ``CoordinatorClient`` is the worker-side stub, mapping
+connection-level failures onto :class:`TransientError` so the worker's
+retry policies compose (docs/RESILIENCE.md)."""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.resilience.errors import TransientError
+
+
+class CoordinatorUnavailableError(TransientError):
+    """The coordinator could not be reached (refused / reset / timed
+    out) — retryable; the cluster is useless without it, so workers
+    retry rather than fail over."""
+
+
+def encode_vec(vec) -> Optional[str]:
+    """float32 vector → base64 ``.npy`` (bit-exact round trip)."""
+    if vec is None:
+        return None
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(vec, np.float32), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_vec(blob: Optional[str]):
+    if blob is None:
+        return None
+    buf = io.BytesIO(base64.b64decode(blob.encode("ascii")))
+    return np.load(buf, allow_pickle=False)
+
+
+#: request/response fields carried as binary npy instead of JSON lists
+_VEC_FIELDS = ("vec", "params", "updater")
+
+
+def _pack(doc: dict) -> dict:
+    out = dict(doc)
+    for k in _VEC_FIELDS:
+        if out.get(k) is not None:
+            out[k] = encode_vec(out[k])
+    return out
+
+
+def _unpack(doc: dict) -> dict:
+    out = dict(doc)
+    for k in _VEC_FIELDS:
+        if out.get(k) is not None:
+            out[k] = decode_vec(out[k])
+    return out
+
+
+class CoordinatorServer:
+    """Serves a :class:`Coordinator` over localhost-grade HTTP.  The
+    method surface mirrors the class one-to-one; ``GET /healthz`` and
+    ``GET /status`` are bare probe surfaces for the launcher."""
+
+    METHODS = ("join", "sync_done", "heartbeat", "leave", "placement",
+               "allreduce", "put_snapshot", "get_snapshot", "status")
+
+    def __init__(self, coordinator, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.coordinator = coordinator
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            daemon_threads = True
+
+            def log_message(self, *a):            # quiet
+                pass
+
+            def _reply(self, code: int, doc: dict) -> None:
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    self._reply(200, {"ok": True})
+                    return
+                if self.path.startswith("/status"):
+                    self._reply(200, server.coordinator.status())
+                    return
+                self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    method = doc.get("method")
+                    if method not in CoordinatorServer.METHODS:
+                        self._reply(400, {"error":
+                                          f"unknown method {method!r}"})
+                        return
+                    params = _unpack(doc.get("params") or {})
+                    result = getattr(server.coordinator, method)(**params)
+                    self._reply(200, {"result": _pack(result or {})})
+                except Exception as e:  # malformed frame / codec error
+                    self._reply(500,
+                                {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "CoordinatorServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="dist-coordinator")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.coordinator.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+
+
+class CoordinatorClient:
+    """Worker-side stub speaking the wire protocol above.  Exposes the
+    same method surface as :class:`Coordinator` so
+    ``distributed.worker.DistSession`` runs identically against an
+    in-process coordinator object (thread-mode tests) or this client
+    (real multi-process clusters)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 180.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    def __repr__(self):
+        return f"CoordinatorClient({self.base_url!r})"
+
+    def _call(self, method: str, timeout_s: Optional[float] = None,
+              **params) -> dict:
+        body = json.dumps({"method": method,
+                           "params": _pack(params)}).encode()
+        req = urllib.request.Request(
+            self.base_url + "/", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s) as r:
+                doc = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read() or b"{}").get("error")
+            except Exception:
+                msg = None
+            raise RuntimeError(f"coordinator {method} failed: "
+                               f"{msg or f'HTTP {e.code}'}") from None
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise CoordinatorUnavailableError(
+                f"coordinator {self.base_url} unreachable for "
+                f"{method!r}: {getattr(e, 'reason', e)}") from None
+        return _unpack(doc.get("result") or {})
+
+    # -- the Coordinator surface --------------------------------------
+    def join(self, worker_id):
+        return self._call("join", worker_id=worker_id)
+
+    def sync_done(self, worker_id):
+        return self._call("sync_done", worker_id=worker_id)
+
+    def heartbeat(self, worker_id, generation=-1):
+        return self._call("heartbeat", timeout_s=10.0,
+                          worker_id=worker_id, generation=generation)
+
+    def leave(self, worker_id):
+        return self._call("leave", worker_id=worker_id)
+
+    def placement(self, worker_id=None):
+        return self._call("placement", worker_id=worker_id)
+
+    def allreduce(self, worker_id, generation, step, weight, vec):
+        return self._call("allreduce", worker_id=worker_id,
+                          generation=generation, step=step,
+                          weight=weight, vec=vec)
+
+    def put_snapshot(self, worker_id, step, params, updater, meta=None):
+        return self._call("put_snapshot", worker_id=worker_id, step=step,
+                          params=params, updater=updater, meta=meta)
+
+    def get_snapshot(self, worker_id, min_step=0):
+        out = self._call("get_snapshot", worker_id=worker_id,
+                         min_step=min_step)
+        return out or None
+
+    def status(self):
+        return self._call("status")

@@ -1,0 +1,572 @@
+"""Elastic worker runtime: the per-process session against the
+coordinator, and the distributed data-plane step the engines' fit loops
+route through under ``conf.distributed(...)`` (docs/DISTRIBUTED.md).
+
+Per global batch the worker computes gradients on ITS shard (sliced by
+``(rank, world)`` of the current generation), all-reduces the flat
+gradient + score through the coordinator barrier, and applies the
+weighted-mean gradient through the engine's own updater step — so every
+worker holds bit-identical params/updater state after every committed
+step, and the committed trajectory equals a single-host run over the
+same global batches (weighted shard-mean == global mean for the
+mean-reduction losses; parity pinned ≤1e-6 in tests/test_distributed*).
+
+Elasticity falls out of the generation protocol:
+
+* a **generation roll** mid-step (:class:`GenerationRolled`) makes the
+  survivors recompute the SAME global step with their new shard bounds
+  — the committed gradient always covers the whole global batch, so a
+  2→1 resize changes nothing about the trajectory;
+* an **absorbed** worker (fresh join or respawned process) restores the
+  in-memory snapshot the lowest-ranked survivor uploaded (params +
+  updater flat vectors — the reshape-tolerant checkpoint format, so the
+  restore redistributes onto the joiner's own local mesh), then its
+  fit() replay-skips the already-trained prefix exactly like a
+  checkpoint resume;
+* an **evicted** zombie (heartbeats lost while the step loop lived) is
+  fenced by the coordinator, re-admits through the breaker, and resyncs
+  from the snapshot (within the current epoch).
+
+Fault sites: ``dist.worker`` (before each local gradient compute — a
+``kill`` here is a worker dying mid-epoch) and ``dist.heartbeat``
+(inside the heartbeat loop — a ``kill`` makes a zombie whose lease
+lapses).  See docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import monitor
+from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.errors import TransientError
+
+log = logging.getLogger(__name__)
+
+
+class GenerationRolled(Exception):
+    """Internal control-flow signal: the cluster rolled to a new
+    generation while this step was in flight — recompute the shard
+    under the new placement (same global step)."""
+
+
+class WorkerEvictedError(RuntimeError):
+    """This worker was declared dead by the coordinator (lease + grace
+    lapsed) while it was still running — it must re-admit and resync
+    before contributing again."""
+
+
+class ClusterFormationError(RuntimeError):
+    """The cluster never formed / this worker never became active
+    within the deadline."""
+
+
+def shard_bounds(n: int, world: int, rank: int) -> Tuple[int, int]:
+    """Contiguous near-equal row split of a global batch: worker
+    ``rank`` of ``world`` owns rows ``[n*rank//world, n*(rank+1)//world)``
+    — covers every row exactly once for any world size."""
+    world = max(1, int(world))
+    return (n * rank) // world, (n * (rank + 1)) // world
+
+
+class DistSession:
+    """One worker's membership in the elastic cluster.  ``coordinator``
+    is either a :class:`~deeplearning4j_tpu.distributed.coordinator.
+    Coordinator` (thread-mode tests / the dl4j-check scenario) or a
+    :class:`~deeplearning4j_tpu.distributed.rpc.CoordinatorClient`
+    (real multi-process clusters) — identical surface."""
+
+    def __init__(self, coordinator, worker_id: str,
+                 heartbeat_ms: float = 250.0,
+                 form_timeout_s: float = 120.0,
+                 rejoin: bool = True):
+        self.coordinator = coordinator
+        self.worker_id = str(worker_id)
+        self.heartbeat_s = max(0.01, float(heartbeat_ms) / 1e3)
+        self.form_timeout_s = float(form_timeout_s)
+        self.rejoin = bool(rejoin)
+        self.closed = False
+        self.pending_skip = 0
+        self._generation = 0
+        self._rank = -1
+        self._world = 0
+        self._await_snapshot = False
+        self._join_step = 0
+        self._evicted = threading.Event()
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._model_ref = None
+
+    # ------------------------------------------------------------------
+    # Membership
+    # ------------------------------------------------------------------
+    def connect(self) -> dict:
+        """Join (retrying past coordinator races and breaker refusals),
+        start heartbeating, and — when the cluster has not trained yet —
+        activate immediately.  A join into a running cluster defers
+        activation until :meth:`resume_position` restores the state
+        snapshot inside fit()."""
+        deadline = time.monotonic() + self.form_timeout_s
+        while True:
+            try:
+                resp = self.coordinator.join(self.worker_id)
+            except TransientError:
+                resp = None
+            if resp is not None and resp.get("admitted"):
+                break
+            if time.monotonic() > deadline:
+                raise ClusterFormationError(
+                    f"worker {self.worker_id}: not admitted within "
+                    f"{self.form_timeout_s}s (last: {resp})")
+            time.sleep(min(1.0, float((resp or {}).get(
+                "retry_after_s", 0.2))))
+        self._await_snapshot = bool(resp.get("await_snapshot"))
+        self._join_step = int(resp.get("step", 0))
+        self._start_heartbeat()
+        if not self._await_snapshot:
+            self._note_placement(self.coordinator.sync_done(self.worker_id))
+        return resp
+
+    def _start_heartbeat(self) -> None:
+        self._evicted.clear()
+        self._stop.clear()
+        t = threading.Thread(target=self._hb_loop, daemon=True,
+                             name=f"dist-hb:{self.worker_id}")
+        self._hb_thread = t
+        t.start()
+
+    def _hb_loop(self) -> None:
+        try:
+            while not self._stop.wait(self.heartbeat_s):
+                faults.check("dist.heartbeat")
+                try:
+                    resp = self.coordinator.heartbeat(
+                        self.worker_id, self._generation)
+                except TransientError:
+                    continue     # coordinator blip: the lease covers it
+                if resp.get("evicted"):
+                    self._evicted.set()
+                    return
+        except BaseException as e:  # incl. ThreadKill chaos: the lease
+            # now lapses and the coordinator will declare this worker
+            # dead — exactly the zombie failure mode under test
+            try:
+                events.emit("dist.heartbeat_lost", severity="error",
+                            worker=self.worker_id,
+                            error=f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def heartbeat_alive(self) -> bool:
+        t = self._hb_thread
+        return t is not None and t.is_alive()
+
+    def placement_tuple(self) -> Tuple[int, int, int]:
+        """(generation, rank, world) — refreshed from the coordinator
+        until this worker is an active member of a formed generation."""
+        deadline = time.monotonic() + self.form_timeout_s
+        while True:
+            if self._generation > 0 and self._rank >= 0:
+                return self._generation, self._rank, self._world
+            out = self.coordinator.placement(self.worker_id)
+            self._note_placement(out)
+            if self._generation > 0 and self._rank >= 0:
+                return self._generation, self._rank, self._world
+            if out.get("state") == "dead":
+                raise WorkerEvictedError(
+                    f"worker {self.worker_id} evicted while waiting "
+                    "for placement")
+            if time.monotonic() > deadline:
+                raise ClusterFormationError(
+                    f"worker {self.worker_id}: no active placement "
+                    f"within {self.form_timeout_s}s ({out})")
+            time.sleep(0.02)
+
+    def _note_placement(self, out: dict) -> None:
+        if not out:
+            return
+        self._generation = int(out.get("generation", self._generation))
+        self._world = int(out.get("world", self._world))
+        if "rank" in out:
+            self._rank = int(out["rank"])
+
+    # ------------------------------------------------------------------
+    # Data plane
+    # ------------------------------------------------------------------
+    def before_step(self, iteration: int) -> None:
+        """Pre-dispatch hook: the ``dist.worker`` fault site, plus
+        eviction fast-path (the heartbeat thread saw it first)."""
+        faults.check("dist.worker")
+        if self._evicted.is_set():
+            raise WorkerEvictedError(
+                f"worker {self.worker_id} evicted (lease lapsed) at "
+                f"iteration {iteration}")
+
+    def allreduce_step(self, step: int, vec, weight: float) -> dict:
+        """Contribute to global step ``step`` and block for the reduced
+        result.  Raises :class:`GenerationRolled` when membership
+        changed mid-barrier (recompute), :class:`WorkerEvictedError`
+        when this worker was fenced out for good."""
+        while True:
+            try:
+                resp = self.coordinator.allreduce(
+                    self.worker_id, self._generation, step,
+                    float(weight), vec)
+            except TransientError:
+                time.sleep(0.05)
+                continue
+            if resp.get("evicted"):
+                self._evicted.set()
+                raise WorkerEvictedError(
+                    f"worker {self.worker_id} evicted at step {step}")
+            if resp.get("stale_step"):
+                # fenced behind the cluster's committed step: this
+                # worker must resync from a snapshot, not recompute
+                self._note_placement(resp)
+                raise WorkerEvictedError(
+                    f"worker {self.worker_id} desynced at step {step} "
+                    f"(cluster committed {resp.get('committed')})")
+            if resp.get("rolled") or resp.get("timeout"):
+                self._note_placement(resp)
+                if resp.get("state") == "dead":
+                    self._evicted.set()
+                    raise WorkerEvictedError(
+                        f"worker {self.worker_id} fenced dead at step "
+                        f"{step}")
+                raise GenerationRolled(
+                    f"generation rolled to {self._generation} during "
+                    f"step {step}")
+            return resp
+
+    # ------------------------------------------------------------------
+    # State snapshot (absorption / resync)
+    # ------------------------------------------------------------------
+    def resume_position(self, model, skip_epochs: int,
+                        skip_batches: int) -> Tuple[int, int]:
+        """fit()'s dist-resume hook (runs right after the checkpoint
+        auto-resume): a joiner into a running cluster waits for the
+        survivors' state snapshot, restores it in place (params +
+        updater redistributed onto this worker's own mesh by the
+        flat-vector path), activates, and returns the replay-skip
+        position — same contract as ``checkpoint.maybe_auto_resume``."""
+        if not self._await_snapshot:
+            return skip_epochs, skip_batches
+        # the coordinator activates this worker ATOMICALLY with snapshot
+        # availability (the cluster's committed step freezes at the
+        # restored step), so no separate sync_done follows the restore
+        snap = self._wait_snapshot(self._join_step)
+        self._restore_into(model, snap)
+        self._await_snapshot = False
+        self._note_placement(self.coordinator.placement(self.worker_id))
+        meta = snap.get("meta") or {}
+        return (int(meta.get("epoch") or 0),
+                int(meta.get("iteration_in_epoch") or 0))
+
+    def _wait_snapshot(self, min_step: int) -> dict:
+        deadline = time.monotonic() + self.form_timeout_s
+        while True:
+            snap = self.coordinator.get_snapshot(self.worker_id,
+                                                 min_step=min_step)
+            if snap is not None:
+                return snap
+            if time.monotonic() > deadline:
+                raise ClusterFormationError(
+                    f"worker {self.worker_id}: no state snapshot at/after "
+                    f"step {min_step} within {self.form_timeout_s}s")
+            time.sleep(0.02)
+
+    def _restore_into(self, model, snap: dict) -> None:
+        from deeplearning4j_tpu.nn import checkpoint as ckpt_mod
+        with monitor.span("dist/restore", phase="reshard"):
+            model.set_params(np.asarray(snap["params"], np.float32))
+            upd = snap.get("updater")
+            if upd is not None and np.asarray(upd).size:
+                model.set_updater_state_flat(np.asarray(upd, np.float32))
+        meta = snap.get("meta") or {}
+        model.iteration = int(snap.get("step") or 0)
+        model.epoch = int(meta.get("epoch") or 0)
+        ckpt_mod._fast_forward_rng(model)
+        events.emit("dist.snapshot_restored", worker=self.worker_id,
+                    step=model.iteration, epoch=model.epoch)
+
+    def upload_snapshot(self, model) -> None:
+        """Lowest-ranked survivor's side of absorption: post-step state
+        relay through the coordinator."""
+        params = np.asarray(model.params(), np.float32)
+        upd = np.asarray(model.updater_state_flat(), np.float32)
+        meta = {"epoch": int(getattr(model, "epoch", 0)),
+                "iteration_in_epoch": int(
+                    model.iteration
+                    - int(getattr(model, "_epoch_start_iter", 0) or 0))}
+        self.coordinator.put_snapshot(
+            self.worker_id, int(model.iteration), params,
+            upd if upd.size else None, meta)
+
+    def rejoin_and_resync(self, model) -> None:
+        """Zombie recovery: re-admit through the breaker, restore the
+        freshest snapshot, re-activate.  ``model.iteration`` lands on
+        the snapshot's committed step; the caller turns the delta into
+        a replay-skip of the stream (same-epoch resync)."""
+        self._stop.set()          # retire any still-running heartbeat
+        deadline = time.monotonic() + self.form_timeout_s
+        while True:
+            try:
+                resp = self.coordinator.join(self.worker_id)
+            except TransientError:
+                resp = None
+            if resp is not None and resp.get("admitted"):
+                break
+            if time.monotonic() > deadline:
+                raise WorkerEvictedError(
+                    f"worker {self.worker_id}: re-admission refused "
+                    f"within {self.form_timeout_s}s (last: {resp})")
+            time.sleep(min(1.0, float((resp or {}).get(
+                "retry_after_s", 0.1))))
+        self._start_heartbeat()
+        if resp.get("await_snapshot"):
+            # activation rides the snapshot (see resume_position)
+            snap = self._wait_snapshot(int(resp.get("step", 0)))
+            self._restore_into(model, snap)
+            self._note_placement(self.coordinator.placement(self.worker_id))
+        else:
+            self._note_placement(self.coordinator.sync_done(self.worker_id))
+
+    # ------------------------------------------------------------------
+    def attach(self, model) -> None:
+        self._model_ref = weakref.ref(model)
+
+    def close(self, leave: bool = True) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self._stop.set()
+        t = self._hb_thread
+        if t is not None:
+            t.join(2.0)
+        if leave:
+            try:
+                model = (self._model_ref() if self._model_ref is not None
+                         else None)
+                if model is not None:
+                    # leave the final committed state behind: a worker
+                    # respawned AFTER the survivors finish still absorbs
+                    # (restores this snapshot, replay-skips the whole
+                    # stream) instead of timing out against an empty
+                    # cluster
+                    self.upload_snapshot(model)
+            except Exception:
+                pass   # best-effort: departure must not hang
+            try:
+                self.coordinator.leave(self.worker_id)
+            except Exception:
+                pass   # coordinator already gone: nothing to leave
+
+
+# ----------------------------------------------------------------------
+# The engines' distributed step (routed from MLN/CG _fit_batch)
+# ----------------------------------------------------------------------
+def _dist_fns(model) -> dict:
+    """Per-model jitted halves of the distributed step: the gradient
+    fn (same loss closure as the fused step — ``_build_grad_raw``) and
+    the apply fn (the engine's ``_apply_updates``, donated buffers).
+    Cached on the model; ``_check_trace_token`` invalidates."""
+    fns = getattr(model, "_dist_cache", None)
+    if fns is None:
+        grad_raw = model._build_grad_raw()
+
+        def apply_fn(p, o, gr, it):
+            return model._apply_updates(p, o, gr, it)
+
+        fns = {"grad": jax.jit(grad_raw),
+               "apply": jax.jit(apply_fn, donate_argnums=(0, 1))}  # dl4j: noqa[DL4J104] one jit per model, cached in model._dist_cache
+        model._dist_cache = fns
+    return fns
+
+
+def _flatten_leaves(tree) -> np.ndarray:
+    """Host-gathered flat float32 vector over a pytree's leaves (per-
+    leaf ``np.asarray``: correct for mixed committed shardings — see
+    nn/params.flatten)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate(
+        [np.ravel(np.asarray(l)).astype(np.float32) for l in leaves])
+
+
+def _unflatten_like(flat: np.ndarray, tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape)) if l.shape else 1
+        out.append(jnp.asarray(
+            np.asarray(flat[off:off + n]).reshape(l.shape), l.dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _slice_batch(ds, lo: int, hi: int, is_graph: bool):
+    """(xs, ys, fms, lms) host arrays for this worker's shard rows."""
+    def cut(a):
+        return None if a is None else np.asarray(a)[lo:hi]
+    if is_graph:
+        return (tuple(cut(f) for f in ds.features),
+                tuple(cut(l) for l in ds.labels),
+                (None if ds.features_masks is None
+                 else tuple(cut(m) for m in ds.features_masks)),
+                (None if ds.labels_masks is None
+                 else tuple(cut(m) for m in ds.labels_masks)))
+    return (cut(ds.features), cut(ds.labels),
+            cut(ds.features_mask), cut(ds.labels_mask))
+
+
+def fit_batch(model, ds, sess: DistSession, is_graph: bool) -> None:
+    """ONE global train step through the cluster: shard-local gradients
+    → coordinator barrier all-reduce → engine updater apply.  Handles
+    generation rolls (recompute same step under the new world) and
+    eviction (rejoin + snapshot resync + replay-skip) in place, so the
+    surrounding fit loop stays the engines' ordinary epoch/batch
+    loop."""
+    if sess.pending_skip > 0:
+        # stream resync after an in-fit snapshot restore: consume the
+        # already-trained batch without stepping
+        sess.pending_skip -= 1
+        return
+    n = ds.num_examples()
+    fns = _dist_fns(model)
+    step_target = model.iteration + 1
+    t_step = time.perf_counter()
+    try:
+        resp, new_states = _barrier_step(model, ds, sess, is_graph, fns,
+                                         step_target, n)
+    except BaseException:
+        # a dying worker (ThreadKill chaos, a real crash) must stop
+        # heartbeating so the cluster evicts it promptly instead of
+        # waiting out a zombie lease
+        sess.close(leave=False)
+        raise
+    if resp is None:
+        return   # consumed as part of a post-resync replay-skip
+    reduced = np.asarray(resp["vec"], np.float32)
+    with monitor.span("fit/step", phase="dist_apply"):
+        grads_tree = _unflatten_like(reduced[1:], model.net_params)
+        model.net_params, model.opt_states = fns["apply"](
+            model.net_params, model.opt_states, grads_tree,
+            jnp.asarray(model.iteration, jnp.int32))
+    model.net_state = new_states
+    model._strip_rnn_state()
+    model._score = float(reduced[0])
+    model.iteration += 1
+    model.last_batch_size = n
+    monitor.record_fit_step(n, time.perf_counter() - t_step,
+                            float(reduced[0]))
+    with monitor.span("fit/step", phase="listeners"):
+        for lst in model.listeners:
+            lst.iteration_done(model, model.iteration)
+    if resp.get("upload_state"):
+        # a joiner is waiting: relay post-step state (absorption)
+        with monitor.span("dist/snapshot", phase="upload"):
+            sess.upload_snapshot(model)
+
+
+def _barrier_step(model, ds, sess: DistSession, is_graph: bool,
+                  fns: dict, step_target: int, n: int):
+    """Shard-compute + barrier for ONE global step, retrying across
+    generation rolls and resyncing across evictions.  Returns
+    ``(reduce response, local new_states)`` — or ``(None, None)`` when
+    the batch was consumed by a replay-skip after a resync."""
+    while True:
+        try:
+            with monitor.span("fit/step", phase="dist_barrier"):
+                sess.before_step(model.iteration)
+                gen, rank, world = sess.placement_tuple()
+            lo, hi = shard_bounds(n, world, rank)
+            with monitor.span("fit/step", phase="jit_call"):
+                xs, ys, fms, lms = _slice_batch(ds, lo, hi, is_graph)
+                model._key, sub = jax.random.split(model._key)
+                score, new_states, grads = fns["grad"](
+                    model.net_params, model.net_state, xs, ys, fms, lms,
+                    sub)
+                flat = _flatten_leaves(grads)
+            payload = np.concatenate(
+                [np.asarray([score], np.float32), flat])
+            with monitor.span("fit/step", phase="dist_barrier"):
+                resp = sess.allreduce_step(step_target, payload,
+                                           weight=hi - lo)
+            return resp, new_states
+        except GenerationRolled:
+            continue     # same step, new shard bounds
+        except WorkerEvictedError:
+            if not sess.rejoin:
+                raise
+            before = model.iteration
+            sess.rejoin_and_resync(model)
+            extra = model.iteration - before
+            if extra > 0:
+                # the cluster committed `extra` steps while this worker
+                # was fenced out; this batch is the first of them
+                sess.pending_skip = extra - 1
+                return None, None
+            step_target = model.iteration + 1
+            continue
+
+
+# ----------------------------------------------------------------------
+# Session wiring for conf-driven fit() (the launcher's env contract)
+# ----------------------------------------------------------------------
+_STATE = {"session": None}
+ENV_COORDINATOR = "DL4J_DIST_COORDINATOR"
+ENV_WORKER_ID = "DL4J_DIST_WORKER_ID"
+ENV_EXPECTED = "DL4J_DIST_EXPECTED"
+
+
+def install_session(sess: Optional[DistSession]) -> None:
+    """Make ``sess`` the process-wide session fit() attaches (tests and
+    embedders; the launcher path goes through the env vars)."""
+    _STATE["session"] = sess
+
+
+def active_session() -> Optional[DistSession]:
+    s = _STATE["session"]
+    return None if (s is None or s.closed) else s
+
+
+def maybe_session(g) -> Optional[DistSession]:
+    """fit()'s hook: the active session for a ``dist_enabled`` conf, or
+    None (single-process: conf is inert, replica semantics — the same
+    graceful degrade as ``conf.sharding``).  Lazily connects from the
+    conf/env coordinator address the launcher exports."""
+    if not getattr(g, "dist_enabled", False):
+        return None
+    s = active_session()
+    if s is not None:
+        return s
+    addr = (getattr(g, "dist_coordinator", None)
+            or os.environ.get(ENV_COORDINATOR))
+    if not addr:
+        return None
+    from deeplearning4j_tpu.distributed.rpc import CoordinatorClient
+    worker_id = os.environ.get(ENV_WORKER_ID) or f"w-pid{os.getpid()}"
+    sess = DistSession(
+        CoordinatorClient(addr), worker_id,
+        heartbeat_ms=float(getattr(g, "dist_heartbeat_ms", 250.0)))
+    sess.connect()
+    install_session(sess)
+    return sess
+
+
+def shutdown_session(leave: bool = True) -> None:
+    s = _STATE["session"]
+    _STATE["session"] = None
+    if s is not None:
+        s.close(leave=leave)

@@ -78,6 +78,16 @@ EVENT_TYPES = (
     "fleet.rollout",
     "slo.state_changed",
     "slo.replica_parked",
+    "slo.alert_delivered",
+    "dist.worker_joined",
+    "dist.worker_active",
+    "dist.worker_suspect",
+    "dist.worker_dead",
+    "dist.generation_rolled",
+    "dist.step_fenced",
+    "dist.snapshot_transferred",
+    "dist.snapshot_restored",
+    "dist.heartbeat_lost",
     "cache.load",
     "cache.evicted",
     "rollout.flip",

@@ -34,10 +34,22 @@ scope, e.g. ``replica=r0|``).
 ``DL4J_SLO=0`` (or :func:`set_enabled`) is the kill switch — the
 bench A/B lever (``bench_serving`` reports ``slo_overhead_pct``,
 required ≤ 5%).
+
+**Alert delivery**: burn states that only live in ``/metrics`` page
+nobody.  ``SloTracker(alert_sink=...)`` delivers every
+``slo.state_changed`` flip to a sink — a callable (the in-process
+pager hook), an ``http(s)://`` webhook URL (JSON POST), or a
+``cmd:<shell command>`` (payload JSON on stdin).  With no explicit
+sink, the ``DL4J_SLO_WEBHOOK`` env var supplies one.  Delivery runs
+through a :class:`~deeplearning4j_tpu.resilience.policy.RetryPolicy`
+(transient webhook failures retry with backoff inside a small
+deadline) and is metered ``dl4j_slo_alerts_total{outcome=}``
+(``delivered`` / ``failed``).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -65,6 +77,63 @@ def enabled() -> bool:
     if on is not None:
         return on
     return os.environ.get("DL4J_SLO", "1") != "0"
+
+
+ENV_WEBHOOK = "DL4J_SLO_WEBHOOK"
+
+
+def _webhook_sink(url: str):
+    """JSON-POST alert sink.  Non-2xx and transport failures raise a
+    retryable error so the tracker's RetryPolicy engages."""
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.resilience.errors import TransientError
+
+    def deliver(payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5.0) as r:
+                r.read()
+        except (urllib.error.URLError, ConnectionError, TimeoutError,
+                OSError) as e:
+            raise TransientError(
+                f"slo webhook {url} failed: "
+                f"{getattr(e, 'reason', e)}") from None
+    return deliver
+
+
+def _command_sink(command: str):
+    """Shell-command alert sink (``cmd:<command>``): the payload JSON
+    arrives on stdin — the pager/runbook integration hook."""
+    import subprocess
+
+    from deeplearning4j_tpu.resilience.errors import TransientError
+
+    def deliver(payload: dict) -> None:
+        proc = subprocess.run(command, shell=True,
+                              input=json.dumps(payload).encode(),
+                              capture_output=True, timeout=10.0)
+        if proc.returncode != 0:
+            raise TransientError(
+                f"slo alert command exited {proc.returncode}: "
+                f"{proc.stderr[-200:]!r}")
+    return deliver
+
+
+def resolve_alert_sink(sink):
+    """callable → itself; ``http(s)://`` → webhook; ``cmd:`` → command;
+    None → the ``DL4J_SLO_WEBHOOK`` env var (or no sink)."""
+    if sink is None:
+        sink = os.environ.get(ENV_WEBHOOK) or None
+    if sink is None or callable(sink):
+        return sink
+    s = str(sink)
+    if s.startswith("cmd:"):
+        return _command_sink(s[4:].strip())
+    return _webhook_sink(s)
 
 
 def _le_value(le: str) -> float:
@@ -222,13 +291,25 @@ class SloTracker:
     def __init__(self, objectives: Optional[List[Objective]] = None,
                  registry=None, series_prefix: str = "",
                  on_state_change: Optional[Callable] = None,
-                 flight_dump: bool = True):
+                 flight_dump: bool = True, alert_sink=None,
+                 alert_retry=None):
         self.objectives = (list(objectives) if objectives is not None
                            else default_objectives())
         self._reg = registry if registry is not None else get_registry()
         self.series_prefix = str(series_prefix)
         self.on_state_change = on_state_change
         self.flight_dump = bool(flight_dump)
+        self.alert_sink = resolve_alert_sink(alert_sink)
+        if alert_retry is None and self.alert_sink is not None:
+            from deeplearning4j_tpu.resilience.policy import RetryPolicy
+            alert_retry = RetryPolicy(max_attempts=3, base_delay_ms=100,
+                                      max_delay_ms=1000, deadline_s=10.0,
+                                      name="slo-alert")
+        self.alert_retry = alert_retry
+        self._c_alerts = self._reg.counter(
+            "dl4j_slo_alerts_total",
+            "SLO state-change alerts by delivery outcome "
+            "(delivered / failed)", ("outcome",))
         self._lock = threading.Lock()
         self._hist: Dict[Tuple[str, str], deque] = {}
         self._state: Dict[Tuple[str, str], str] = {}
@@ -388,6 +469,38 @@ class SloTracker:
                 cb(obj, series, old, new)
             except Exception:
                 pass   # a hook failure must not break evaluation
+        self._deliver_alert(obj, series, old, new, burn_fast, burn_slow)
+
+    def _deliver_alert(self, obj: Objective, series: str, old: str,
+                       new: str, burn_fast: float,
+                       burn_slow: float) -> None:
+        """Push the flip to the configured sink through the retry
+        policy; outcomes land in ``dl4j_slo_alerts_total``.  A sink
+        that stays broken past the retries is counted and dropped — the
+        evaluator never wedges on a dead pager."""
+        sink = self.alert_sink
+        if sink is None:
+            return
+        payload = {"kind": "slo.state_changed", "objective": obj.name,
+                   "series": series, "old": old, "new": new,
+                   "burn_fast": round(burn_fast, 3),
+                   "burn_slow": round(burn_slow, 3),
+                   "target": obj.target, "ts": time.time()}
+        try:
+            if self.alert_retry is not None:
+                self.alert_retry.call(sink, payload)
+            else:
+                sink(payload)
+        except Exception as e:
+            self._c_alerts.labels(outcome="failed").inc()
+            events.emit("slo.alert_delivered", severity="error",
+                        objective=obj.name, series=series, new=new,
+                        outcome="failed",
+                        error=f"{type(e).__name__}: {e}")
+            return
+        self._c_alerts.labels(outcome="delivered").inc()
+        events.emit("slo.alert_delivered", objective=obj.name,
+                    series=series, new=new, outcome="delivered")
 
     # ------------------------------------------------------------------
     # State surface

@@ -122,6 +122,24 @@ def _sharding_meta(model):
         return None
 
 
+def _dist_meta(model):
+    """Cluster placement at save time for the manifest (None outside
+    distributed training) — which generation/rank/world wrote this
+    checkpoint.  The coefficients stay the gathered flat host vector,
+    so a checkpoint written by a 4-worker cluster restores into a
+    1-worker (or single-host) run unchanged; this records provenance
+    for the resume log and the cross-world-restore tests."""
+    sess = getattr(model, "_dist_session", None)
+    if sess is None:
+        return None
+    try:
+        return {"worker": sess.worker_id,
+                "generation": int(sess._generation),
+                "rank": int(sess._rank), "world": int(sess._world)}
+    except Exception:
+        return None
+
+
 def _count_fallback() -> None:
     try:
         from deeplearning4j_tpu import monitor
@@ -202,7 +220,11 @@ class CheckpointListener(TrainingListener):
                 # the zip are ALWAYS the gathered flat host vector, so a
                 # checkpoint restores onto any mesh; this records where
                 # it came from for the reshard log/metrics.
-                "sharding": _sharding_meta(model)}
+                "sharding": _sharding_meta(model),
+                # cluster placement at save time (None outside
+                # distributed training) — restores work across process
+                # counts; this is provenance, not a constraint
+                "dist": _dist_meta(model)}
         self._update_manifest(meta)
         # legacy single-entry index, kept for older readers
         _atomic_write_text(self.dir / "checkpoint_index.json",
@@ -273,12 +295,13 @@ def _checkpoint_meta(directory, path: Path) -> dict:
     m = _CKPT_RE.search(path.name)
     meta = {"file": path.name,
             "iteration": int(m.group(1)) if m else 0,
-            "epoch": None, "iteration_in_epoch": None, "sharding": None}
+            "epoch": None, "iteration_in_epoch": None, "sharding": None,
+            "dist": None}
     for e in read_manifest(directory):
         if e.get("file") == path.name:
             meta.update({k: e.get(k, meta.get(k)) for k in
                          ("epoch", "iteration_in_epoch", "model_class",
-                          "sharding")})
+                          "sharding", "dist")})
             return meta
     idx = Path(directory) / "checkpoint_index.json"
     if idx.exists():
@@ -383,6 +406,13 @@ def restore_into(model, directory, load_updater: bool = True
         fsdp.note_reshard(model, meta.get("sharding"))
     except Exception:
         pass
+    if meta.get("dist"):
+        # written under a cluster placement (possibly another world
+        # size): the flat-vector restore above already redistributed —
+        # log the cross-world provenance for the resume audit trail
+        log.info("restoring checkpoint written by cluster worker %s "
+                 "(generation %s, world %s)", meta["dist"].get("worker"),
+                 meta["dist"].get("generation"), meta["dist"].get("world"))
     model.iteration = loaded.iteration
     model.epoch = getattr(loaded, "epoch", 0)
     _fast_forward_rng(model)

@@ -104,6 +104,22 @@ class GlobalConf:
     sharding_fsdp: int = 1
     sharding_model: int = 1
     sharding_replicate_below: int = 2048
+    # Elastic multi-host training (distributed/): ``dist_enabled`` makes
+    # fit() train as one worker of a coordinator-backed cluster — each
+    # global batch is shard-sliced by (rank, world) of the current
+    # cluster generation, gradients all-reduce through the coordinator
+    # barrier, and membership changes (a preempted worker, a returning
+    # one) roll the generation and re-slice live.  ``dist_processes`` is
+    # the initial formation size; ``dist_coordinator`` the coordinator
+    # URL (the launcher exports DL4J_DIST_COORDINATOR instead).  Without
+    # a reachable coordinator the conf is inert — single-process fit()
+    # is byte-identical to a non-distributed one.  See
+    # docs/DISTRIBUTED.md.
+    dist_enabled: bool = False
+    dist_processes: int = 0
+    dist_coordinator: Optional[str] = None
+    dist_heartbeat_ms: float = 250.0
+    dist_lease_ms: float = 2000.0
 
 
 _MERGE_FIELDS = [
@@ -366,6 +382,33 @@ class Builder:
             self._g.sharding_model = int(model)
         if replicate_below is not None:
             self._g.sharding_replicate_below = max(0, int(replicate_below))
+        return self
+
+    def distributed(self, processes: Optional[int] = None,
+                    coordinator: Optional[str] = None,
+                    heartbeat_ms: Optional[float] = None,
+                    lease_ms: Optional[float] = None,
+                    enabled: bool = True):
+        """Route fit() through the elastic multi-worker cluster runtime
+        (docs/DISTRIBUTED.md) — the modern equivalent of the reference's
+        Spark ``TrainingMaster`` tier: N workers (usually spawned by
+        ``python -m deeplearning4j_tpu.distributed.launch``) slice each
+        global batch by their generation's (rank, world), all-reduce
+        gradients through the coordinator barrier, tolerate preemption
+        (survivors continue on N−1 within the run) and absorb returning
+        workers from an in-memory state snapshot.  ``processes`` is the
+        initial formation size; ``coordinator`` overrides the
+        ``DL4J_DIST_COORDINATOR`` env the launcher exports.  Without a
+        coordinator the conf is inert (replica semantics)."""
+        self._g.dist_enabled = bool(enabled)
+        if processes is not None:
+            self._g.dist_processes = max(0, int(processes))
+        if coordinator is not None:
+            self._g.dist_coordinator = str(coordinator)
+        if heartbeat_ms is not None:
+            self._g.dist_heartbeat_ms = float(heartbeat_ms)
+        if lease_ms is not None:
+            self._g.dist_lease_ms = float(lease_ms)
         return self
 
     def data_type(self, p: Optional[str]):  # reference-style alias

@@ -237,7 +237,10 @@ class ComputationGraph:
                 score = score + s["moe_aux_loss"]
         return score
 
-    def _build_step_raw(self):
+    def _build_grad_raw(self):
+        """The loss-and-gradient half of the graph train step — same
+        split and contract as ``MultiLayerNetwork._build_grad_raw``
+        (the distributed runtime's all-reduce seam)."""
         g = self.conf.global_conf
         policy = dtype_ops.resolve(g.precision)
         out_confs = self._output_layer_confs()
@@ -249,7 +252,7 @@ class ComputationGraph:
         # position, NOT by position in the (filtered) out_confs dict
         out_pos = {n: self.conf.network_outputs.index(n) for n in out_names}
 
-        def step(params, state, opts, xs, ys, fmasks, lmasks, it, rng):
+        def grad_step(params, state, xs, ys, fmasks, lmasks, rng):
             xs_c, fmasks_c = policy.cast_to_compute((xs, fmasks))
 
             def loss_fn(p):
@@ -270,6 +273,16 @@ class ComputationGraph:
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            return score, new_states, grads
+
+        return grad_step
+
+    def _build_step_raw(self):
+        grad_step = self._build_grad_raw()
+
+        def step(params, state, opts, xs, ys, fmasks, lmasks, it, rng):
+            score, new_states, grads = grad_step(params, state, xs, ys,
+                                                 fmasks, lmasks, rng)
             new_params, new_opts = self._apply_updates(params, opts, grads, it)
             return new_params, new_states, new_opts, score
 
@@ -360,11 +373,26 @@ class ComputationGraph:
         pallas_helpers.ensure_validated()
         self._check_trace_token()
         self._ensure_sharding()
+        # elastic cluster training (conf.distributed(...)) — same
+        # contract as MultiLayerNetwork.fit: batches route through the
+        # coordinator barrier step; inert without a coordinator
+        if getattr(self, "_dist_session", None) is None \
+                and getattr(self.conf.global_conf, "dist_enabled", False):
+            from deeplearning4j_tpu import distributed as dist_mod
+            self._dist_session = dist_mod.maybe_session(
+                self.conf.global_conf)
+        dist_sess = getattr(self, "_dist_session", None)
+        if dist_sess is not None:
+            dist_sess.attach(self)
+            fuse = 1   # the distributed step barriers per batch
         # crash-safe resume (conf.fault_tolerance(resume=True)) — same
         # contract as MultiLayerNetwork.fit: restore the newest valid
         # checkpoint, then skip the already-trained epochs/batches
         from deeplearning4j_tpu.nn import checkpoint as ckpt_mod
         skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
+        if dist_sess is not None:
+            skip_epochs, skip_batches = dist_sess.resume_position(
+                self, skip_epochs, skip_batches)
         if isinstance(data, MultiDataSet):
             batches = [data]
             with sanitizer.armed_fit(self), \
@@ -601,6 +629,7 @@ class ComputationGraph:
             self._rnn_step_fn = None
             self._ext_grad_fn = self._apply_fn = None
             self._score_ex_fn = None
+            self._dist_cache = None
             self._fused_fns = None
             self.compile_telemetry.invalidate()
 
@@ -655,6 +684,12 @@ class ComputationGraph:
         if self.conf.backprop_type == "truncatedbptt" \
                 and any(f.ndim == 3 for f in mds.features):
             self._fit_tbptt(mds)
+            return
+        dist_sess = getattr(self, "_dist_session", None)
+        if dist_sess is not None:
+            # cluster step — see MultiLayerNetwork._fit_batch
+            from deeplearning4j_tpu.distributed import worker as dist_worker
+            dist_worker.fit_batch(self, mds, dist_sess, is_graph=True)
             return
         self._check_trace_token()
         if self._step_fn is None:

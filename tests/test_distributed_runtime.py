@@ -1,0 +1,473 @@
+"""Elastic distributed runtime tests (deeplearning4j_tpu/distributed/ —
+docs/DISTRIBUTED.md): coordinator protocol units (leases, generation
+fencing, breaker re-admission, snapshot relay), in-process thread-worker
+clusters (parity vs a single-host twin, fault-injected preemption,
+zombie eviction + resync, absorption of a joiner), checkpoint restore
+across process counts, and conf plumbing."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.distributed import (
+    Coordinator, DistSession, WorkerEvictedError, shard_bounds)
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.network import (
+    GlobalConf, MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# Coordinator protocol units (injected clock — no real waiting)
+# ----------------------------------------------------------------------
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _form(co, ids):
+    for w in ids:
+        assert co.join(w)["admitted"]
+    out = {}
+    for w in ids:
+        out[w] = co.sync_done(w)
+    return out
+
+
+def test_formation_assigns_ranks_in_join_order():
+    clk = Clock()
+    co = Coordinator(expected=3, lease_ms=1000, clock=clk)
+    placements = _form(co, ["wa", "wb", "wc"])
+    assert co.generation == 1
+    assert placements["wc"]["world"] == 3
+    ranks = {w: co.placement(w)["rank"] for w in ("wa", "wb", "wc")}
+    assert ranks == {"wa": 0, "wb": 1, "wc": 2}
+
+
+def test_lease_suspect_then_recover():
+    clk = Clock()
+    co = Coordinator(expected=2, lease_ms=1000, suspect_grace_ms=1000,
+                     clock=clk)
+    _form(co, ["wa", "wb"])
+    clk.t = 1.5     # wb misses its lease
+    co.heartbeat("wa")
+    assert co.placement("wb")["state"] == "suspect"
+    assert co.generation == 1            # suspicion alone never rolls
+    co.heartbeat("wb")                   # recovery
+    assert co.placement("wb")["state"] == "active"
+    assert co.generation == 1
+
+
+def test_lease_death_rolls_generation_and_reranks():
+    clk = Clock()
+    co = Coordinator(expected=2, lease_ms=1000, suspect_grace_ms=500,
+                     clock=clk)
+    _form(co, ["wa", "wb"])
+    clk.t = 0.9
+    co.heartbeat("wa")          # wa's lease renewed to 1.9
+    clk.t = 1.6     # wb: lease (1.0) + grace (0.5) both lapsed
+    co.heartbeat("wa")
+    assert co.generation == 2
+    p = co.placement("wa")
+    assert (p["world"], p["rank"]) == (1, 0)
+    assert co.placement("wb")["state"] == "dead"
+
+
+def test_generation_fencing_rejects_stale_generation():
+    clk = Clock()
+    co = Coordinator(expected=2, lease_ms=1000, clock=clk)
+    _form(co, ["wa", "wb"])
+    co.leave("wb")              # roll to generation 2
+    resp = co.allreduce("wa", generation=1, step=1, weight=1.0,
+                        vec=np.ones(3, np.float32))
+    assert resp.get("rolled") and resp["generation"] == 2
+    # nothing was merged: the correct-generation barrier still completes
+    ok = co.allreduce("wa", generation=2, step=1, weight=2.0,
+                      vec=np.full(3, 5.0, np.float32))
+    assert ok["step"] == 1
+    np.testing.assert_allclose(ok["vec"], 5.0)
+
+
+def test_step_fencing_rejects_desynced_steps():
+    clk = Clock()
+    co = Coordinator(expected=1, lease_ms=1000, clock=clk)
+    _form(co, ["wa"])
+    co.allreduce("wa", 1, 1, 1.0, np.zeros(2, np.float32))
+    stale = co.allreduce("wa", 1, 1, 1.0, np.zeros(2, np.float32))
+    assert stale.get("stale_step") and stale["committed"] == 1
+    ahead = co.allreduce("wa", 1, 5, 1.0, np.zeros(2, np.float32))
+    assert ahead.get("stale_step")
+
+
+def test_fresh_coordinator_adopts_checkpoint_resumed_step():
+    clk = Clock()
+    co = Coordinator(expected=1, lease_ms=1000, clock=clk)
+    _form(co, ["wa"])
+    # a cluster restarted from a checkpoint at iteration 6 submits 7
+    ok = co.allreduce("wa", 1, 7, 1.0, np.ones(2, np.float32))
+    assert ok["step"] == 7 and co.step == 7
+
+
+def test_weighted_reduce_in_rank_order():
+    clk = Clock()
+    co = Coordinator(expected=2, lease_ms=1000, clock=clk)
+    _form(co, ["wa", "wb"])
+    out = {}
+
+    def contribute(w, weight, val):
+        out[w] = co.allreduce(w, 1, 1, weight,
+                              np.full(2, val, np.float32))
+
+    t1 = threading.Thread(target=contribute, args=("wa", 3.0, 1.0))
+    t1.start()
+    time.sleep(0.05)
+    contribute("wb", 1.0, 5.0)
+    t1.join(30)
+    expect = (3.0 * 1.0 + 1.0 * 5.0) / 4.0
+    np.testing.assert_allclose(out["wa"]["vec"], expect)
+    np.testing.assert_allclose(out["wb"]["vec"], expect)
+    assert out["wa"]["weight"] == 4.0
+
+
+def test_breaker_refuses_flapping_worker_then_readmits():
+    clk = Clock()
+    co = Coordinator(expected=2, lease_ms=100, suspect_grace_ms=100,
+                     breaker={"min_calls": 2, "window": 4,
+                              "cooldown_s": 5.0},
+                     clock=clk)
+    _form(co, ["wa", "wb"])
+    for _ in range(2):          # wb dies twice in quick succession
+        clk.t += 0.3
+        co.heartbeat("wa")      # sweep: wb lease+grace lapsed -> dead
+        assert co.placement("wb")["state"] == "dead"
+        resp = co.join("wb")    # respawn rejoins...
+        if resp["admitted"]:
+            co.sync_done("wb")
+    refused = co.join("wb")
+    assert not refused["admitted"]
+    assert refused["reason"] == "breaker_open"
+    assert refused["retry_after_s"] > 0
+    clk.t += 10.0               # cooldown passes: probe admitted
+    again = co.join("wb")
+    assert again["admitted"], again
+
+
+def test_snapshot_relay_activates_joiner_atomically():
+    clk = Clock()
+    co = Coordinator(expected=1, lease_ms=1000, clock=clk)
+    _form(co, ["wa"])
+    co.allreduce("wa", 1, 1, 1.0, np.zeros(2, np.float32))
+    resp = co.join("wb")
+    assert resp["admitted"] and resp["await_snapshot"]
+    assert co.get_snapshot("wb", min_step=1) is None   # nothing yet
+    # rank 0 is asked to upload on its next barrier
+    nxt = co.allreduce("wa", 1, 2, 1.0, np.zeros(2, np.float32))
+    assert nxt["upload_state"]
+    co.put_snapshot("wa", 2, np.arange(4, dtype=np.float32),
+                    None, {"epoch": 0, "iteration_in_epoch": 2})
+    # the upload activated the joiner and rolled — committed step frozen
+    assert co.generation == 2
+    assert co.placement("wb")["state"] == "active"
+    snap = co.get_snapshot("wb", min_step=1)
+    assert snap["step"] == 2
+    np.testing.assert_allclose(snap["params"], np.arange(4))
+    # both now barrier step 3 together
+    done = {}
+    t = threading.Thread(target=lambda: done.setdefault(
+        "wa", co.allreduce("wa", 2, 3, 1.0, np.ones(2, np.float32))))
+    t.start()
+    done["wb"] = co.allreduce("wb", 2, 3, 1.0, np.ones(2, np.float32))
+    t.join(30)
+    assert done["wa"]["step"] == done["wb"]["step"] == 3
+
+
+def test_shard_bounds_cover_every_row_once():
+    for n in (1, 7, 16, 33):
+        for world in (1, 2, 3, 5):
+            spans = [shard_bounds(n, world, r) for r in range(world)]
+            rows = [i for lo, hi in spans for i in range(lo, hi)]
+            assert rows == list(range(n)), (n, world, spans)
+
+
+# ----------------------------------------------------------------------
+# Thread-worker clusters (in-process: one jax runtime, N sessions)
+# ----------------------------------------------------------------------
+def _mln_conf(dist=True, **dist_kw):
+    b = (NeuralNetConfiguration.builder().seed(99).learning_rate(0.05)
+         .updater("adam"))
+    if dist:
+        b.distributed(processes=dist_kw.pop("processes", 2), **dist_kw)
+    return (b.list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .build())
+
+
+def _batches(n=8, rows=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(rows, 4)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, rows)])
+            for _ in range(n)]
+
+
+def _run_cluster(co, n, batches, epochs=1, make_net=None, extra=(),
+                 slow_s=0.0, ckpt_dirs=None):
+    """N worker threads (own model each) against one coordinator;
+    returns ({worker: final params}, [(worker, exc type)] for died)."""
+    results, died = {}, []
+
+    def make_default():
+        return MultiLayerNetwork(_mln_conf()).init()
+
+    class SlowIter(ListDataSetIterator):
+        def next(self):
+            if slow_s:
+                time.sleep(slow_s)
+            return super().next()
+
+    def work(wid, delay=0.0):
+        try:
+            if delay:
+                time.sleep(delay)
+            net = (make_net or make_default)()
+            if ckpt_dirs and wid in ckpt_dirs:
+                from deeplearning4j_tpu.nn.checkpoint import (
+                    CheckpointListener)
+                net.add_listener(CheckpointListener(
+                    ckpt_dirs[wid], save_every_n_iterations=2))
+            sess = DistSession(co, wid, heartbeat_ms=60)
+            sess.connect()
+            net._dist_session = sess
+            net.fit(SlowIter(list(batches)), epochs=epochs)
+            results[wid] = np.asarray(net.params())
+            sess.close()
+        except BaseException as e:  # noqa: BLE001 — chaos kills ride here
+            died.append((wid, type(e).__name__))
+
+    threads = [threading.Thread(target=work, args=(f"w{i}",))
+               for i in range(n)]
+    threads += [threading.Thread(target=work, args=(wid, delay))
+                for wid, delay in extra]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+        assert not t.is_alive(), "cluster worker thread hung"
+    return results, died
+
+
+def test_thread_cluster_matches_single_host_mln():
+    ref = MultiLayerNetwork(_mln_conf(dist=False)).init()
+    ref.fit(ListDataSetIterator(_batches()), epochs=2)
+    ref_p = np.asarray(ref.params())
+    co = Coordinator(expected=2, lease_ms=800)
+    results, died = _run_cluster(co, 2, _batches(), epochs=2)
+    assert not died, died
+    np.testing.assert_array_equal(results["w0"], results["w1"])
+    np.testing.assert_allclose(results["w0"], ref_p, atol=1e-6)
+    assert co.status()["step"] == 16
+
+
+def test_thread_cluster_matches_single_host_cg():
+    def g(dist):
+        gc = GlobalConf(seed=7, learning_rate=0.05, updater="sgd")
+        if dist:
+            gc.dist_enabled = True
+            gc.dist_processes = 2
+        return gc
+
+    def conf(dist):
+        return (GraphBuilder(g(dist))
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=8,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                              activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .build())
+
+    mds = [MultiDataSet([b.features], [b.labels])
+           for b in _batches(6)]
+    ref = ComputationGraph(conf(False)).init()
+    for m in mds:
+        ref.fit(m)
+    ref_p = np.asarray(ref.params())
+
+    co = Coordinator(expected=2, lease_ms=800)
+    results, errs = {}, []
+
+    def work(wid):
+        try:
+            net = ComputationGraph(conf(True)).init()
+            sess = DistSession(co, wid, heartbeat_ms=60)
+            sess.connect()
+            net._dist_session = sess
+            for m in mds:
+                net.fit(m)
+            results[wid] = np.asarray(net.params())
+            sess.close()
+        except BaseException as e:  # noqa: BLE001
+            errs.append((wid, repr(e)))
+
+    ts = [threading.Thread(target=work, args=(f"w{i}",))
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    assert not errs, errs
+    np.testing.assert_array_equal(results["w0"], results["w1"])
+    np.testing.assert_allclose(results["w0"], ref_p, atol=1e-6)
+
+
+def test_elastic_kill_midepoch_and_absorb_joiner():
+    """The headline elastic path, in-process: a fault-injected worker
+    kill mid-epoch (dist.worker, mode=kill) shrinks the cluster to one
+    survivor which finishes the SAME run; a replacement worker joining
+    mid-stream absorbs the survivors' in-memory snapshot.  Every
+    finisher matches the uninterrupted single-host twin ≤1e-6."""
+    ref = MultiLayerNetwork(_mln_conf(dist=False)).init()
+    ref.fit(ListDataSetIterator(_batches(10)), epochs=1)
+    ref_p = np.asarray(ref.params())
+
+    faults.arm({"site": "dist.worker", "mode": "kill", "on_call": 6,
+                "max_injections": 1})
+    co = Coordinator(expected=2, lease_ms=300)
+    results, died = _run_cluster(
+        co, 2, _batches(10), slow_s=0.05,
+        extra=[("w9", 1.0)])      # the replacement joins ~step 4-8
+    assert [k for k, e in died if e == "ThreadKill"], died
+    assert len(died) == 1, died
+    survivors = set(results)
+    assert len(survivors) == 2, results   # one original + the joiner
+    assert "w9" in survivors
+    for wid, p in results.items():
+        np.testing.assert_allclose(p, ref_p, atol=1e-6, err_msg=wid)
+    st = co.status()
+    assert st["step"] == 10
+    assert st["generation"] >= 3   # formation + death + absorption
+
+
+def test_heartbeat_kill_makes_zombie_that_resyncs():
+    """dist.heartbeat kill: the step loop survives but the lease lapses
+    — the coordinator evicts the zombie, it re-admits through the
+    breaker, resyncs from the survivors' snapshot, and finishes with
+    full parity (no lost or doubled steps)."""
+    ref = MultiLayerNetwork(_mln_conf(dist=False)).init()
+    ref.fit(ListDataSetIterator(_batches(10)), epochs=1)
+    ref_p = np.asarray(ref.params())
+
+    faults.arm({"site": "dist.heartbeat", "mode": "kill", "on_call": 3,
+                "max_injections": 1})
+    co = Coordinator(expected=2, lease_ms=250,
+                     breaker={"cooldown_s": 0.1})
+    results, died = _run_cluster(co, 2, _batches(10), slow_s=0.05)
+    assert not died, died
+    assert set(results) == {"w0", "w1"}
+    for wid, p in results.items():
+        np.testing.assert_allclose(p, ref_p, atol=1e-6, err_msg=wid)
+    reg_status = co.status()
+    assert reg_status["step"] == 10
+    assert reg_status["generation"] >= 3   # eviction + re-absorption
+
+
+def test_checkpoint_restore_across_process_counts(tmp_path):
+    """A checkpointed 2-worker run resumed by a 1-worker cluster (fresh
+    coordinator): the manifest's replay-skip + the coordinator's
+    step-adoption continue the run to single-host parity — checkpoints
+    are portable across world sizes."""
+    batches = _batches(8)
+    ref = MultiLayerNetwork(_mln_conf(dist=False)).init()
+    ref.fit(ListDataSetIterator(list(batches)), epochs=2)
+    ref_p = np.asarray(ref.params())
+
+    def make_net():
+        conf = _mln_conf()
+        conf.global_conf.ft_resume = True
+        return MultiLayerNetwork(conf).init()
+
+    dirs = {"w0": str(tmp_path / "w0"), "w1": str(tmp_path / "w1")}
+    co = Coordinator(expected=2, lease_ms=800)
+    results, died = _run_cluster(co, 2, batches, epochs=1,
+                                 make_net=make_net, ckpt_dirs=dirs)
+    assert not died, died
+
+    # restart as a 1-worker cluster from w0's checkpoints, epochs=2:
+    # epoch 0 replay-skips, epoch 1 trains at world=1
+    def make_resumed():
+        conf = _mln_conf(processes=1)
+        conf.global_conf.ft_resume = True
+        conf.global_conf.ft_checkpoint_dir = dirs["w0"]
+        return MultiLayerNetwork(conf).init()
+
+    co2 = Coordinator(expected=1, lease_ms=800)
+    results2, died2 = _run_cluster(co2, 1, batches, epochs=2,
+                                   make_net=make_resumed)
+    assert not died2, died2
+    np.testing.assert_allclose(results2["w0"], ref_p, atol=1e-6)
+    # the manifest recorded the cluster placement it was written under
+    from deeplearning4j_tpu.nn.checkpoint import read_manifest
+    entries = read_manifest(dirs["w0"])
+    assert entries and entries[-1].get("dist", {}).get("world") == 2
+
+
+# ----------------------------------------------------------------------
+# Conf plumbing
+# ----------------------------------------------------------------------
+def test_dist_conf_inert_without_coordinator():
+    """conf.distributed() with no coordinator reachable degrades to
+    plain single-process fit — byte-identical params."""
+    plain = MultiLayerNetwork(_mln_conf(dist=False)).init()
+    plain.fit(ListDataSetIterator(_batches(4)), epochs=1)
+    dist = MultiLayerNetwork(_mln_conf()).init()
+    dist.fit(ListDataSetIterator(_batches(4)), epochs=1)
+    np.testing.assert_array_equal(np.asarray(plain.params()),
+                                  np.asarray(dist.params()))
+
+
+def test_dist_conf_serde_roundtrip():
+    conf = _mln_conf(processes=4, coordinator="http://10.0.0.1:4711",
+                     heartbeat_ms=125.0, lease_ms=999.0)
+    doc = conf.to_dict()
+    back = MultiLayerConfiguration.from_dict(doc)
+    g = back.global_conf
+    assert g.dist_enabled and g.dist_processes == 4
+    assert g.dist_coordinator == "http://10.0.0.1:4711"
+    assert g.dist_heartbeat_ms == 125.0 and g.dist_lease_ms == 999.0
+    # legacy configs (no dist fields) still load with inert defaults
+    legacy = dict(doc)
+    legacy["global"] = {k: v for k, v in doc["global"].items()
+                       if not k.startswith("dist_")}
+    g2 = MultiLayerConfiguration.from_dict(legacy).global_conf
+    assert not g2.dist_enabled and g2.dist_processes == 0
+
+
+def test_dist_metrics_families_registered():
+    from deeplearning4j_tpu import monitor
+    snap = monitor.get_registry().snapshot()
+    for fam in ("dl4j_dist_generation", "dl4j_dist_members",
+                "dl4j_dist_generation_rolls_total",
+                "dl4j_dist_allreduce_total",
+                "dl4j_dist_allreduce_seconds",
+                "dl4j_dist_evictions_total", "dl4j_dist_rejoins_total",
+                "dl4j_dist_snapshot_transfers_total"):
+        assert fam in snap, fam

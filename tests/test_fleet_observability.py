@@ -526,3 +526,123 @@ def test_warmup_spec_eliminates_cold_compiles(model_path):
         assert after == before, (before, after)
     finally:
         ep.close()
+
+
+# ----------------------------------------------------------------------
+# SLO alert delivery (the webhook/command sink satellite)
+# ----------------------------------------------------------------------
+def _drive_flip(tracker):
+    """ok → warning → burning → warning on one availability objective."""
+    t0 = 5000.0
+    tracker.evaluate(_avail_snap(100, 0), now=t0)
+    tracker.evaluate(_avail_snap(197, 3), now=t0 + 1)
+    tracker.evaluate(_avail_snap(197, 103), now=t0 + 2)
+    tracker.evaluate(_avail_snap(1197, 103), now=t0 + 6)
+
+
+def _alert_objective():
+    return Objective("avail_alert", "availability", 0.99,
+                     good_family="dl4j_t_good_total",
+                     bad_family="dl4j_t_bad_total",
+                     fast_window_s=2.0, slow_window_s=10.0)
+
+
+def test_slo_alert_sink_callable_gets_every_flip():
+    got = []
+    tr = SloTracker([_alert_objective()], flight_dump=False,
+                    alert_sink=got.append)
+    _drive_flip(tr)
+    assert [(p["old"], p["new"]) for p in got] == [
+        ("ok", "warning"), ("warning", "burning"),
+        ("burning", "warning")]
+    p = got[1]
+    assert p["kind"] == "slo.state_changed"
+    assert p["objective"] == "avail_alert" and p["burn_fast"] > 14.4
+    # delivery journaled and metered
+    outs = [e["outcome"] for e in events.get_journal().tail(
+        etype="slo.alert_delivered")
+        if e.get("objective") == "avail_alert"]
+    assert outs.count("delivered") == 3
+
+
+def test_slo_alert_webhook_retries_then_delivers_and_meters():
+    """A webhook that fails its first hit per alert delivers via the
+    RetryPolicy; an unreachable one counts outcome=failed after the
+    retries — the evaluator never wedges."""
+    import http.server
+    import threading
+
+    hits = {"n": 0}
+    bodies = []
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            hits["n"] += 1
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            if hits["n"] % 2 == 1:      # first attempt of each alert 500s
+                self.send_response(500)
+                self.end_headers()
+                return
+            bodies.append(json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        from deeplearning4j_tpu.resilience.policy import RetryPolicy
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/alert"
+        tr = SloTracker([_alert_objective()], flight_dump=False,
+                        alert_sink=url,
+                        alert_retry=RetryPolicy(max_attempts=3,
+                                                base_delay_ms=1,
+                                                name="slo-alert-test"))
+        reg = monitor.get_registry()
+        fam = reg.counter("dl4j_slo_alerts_total",
+                          "SLO state-change alerts by delivery outcome "
+                          "(delivered / failed)", ("outcome",))
+        before_ok = fam.labels(outcome="delivered").value
+        _drive_flip(tr)
+        assert len(bodies) == 3, (hits, bodies)
+        assert bodies[0]["new"] == "warning"
+        assert fam.labels(outcome="delivered").value - before_ok == 3
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # unreachable webhook: outcome=failed, evaluation survives
+    from deeplearning4j_tpu.resilience.policy import RetryPolicy
+    tr2 = SloTracker([_alert_objective()], flight_dump=False,
+                     alert_sink="http://127.0.0.1:9/nope",
+                     alert_retry=RetryPolicy(max_attempts=2,
+                                             base_delay_ms=1,
+                                             name="slo-alert-dead"))
+    reg = monitor.get_registry()
+    fam = reg.counter("dl4j_slo_alerts_total",
+                      "SLO state-change alerts by delivery outcome "
+                      "(delivered / failed)", ("outcome",))
+    before_fail = fam.labels(outcome="failed").value
+    _drive_flip(tr2)
+    assert fam.labels(outcome="failed").value - before_fail == 3
+
+
+def test_slo_alert_sink_resolution(monkeypatch):
+    assert slo_mod.resolve_alert_sink(None) is None
+    monkeypatch.setenv("DL4J_SLO_WEBHOOK", "http://example.invalid/hook")
+    sink = slo_mod.resolve_alert_sink(None)
+    assert callable(sink)
+    fn = lambda p: None  # noqa: E731
+    assert slo_mod.resolve_alert_sink(fn) is fn
+    # command sinks get the payload on stdin
+    monkeypatch.delenv("DL4J_SLO_WEBHOOK")
+    cmd = slo_mod.resolve_alert_sink("cmd:cat > /dev/null")
+    cmd({"kind": "slo.state_changed"})   # exit 0 == delivered
+    from deeplearning4j_tpu.resilience.errors import TransientError
+    bad = slo_mod.resolve_alert_sink("cmd:exit 3")
+    with pytest.raises(TransientError):
+        bad({"kind": "slo.state_changed"})

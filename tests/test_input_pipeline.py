@@ -501,6 +501,7 @@ def test_bench_dry_run_emits_record_on_cpu():
     assert "bench_sharded" in rec["configs"]
     assert "bench_fleet" in rec["configs"]
     assert "bench_spec" in rec["configs"]
+    assert "bench_elastic" in rec["configs"]
     assert rec.get("machine", {}).get("host"), "machine fingerprint missing"
     assert "metrics_registry" in rec
     # the dry run also gates dl4j-lint: zero unsuppressed findings
