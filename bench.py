@@ -1568,10 +1568,22 @@ def bench_spec():
     token + K n-gram drafts and commits the agreeing prefix) against
     plain one-token-per-dispatch greedy decode.  Exact same emitted
     tokens (greedy parity is exact by construction); reports dispatches
-    per accepted token, acceptance rate, and wall-clock tokens/sec."""
+    per accepted token, acceptance rate, and wall-clock tokens/sec.
+
+    Leg C — **resident-tokens axis** (ISSUE 16): paged KV arena vs
+    dense per-slot rings at FIXED KV HBM.  The dense pool pre-commits a
+    worst-case ``max_slots x window`` rectangle, so its admission limit
+    is slot count no matter how short the streams are; the paged pool
+    holds the same token budget in a shared arena and admits by tokens
+    actually resident.  A mixed short/long session load is pushed into
+    both until they shed; reports sessions admitted (paged/dense must
+    be >= 2x), aggregate tokens/sec while filling, and the paged
+    pool's own per-token flat ratio (256/64 <= 1.2 — paging must not
+    reintroduce O(T) steps)."""
     from deeplearning4j_tpu.nn.conf import layers as L
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.resilience.errors import OverloadedError
     from deeplearning4j_tpu.server.decode import DecodePool
     from deeplearning4j_tpu.server.speculative import (
         NGramDraft, SpeculativeDecoder, one_hot)
@@ -1680,6 +1692,88 @@ def bench_spec():
                 "spec": st.get("spec_programs", 0)}
     pool.stop()
 
+    # --- leg C1: paged pool per-token flatness.  Same token-by-token
+    # loop as leg A2, but the KV carry is block tables into the shared
+    # arena — the ratio proves block-table indirection stays O(window).
+    ppool = DecodePool(net, name="bench_spec_pgflat", max_slots=K,
+                       max_wait_ms=5.0, min_batch=K, kv_paged=True,
+                       kv_block=16, kv_arena_tokens=(K + 1) * T)
+    sids = [ppool.open_session() for _ in range(K)]
+    tok["t"] = 0
+
+    def pstep_round():
+        t = tok["t"]
+        futs = [ppool.submit_step(sid, x[i, t % T:t % T + 1])
+                for i, sid in enumerate(sids)]
+        for f in futs:
+            f.result(timeout=120)
+        tok["t"] += 1
+
+    pstep_round()   # compile off-clock
+    pcached = {}
+    prev = 1
+    for p in CHECKPOINTS:
+        n = p - prev
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pstep_round()
+        pcached[str(p)] = {"per_token_ms":
+                           round((time.perf_counter() - t0) / n * 1e3, 3)}
+        prev = p
+    pflat = (pcached[str(CHECKPOINTS[-1])]["per_token_ms"]
+             / max(pcached[str(CHECKPOINTS[0])]["per_token_ms"], 1e-9))
+    for sid in sids:
+        ppool.close_session(sid)
+    ppool.stop()
+
+    # --- leg C2: admission at fixed KV HBM.  Dense baseline: 4 slots x
+    # the full T=256 window (1024 tokens pre-committed whether streams
+    # use them or not).  Paged: the SAME 1024-token budget as a shared
+    # arena.  The load is mixed — every 4th session streams the full
+    # window, the rest stop at 32 tokens — so the paged pool's 64
+    # blocks go 16+2+2+2 per cycle instead of 4x16.
+    S_DENSE, SHORT, CHUNK = 4, 32, 32
+    ARENA_TOKENS = S_DENSE * T
+
+    def admit_mixed(p):
+        """Open+stream sessions until the pool sheds; a session counts
+        only when its whole stream landed.  Returns (admitted sids,
+        tokens streamed, wall seconds)."""
+        warm = p.open_session()          # compile the chunk rung
+        p.step(warm, x[0, :CHUNK])       # off-clock
+        p.close_session(warm)
+        admitted, toks = [], 0
+        t0 = time.perf_counter()
+        for i in range(64):
+            ln = T if i % 4 == 0 else SHORT
+            try:
+                sid = p.open_session()
+            except OverloadedError:
+                break
+            try:
+                for c0 in range(0, ln, CHUNK):
+                    p.step(sid, x[i % K, c0:c0 + CHUNK])
+            except OverloadedError:
+                p.close_session(sid)     # shed mid-stream: not admitted
+                break
+            admitted.append(sid)
+            toks += ln
+        return admitted, toks, time.perf_counter() - t0
+
+    dpool = DecodePool(net, name="bench_spec_dense", max_slots=S_DENSE,
+                       max_wait_ms=2.0, min_batch=1)
+    adm_d, toks_d, dt_d = admit_mixed(dpool)
+    dense_kv = dpool.stats().get("kv_cache")
+    dpool.stop()
+
+    apool = DecodePool(net, name="bench_spec_paged", max_slots=48,
+                       max_wait_ms=2.0, min_batch=1, kv_paged=True,
+                       kv_block=16, kv_arena_tokens=ARENA_TOKENS)
+    adm_p, toks_p, dt_p = admit_mixed(apool)
+    arena_kv = apool.stats().get("kv_arena")
+    apool.stop()
+    admit_ratio = len(adm_p) / max(len(adm_d), 1)
+
     tokens_per_dispatch = N_GEN / max(disp_on, 1)
     return {
         "metric": "speculative greedy decode, accepted tokens per "
@@ -1701,6 +1795,20 @@ def bench_spec():
         "pool_spec_counters": spec_stats,
         "compiled_programs": programs,
         "kv_cache": st.get("kv_cache"),
+        "paged": {
+            "kv_hbm_tokens": ARENA_TOKENS,
+            "dense_sessions_admitted": len(adm_d),
+            "paged_sessions_admitted": len(adm_p),
+            "session_admit_ratio": round(admit_ratio, 2),
+            "meets_2x_sessions_target": admit_ratio >= 2.0,
+            "dense_fill_tokens_per_sec": round(toks_d / max(dt_d, 1e-9), 1),
+            "paged_fill_tokens_per_sec": round(toks_p / max(dt_p, 1e-9), 1),
+            "paged_per_token_ms": pcached,
+            "paged_flat_ratio_256_over_64": round(pflat, 3),
+            "paged_flat": pflat <= 1.2,
+            "dense_kv_cache": dense_kv,
+            "kv_arena": arena_kv,
+        },
     }
 
 

@@ -188,6 +188,88 @@ class CheckKVDecodePool(DecodePool):
         self._step_jit = _kv_pool_step
 
 
+def _paged_stub_step(params, state, pool, idx, fresh, xs, fms,
+                     arenas, tbls):
+    """Pure-host stand-in for the PAGED pool step, keeping its exact
+    contract: counting carry plus a paged-KV node (``aid``/``pos``/
+    ``tbl``) whose write position advances one token per step and whose
+    table row is the dispatch's host-built block table — so the real
+    allocator (admission, close/TTL frees, migration re-page) drives
+    the real arena invariants at every scheduling point."""
+    h = np.asarray(pool["h"])
+    pos = np.asarray(pool["rnn"]["pos"])
+    tbl = np.asarray(pool["rnn"]["tbl"])
+    idx = np.asarray(idx)
+    fresh = np.asarray(fresh)
+    g = h[idx] * (1.0 - fresh)[:, None]
+    gpos = (pos[idx] * (1.0 - fresh)).astype(np.int32)
+    newh = g + 1.0
+    newpos = gpos + 1
+    x = np.asarray(xs[0])
+    if x.ndim >= 3:
+        out = np.repeat(newh[:, None, :], x.shape[1], axis=1)
+    else:
+        out = newh
+    h2 = h.copy()
+    h2[idx] = newh
+    pos2 = pos.copy()
+    pos2[idx] = newpos
+    tbl2 = tbl.copy()
+    tbl2[idx] = np.asarray(tbls[0])
+    import jax.numpy as jnp
+    new_pool = {"h": jnp.asarray(h2),
+                "rnn": {"aid": pool["rnn"]["aid"],
+                        "pos": jnp.asarray(pos2),
+                        "tbl": jnp.asarray(tbl2)}}
+    return (out,), new_pool, arenas
+
+
+class CheckPagedDecodePool(DecodePool):
+    """DecodePool with ``kv_paged`` on and the device compute stubbed —
+    the block allocator, token admission, close/TTL frees, the
+    de-page/re-page migration halves and the crash resets are all the
+    parent's REAL code; only the jitted step is the host stand-in."""
+
+    def __init__(self, *args, arena_blocks: int = 3, window: int = 8,
+                 **kw):
+        kw.setdefault("kv_paged", True)
+        kw.setdefault("kv_block", 4)
+        self._arena_nb = max(1, int(arena_blocks))
+        self._window = int(window)
+        super().__init__(*args, **kw)
+
+    def _ensure_device_state(self, tails, dtype) -> None:
+        if self._pool is not None:
+            return
+        import jax.numpy as jnp
+        n = self.max_slots + 1
+        bs = self.kv_block
+        nbs = -(-self._window // bs)
+        nb = self._arena_nb
+        self._pool = {
+            "h": jnp.zeros((n, 1), np.float32),
+            "rnn": {"aid": jnp.zeros((n, 1), np.int32),
+                    "pos": jnp.zeros((n,), np.int32),
+                    "tbl": jnp.full((n, nbs), nb, np.int32)},
+        }
+        self._tails = tuple(tuple(t[1:]) for t in tails)
+        self._dtype = np.dtype(np.float32)
+        self._step_jit = _paged_stub_step
+        with self._cond:
+            self._arenas = ({"k": jnp.zeros((nb + 1, 1, bs, 1),
+                                            np.float32),
+                             "v": jnp.zeros((nb + 1, 1, bs, 1),
+                                            np.float32)},)
+            self._arena_specs = ({"heads": 1, "head_dim": 1,
+                                  "window": self._window,
+                                  "window_eff": nbs * bs,
+                                  "blocks_per_slot": nbs,
+                                  "dtype": "float32"},)
+            self._arena_blocks = (nb,)
+            self._kv_free = [list(range(nb))]
+            self._update_arena_gauges_locked()
+
+
 def _x():
     return np.zeros((1, 1), np.float32)
 
@@ -383,6 +465,122 @@ def scenario_kv_migration(ctx: Context) -> None:
         assert not errors, errors
         assert results == [1.0, 2.0, 3.0, 4.0], \
             f"kv carry broke across the migration: {results}"
+    finally:
+        src.stop(timeout=30.0)
+        dst.stop(timeout=30.0)
+
+
+def scenario_kv_paging(ctx: Context) -> None:
+    """Paged-KV block allocator under concurrent growth, close/TTL
+    frees, exhaustion sheds and a live migration, all through the REAL
+    allocator/admission/re-page code: the ``_arena_probe`` invariants
+    (no block owned by two live sessions, freed blocks return exactly
+    once, held+free conserves the arena) are checked at EVERY
+    scheduling point, and the counting carry pins that the migrated
+    stream's VALUE continued exactly across the de-page/re-page hop."""
+    from deeplearning4j_tpu.server.decode import OverloadedError
+    faults.reset()
+    # src arena: 3 blocks of 4 tokens (window 8 -> up to 2 blocks per
+    # stream) — the grower and the churner genuinely contend; dst
+    # arena: exactly the 2 blocks the migrated stream needs
+    src = CheckPagedDecodePool(_StubModel(), name="chk-pg-src",
+                               max_slots=2, max_wait_ms=0.0,
+                               arena_blocks=3)
+    dst = CheckPagedDecodePool(_StubModel(), name="chk-pg-dst",
+                               max_slots=2, max_wait_ms=0.0,
+                               arena_blocks=2)
+    ctx.watch_pool(src)
+    ctx.watch_pool(dst)
+    _specs.watch_kv_arena(ctx.sched, src)
+    _specs.watch_kv_arena(ctx.sched, dst)
+    try:
+        sid = src.open_session(tenant="t0")
+        loc = {"pool": src}
+        results = []
+        errors = []
+
+        def grower():
+            # streams past one block (5 tokens -> 2 blocks) while the
+            # migration and the churner race it; arena exhaustion is a
+            # legal retryable shed, never a wrong value
+            for _i in range(5):
+                for _try in range(80):
+                    pool = loc["pool"]
+                    try:
+                        out = pool.step(sid, _x(), timeout=60)
+                        results.append(_val(out))
+                        break
+                    except (TransientError, KeyError, OverloadedError):
+                        time.sleep(0.001)
+                else:
+                    errors.append("grower retries exhausted")
+                    return
+
+        def migrator():
+            try:
+                payload = src.export_session(sid, timeout=30)
+            except Exception as e:
+                errors.append(f"export failed: {type(e).__name__}: {e}")
+                return
+            try:
+                dst.import_session(payload)
+            except OverloadedError:
+                src.finish_export(sid, ok=False)   # reinstate at source
+                return
+            except Exception as e:
+                src.finish_export(sid, ok=False)
+                errors.append(f"import failed: {type(e).__name__}: {e}")
+                return
+            loc["pool"] = dst
+            src.finish_export(sid, ok=True)
+
+        def churner():
+            # open -> grow -> close on the source: every close must
+            # return the session's blocks exactly once
+            for _i in range(2):
+                try:
+                    s2 = src.open_session(tenant="t1")
+                except (OverloadedError, RuntimeError):
+                    continue
+                try:
+                    for _s in range(2):
+                        try:
+                            src.step(s2, _x(), timeout=60)
+                        except OverloadedError:
+                            time.sleep(0.001)
+                except (TransientError, KeyError, RuntimeError):
+                    pass
+                finally:
+                    src.close_session(s2)
+
+        def reaper():
+            # the TTL path frees through the same _close_locked: age a
+            # throwaway session far past the deadline, then force the
+            # sweep (deterministic — no wall-clock waits)
+            try:
+                s3 = src.open_session(tenant="t2")
+            except (OverloadedError, RuntimeError):
+                return
+            try:
+                src.step(s3, _x(), timeout=60)
+            except (TransientError, KeyError, OverloadedError,
+                    RuntimeError):
+                pass
+            with src._cond:
+                s = src._sessions.get(s3)
+                if s is not None:
+                    s.last_used = -1e12
+                src._sweep_locked()
+
+        t1 = ctx.thread("grower", grower)
+        t2 = ctx.thread("migrator", migrator)
+        t3 = ctx.thread("churner", churner)
+        t4 = ctx.thread("reaper", reaper)
+        for t in (t1, t2, t3, t4):
+            t.join(120.0)
+        assert not errors, errors
+        assert results == [1.0, 2.0, 3.0, 4.0, 5.0], \
+            f"paged carry broke across the migration: {results}"
     finally:
         src.stop(timeout=30.0)
         dst.stop(timeout=30.0)
@@ -788,6 +986,7 @@ SCENARIOS: Dict[str, Callable[[Context], None]] = {
     "migration": scenario_migration,
     "migration_kill": scenario_migration_kill,
     "kv_migration": scenario_kv_migration,
+    "kv_paging": scenario_kv_paging,
     "batcher_death": scenario_batcher_death,
     "decode_death": scenario_decode_death,
     "drain": scenario_drain,
@@ -801,5 +1000,5 @@ SCENARIOS: Dict[str, Callable[[Context], None]] = {
 #: the scenarios a default checker run gates on (positive controls are
 #: excluded — they exist to prove the checker catches bugs)
 DEFAULT_SCENARIOS = ("migration", "migration_kill", "kv_migration",
-                     "batcher_death", "decode_death", "drain", "breaker",
-                     "dist_membership")
+                     "kv_paging", "batcher_death", "decode_death",
+                     "drain", "breaker", "dist_membership")

@@ -366,3 +366,60 @@ def watch_kv_ring(sched, pool) -> None:
     probes when the pool's carry has no ``kv_pos`` leaf)."""
     w = _KVRingWatch(pool)
     sched.probes.append((f"kv:{pool.name or 'pool'}", w.probe))
+
+
+# ----------------------------------------------------------------------
+# Paged-KV arena invariants (the block allocator behind kv_paged pools:
+# sessions hold disjoint block sets, the free list is exact — every
+# block is either free or held by exactly one live session)
+# ----------------------------------------------------------------------
+def _arena_probe(pool) -> Optional[str]:
+    if not getattr(pool, "_arena_specs", None):
+        return None
+    n_layers = len(pool._arena_specs)
+    free = [list(f) for f in pool._kv_free]
+    held = [[] for _ in range(n_layers)]
+    sessions = list(pool._sessions.values())
+    for s in sessions:
+        if s.kv_blocks is None:
+            continue
+        for li, blks in enumerate(s.kv_blocks):
+            if li >= n_layers:
+                return (f"session {s.sid} holds blocks for layer {li} "
+                        f"but the arena has {n_layers} layers")
+            held[li].extend((s.sid, b) for b in blks)
+    for li in range(n_layers):
+        total = pool._arena_blocks[li]
+        fl = free[li] if li < len(free) else []
+        if len(set(fl)) != len(fl):
+            dupes = sorted(b for b in set(fl) if fl.count(b) > 1)
+            return (f"layer {li}: block(s) {dupes} returned to the "
+                    "free list more than once")
+        bad = sorted(b for b in fl if not 0 <= b < total)
+        if bad:
+            return (f"layer {li}: free-list block(s) {bad} out of "
+                    f"range 0..{total - 1}")
+        owners: Dict[int, str] = {}
+        for sid, b in held[li]:
+            if not 0 <= b < total:
+                return (f"layer {li}: session {sid} holds block {b} "
+                        f"out of range 0..{total - 1}")
+            if b in owners and owners[b] != sid:
+                return (f"layer {li}: block {b} owned by two live "
+                        f"sessions ({owners[b]} and {sid})")
+            owners[b] = sid
+        overlap = sorted(set(owners) & set(fl))
+        if overlap:
+            return (f"layer {li}: block(s) {overlap} both held and on "
+                    "the free list")
+        if len(owners) + len(fl) != total:
+            return (f"layer {li}: {len(owners)} held + {len(fl)} free "
+                    f"!= {total} arena blocks (leaked or conjured)")
+    return None
+
+
+def watch_kv_arena(sched, pool) -> None:
+    """Register the paged-arena allocator invariants for ``pool``
+    (no-op probes until/unless the pool materializes an arena)."""
+    sched.probes.append(
+        (f"arena:{pool.name or 'pool'}", lambda: _arena_probe(pool)))

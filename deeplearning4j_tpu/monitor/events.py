@@ -61,6 +61,7 @@ EVENT_TYPES = (
     "batcher.restarted",
     "decode.step",
     "decode.spec_verified",
+    "decode.arena_alloc_failed",
     "decode.session_opened",
     "decode.session_closed",
     "decode.session_exported",

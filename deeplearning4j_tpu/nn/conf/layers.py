@@ -717,12 +717,43 @@ class SelfAttentionLayer(Layer):
             # is the layer's rnn_state carry, so it lives on device in
             # the decode pool's slot buffer and rides migration.
             W = int(self.cache_window or 128)
-            ring = state.get("rnn_state") if state else None
-            if ring is None:
-                ring = seq_ops.kv_ring_init(B, H, W, Dh, x.dtype)
-            out, ring = seq_ops.attend_cached(q, k, v, ring, key_mask=mask)
-            new_state = dict(state) if state else {}
-            new_state["rnn_state"] = ring
+            tape = seq_ops.paged_tape()
+            if tape is not None:
+                # paged decode: K/V pages live in the pool-shared arena
+                # (drawn from the trace-time tape); the carry holds only
+                # the int32 block table + write position.  `aid` is the
+                # layer's arena id, encoded in the leaf's trailing dim
+                # (shape survives eval_shape templates, values do not)
+                # so export/import can map a carry node back to its
+                # arena without relying on pytree walk order.
+                _, nbs = seq_ops.block_geometry(W, tape.block_size)
+                aid, arena, tbl = tape.next_layer(H, Dh, W, x.dtype)
+                if tbl is None:
+                    tbl = jnp.zeros((B, nbs), jnp.int32)
+                prev = state.get("rnn_state") if state else None
+                pos = (prev["pos"] if isinstance(prev, dict)
+                       and "pos" in prev else jnp.zeros((B,), jnp.int32))
+                if tape.record_undo:
+                    out, pos, arena, journal = seq_ops.attend_paged(
+                        q, k, v, pos, tbl, arena, window=W,
+                        key_mask=mask, undo=True)
+                    tape.put_undo(aid, journal)
+                else:
+                    out, pos, arena = seq_ops.attend_paged(
+                        q, k, v, pos, tbl, arena, window=W, key_mask=mask)
+                tape.put(aid, arena)
+                new_state = dict(state) if state else {}
+                new_state["rnn_state"] = {
+                    "aid": jnp.full((B, aid + 1), aid, jnp.int32),
+                    "pos": pos, "tbl": tbl}
+            else:
+                ring = state.get("rnn_state") if state else None
+                if ring is None:
+                    ring = seq_ops.kv_ring_init(B, H, W, Dh, x.dtype)
+                out, ring = seq_ops.attend_cached(q, k, v, ring,
+                                                  key_mask=mask)
+                new_state = dict(state) if state else {}
+                new_state["rnn_state"] = ring
         else:
             out = seq_ops.attention(q, k, v, causal=self.causal,
                                     key_mask=mask, strategy=self.strategy)

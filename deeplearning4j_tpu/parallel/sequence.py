@@ -124,6 +124,212 @@ def kv_ring_init(batch: int, n_heads: int, window: int, head_dim: int,
     }
 
 
+# ---------------------------------------------------------------------------
+# Paged KV arena (vLLM-style block tables — the serving tier's shared
+# KV pool).  Instead of every stream owning a dense [H, W, D] ring, one
+# pooled [num_blocks, H, block_size, D] arena per attention layer holds
+# ALL streams' K/V pages; each stream carries only an int32 block table
+# mapping its logical ring slots to physical blocks.  Effective decode
+# capacity becomes total tokens RESIDENT across streams instead of the
+# `max_streams x worst-case window` rectangle.
+#
+# The arena is shared state and therefore cannot ride the per-stream
+# carry pytree the way the dense ring does — it is threaded through the
+# compiled step as an explicit (donated) argument.  `PagedTape` is the
+# trace-time conduit between the pool step (which owns the arena
+# arguments) and the attention layers (which discover them mid-forward):
+# the pool step activates a tape via `paged_scope`, each attention layer
+# draws its arena + block-table input from it in encounter order and
+# deposits the updated arena back.  Like `kv_decode_scope`, the tape is
+# read at TRACE time only — it is baked into the compiled program and
+# never consulted per step.
+_PAGED_TAPE = None
+
+
+def block_geometry(window: int, block_size: int):
+    """Round a logical window up to whole blocks: returns ``(w_eff,
+    n_blocks)`` with ``w_eff = n_blocks * block_size >= window``.  The
+    ring arithmetic runs mod ``w_eff`` (every ring slot maps to a fixed
+    offset of a fixed table entry); validity still masks to the logical
+    ``window``."""
+    bs = max(1, int(block_size))
+    nbs = max(1, -(-int(window) // bs))
+    return nbs * bs, nbs
+
+
+class PagedTape:
+    """Trace-time conduit handing attention layers their shared paged-KV
+    arena.  Two modes:
+
+    * **template** (``arenas is None``): active while the pool builds
+      its carry template via ``eval_shape`` — records each layer's arena
+      geometry in ``specs`` (encounter order == arena id) and hands back
+      a dummy 1-block arena so the trace shapes resolve.
+    * **run** (``arenas``/``tables`` given): hands layer ``i`` the real
+      arena tracer ``arenas[i]`` and its block-table input
+      ``tables[i]``; the layer deposits the written arena via
+      :meth:`put` and the pool step collects them with :meth:`collect`.
+    """
+
+    def __init__(self, block_size: int = 16, arenas=None, tables=None,
+                 dtype=None, record_undo: bool = False):
+        self.block_size = max(1, int(block_size))
+        self.dtype = dtype          # storage override (e.g. bf16 arena)
+        self.arenas = None if arenas is None else tuple(arenas)
+        self.tables = None if tables is None else tuple(tables)
+        # speculative verify needs to roll REJECTED writes back out of
+        # the shared arena (it cannot stack the whole arena per step the
+        # way the per-stream carry is stacked) — when set, layers record
+        # each token's overwritten slot contents via put_undo
+        self.record_undo = bool(record_undo)
+        self.specs = []
+        self._out = {}
+        self._undo = {}
+        self._i = 0
+
+    @property
+    def template(self) -> bool:
+        return self.arenas is None
+
+    def next_layer(self, n_heads: int, head_dim: int, window: int,
+                   ref_dtype):
+        """Claim the next arena id (layer encounter order).  Returns
+        ``(aid, arena, tbl)``; in template mode ``tbl`` is ``None`` (the
+        layer zero-fills) and the arena is a dummy."""
+        i = self._i
+        self._i += 1
+        w_eff, nbs = block_geometry(window, self.block_size)
+        dt = self.dtype if self.dtype is not None else ref_dtype
+        if self.template:
+            self.specs.append({
+                "heads": int(n_heads), "head_dim": int(head_dim),
+                "window": int(window), "window_eff": int(w_eff),
+                "blocks_per_slot": int(nbs),
+                "dtype": str(jnp.zeros((), dt).dtype)})
+            dummy = jnp.zeros((2, n_heads, self.block_size, head_dim), dt)
+            return i, {"k": dummy, "v": dummy}, None
+        return i, self.arenas[i], self.tables[i]
+
+    def put(self, aid: int, arena) -> None:
+        if not self.template:
+            self._out[aid] = arena
+
+    def put_undo(self, aid: int, undo) -> None:
+        if not self.template:
+            self._undo[aid] = undo
+
+    def collect(self):
+        """Updated arenas in arena-id order (the pool step's return)."""
+        return tuple(self._out[i] for i in range(self._i))
+
+    def collect_undo(self):
+        """Per-layer undo journals in arena-id order (spec verify)."""
+        return tuple(self._undo[i] for i in range(self._i))
+
+
+@contextlib.contextmanager
+def paged_scope(tape: PagedTape):
+    """Activate ``tape`` for the duration of one trace (the paged
+    analog of ``kv_decode_scope`` — a trace-time regime, never a
+    per-step branch)."""
+    global _PAGED_TAPE  # dl4j: noqa[DL4J103] trace-time regime flag like _KV_DECODE: flipped once around a trace, never per step
+    prev = _PAGED_TAPE
+    _PAGED_TAPE = tape
+    try:
+        yield tape
+    finally:
+        _PAGED_TAPE = prev
+
+
+def paged_tape() -> Optional[PagedTape]:
+    return _PAGED_TAPE
+
+
+def attend_paged(q, k_new, v_new, pos, tbl, arena, *, window: int,
+                 key_mask=None, scale: Optional[float] = None,
+                 undo: bool = False):
+    """Incremental sliding-window attention through a block table — the
+    paged twin of :func:`attend_cached` (same streaming-causal
+    semantics, same masked-pad exactness, same >= f32 accumulation).
+
+    ``pos``: ``[B]`` int32 monotone token count per stream; ``tbl``:
+    ``[B, n_blocks_per_slot]`` int32 physical block ids (entries beyond
+    the allocated prefix point at the arena's scratch block — they are
+    never valid-attendable); ``arena``: ``{"k","v"}`` of
+    ``[num_blocks, H, block_size, D]``.  Token ``t`` writes its K/V at
+    ring slot ``pos % w_eff`` → physical ``(tbl[slot // bs], slot %
+    bs)``, then attends over the gathered ``[H, w_eff, D]`` view with
+    validity masked to the logical ``window``.  Writes are
+    delta-scatter-adds (``old + (new - old) * mask``): masked pad
+    tokens write exactly nothing, and duplicate scratch-block rows
+    (pad/warmup) stay bounded.  Returns ``(out, new_pos, new_arena)``;
+    the arena is storage-dtype (bf16 arenas attend with f32
+    accumulation via ``preferred_element_type``).
+
+    With ``undo=True`` additionally returns a journal of every token's
+    overwritten slot — ``{"pb","o": [Tc,B], "k","v": [Tc,B,H,D]}`` (the
+    pre-write contents) — so speculative verify can restore the shared
+    arena for rejected tokens after acceptance is known."""
+    B, H, Tc, D = q.shape
+    ak, av = arena["k"], arena["v"]
+    bs = ak.shape[2]
+    nbs = tbl.shape[1]
+    w_eff = nbs * bs
+    W = min(int(window), w_eff)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    if key_mask is None:
+        key_mask = jnp.ones((B, Tc), q.dtype)
+    slots = jnp.arange(w_eff)
+    rows = jnp.arange(B)
+
+    def body(carry, inp):
+        ka, va, p = carry
+        q_t, k_t, v_t, m_t = inp          # [B,H,D] x3, [B]
+        w = p % w_eff                      # [B] ring slot
+        pb = tbl[rows, w // bs]            # [B] physical block
+        o = w % bs                         # [B] offset within block
+        m = m_t.astype(ka.dtype)[:, None, None]
+        old_k = ka[pb, :, o, :]            # [B, H, D] pre-write contents
+        old_v = va[pb, :, o, :]
+        # masked delta-write: .add of (new - old) * m is a set for
+        # unique (pb, o) pairs (live streams hold disjoint blocks), a
+        # no-op for masked pads, and bounded for duplicated scratch
+        # rows (whose contents are never valid-attendable)
+        ka = ka.at[pb, :, o, :].add((k_t.astype(ka.dtype) - old_k) * m)
+        va = va.at[pb, :, o, :].add((v_t.astype(va.dtype) - old_v) * m)
+        count = p + m_t.astype(p.dtype)
+        # gather AFTER the write: [B, nbs, H, bs, D] -> [B, H, w_eff, D]
+        kg = jnp.moveaxis(ka[tbl], 2, 1).reshape(B, H, w_eff, D)
+        vg = jnp.moveaxis(va[tbl], 2, 1).reshape(B, H, w_eff, D)
+        # slot s holds logical position `last` = the largest p' < count
+        # with p' ≡ s (mod w_eff); valid iff it exists and is within
+        # the logical window (w_eff > window only pads to whole blocks)
+        c1 = count[:, None] - 1
+        last = c1 - ((c1 - slots[None, :]) % w_eff)       # [B, w_eff]
+        valid = (last >= 0) & (last >= count[:, None] - W)
+        # zero INVALID values before the weighted sum: invalid slots may
+        # alias the scratch block (unallocated table tail entries) whose
+        # contents are arbitrary — a 0-weight x garbage product must
+        # never poison the output (0 * inf/nan is nan)
+        vg = jnp.where(valid[:, None, :, None], vg, 0)
+        scores = jnp.einsum("bhd,bhwd->bhw", q_t, kg,
+                            preferred_element_type=acc_dt) * scale
+        scores = jnp.where(valid[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o_t = jnp.einsum("bhw,bhwd->bhd", probs, vg,
+                         preferred_element_type=acc_dt)
+        u_t = {"pb": pb, "o": o, "k": old_k, "v": old_v}
+        return (ka, va, count), (o_t.astype(q.dtype), u_t)
+
+    xs = (jnp.moveaxis(q, 2, 0), jnp.moveaxis(k_new, 2, 0),
+          jnp.moveaxis(v_new, 2, 0), jnp.moveaxis(key_mask, 1, 0))
+    (ak, av, pos), (outs, journal) = lax.scan(body, (ak, av, pos), xs)
+    if undo:
+        return jnp.moveaxis(outs, 0, 2), pos, {"k": ak, "v": av}, journal
+    return jnp.moveaxis(outs, 0, 2), pos, {"k": ak, "v": av}
+
+
 def attend_cached(q, k_new, v_new, ring, *, key_mask=None,
                   scale: Optional[float] = None):
     """Incremental sliding-window attention over a per-stream KV ring —

@@ -64,8 +64,10 @@ Metered as the ``dl4j_decode_*`` family (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import base64
+import contextlib
 import io
 import logging
+import os
 import threading
 import time
 import uuid
@@ -80,6 +82,7 @@ from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.analysis import sanitizer
 from deeplearning4j_tpu.monitor import events, flight
 from deeplearning4j_tpu.ops import bucketing
+from deeplearning4j_tpu.parallel import sequence as seq_ops
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import (
     DeadlineExceededError, OverloadedError, TransientError)
@@ -141,6 +144,23 @@ class DecodeMetrics:
         self.g_kv_window = reg.gauge(
             "dl4j_kv_window", "widest KV ring window (tokens) in the "
             "pool's carry", ("model",)).labels(**lbl)
+        # paged KV arena residency (DL4J_KV_PAGED pools): capacity is
+        # tokens RESIDENT, not slots x worst-case window
+        self.g_arena_blocks = reg.gauge(
+            "dl4j_kv_arena_blocks", "paged KV arena capacity in blocks, "
+            "summed over attention layers", ("model",)).labels(**lbl)
+        self.g_arena_free = reg.gauge(
+            "dl4j_kv_arena_blocks_free", "paged KV arena blocks on the "
+            "free lists, summed over attention layers",
+            ("model",)).labels(**lbl)
+        self.g_arena_tokens = reg.gauge(
+            "dl4j_kv_arena_tokens_resident", "KV tokens resident across "
+            "live sessions (per stream, capped at the widest effective "
+            "window)", ("model",)).labels(**lbl)
+        self.c_arena_failures = reg.counter(
+            "dl4j_kv_arena_alloc_failures_total", "decode steps shed "
+            "because the paged KV arena had no free blocks",
+            ("model",)).labels(**lbl)
         # speculative decode (the fused verify path)
         self._f_spec_steps = reg.counter(
             "dl4j_spec_steps_total", "fused speculative verify dispatches",
@@ -228,7 +248,8 @@ class DecodeMetrics:
 
 class DecodeSession:
     __slots__ = ("sid", "slot", "tenant", "created_at", "last_used",
-                 "steps", "started", "migrating", "exported", "importing")
+                 "steps", "started", "migrating", "exported", "importing",
+                 "kv_blocks", "kv_pos")
 
     def __init__(self, sid: str, slot: int, tenant: Optional[str]):
         self.sid = sid
@@ -237,6 +258,14 @@ class DecodeSession:
         self.created_at = time.monotonic()
         self.last_used = self.created_at
         self.steps = 0
+        # paged-KV bookkeeping (kv_paged pools): per-layer lists of
+        # arena block ids this session owns (allocation order == the
+        # logical block order its table rows are built in), and the
+        # host mirror of the stream's device write position — the
+        # allocator's ground truth for how many blocks the NEXT chunk
+        # needs.  Freed back to the pool exactly once, in _close_locked.
+        self.kv_blocks: Optional[List[List[int]]] = None
+        self.kv_pos = 0
         # False until the first dispatched step: the pool step zeroes
         # gathered carries for fresh rows in-trace, so a reused slot's
         # stale carry is never observed
@@ -256,10 +285,10 @@ class DecodeSession:
 
 class _PendingStep:
     __slots__ = ("session", "xs", "masks", "future", "t_enqueue",
-                 "deadline", "tenant", "ctx", "spec_tokens")
+                 "deadline", "tenant", "ctx", "spec_tokens", "sampling")
 
     def __init__(self, session, xs, masks, future, deadline, tenant,
-                 ctx=None, spec_tokens=None):
+                 ctx=None, spec_tokens=None, sampling=None):
         self.session = session
         self.xs = xs          # tuple of per-input [T, ...] host arrays
         self.masks = masks    # tuple of per-input [T] masks or None
@@ -274,6 +303,10 @@ class _PendingStep:
         # None = a normal decode step.  Spec and normal steps never
         # share a dispatch (different compiled programs).
         self.spec_tokens = spec_tokens
+        # sampling-mode spec verify: {"temperature","top_k","seed","pos"}
+        # or None (greedy).  top_k is a compile-time constant (its own
+        # program); temperature/seed/pos are dynamic inputs.
+        self.sampling = sampling
 
     @property
     def request_id(self):
@@ -366,7 +399,41 @@ def _pool_step_raw(model, is_graph: bool):
     return pool_step
 
 
-def _spec_verify_raw(model, is_graph: bool):
+def _paged_pool_step_raw(model, is_graph: bool, block_size: int):
+    """The paged-arena twin of :func:`_pool_step_raw`: same gather →
+    step → scatter shape, but the attention layers' K/V pages live in
+    pool-shared arenas threaded through as explicit donated arguments
+    (they cannot ride the per-slot carry — one arena serves every
+    slot).  ``tbls`` carries each layer's per-row block table, built
+    host-side from the allocator's ground truth each dispatch (the
+    gathered carry's table is zeroed for fresh rows, so the device copy
+    is never authoritative)."""
+    rnn_raw = model._rnn_step_raw()
+
+    def pool_step(params, state, pool, idx, fresh, xs, fms, arenas, tbls):
+        def take(a):
+            g = a[idx]
+            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+            return g * (1.0 - f).astype(g.dtype)
+
+        carries = tree_map(take, pool)
+        tape = seq_ops.PagedTape(block_size=block_size, arenas=arenas,
+                                 tables=tbls)
+        with seq_ops.paged_scope(tape):
+            if is_graph:
+                outs, new_c = rnn_raw(params, state, carries, xs, fms)
+            else:
+                out, new_c = rnn_raw(params, state, carries, xs[0], fms[0])
+                outs = (out,)
+        new_pool = tree_map(lambda p, c: p.at[idx].set(c.astype(p.dtype)),
+                            pool, new_c)
+        return outs, new_pool, tape.collect()
+
+    return pool_step
+
+
+def _spec_verify_raw(model, is_graph: bool, *, block_size: Optional[int] = None,
+                     sampling: bool = False, top_k: int = 0):
     """The ONE fused speculative-verify program (arXiv 1410.0759's
     efficient-primitives playbook: fuse the K scoring dispatches into a
     single compiled call).  The chunk — the known-greedy pending token
@@ -381,7 +448,15 @@ def _spec_verify_raw(model, is_graph: bool):
     Signature: ``(params, state, pool, idx, fresh, xs, tok, nv) ->
     (outs [B,T,C], greedy [B,T], accept [B], new_pool)`` where ``tok``
     is the fed token ids ``[B, T]`` and ``nv`` the per-row real chunk
-    length (pad rows/steps are masked through, state unchanged)."""
+    length (pad rows/steps are masked through, state unchanged).
+
+    ``block_size``/``sampling``/``top_k`` select the generalized program
+    (:func:`_spec_verify_general`) for paged-KV pools and/or
+    temperature/top-k sampling acceptance; the defaults keep this exact
+    greedy/dense program (byte-identical trace)."""
+    if block_size is not None or sampling:
+        return _spec_verify_general(model, is_graph, block_size=block_size,
+                                    sampling=sampling, top_k=top_k)
     rnn_raw = model._rnn_step_raw()
 
     def spec_step(params, state, pool, idx, fresh, xs, tok, nv):
@@ -433,6 +508,134 @@ def _spec_verify_raw(model, is_graph: bool):
     return spec_step
 
 
+def _spec_verify_general(model, is_graph: bool, *,
+                         block_size: Optional[int] = None,
+                         sampling: bool = False, top_k: int = 0):
+    """Generalized fused verify: :func:`_spec_verify_raw` extended to
+    paged-KV pools (arenas + block tables as explicit donated inputs,
+    with in-trace rollback of rejected tokens' arena writes) and to
+    SAMPLING acceptance (temperature/top-k rejection correction, so
+    production sampling keeps the multi-token-per-dispatch win with the
+    exact target distribution).
+
+    Sampling uses the Gumbel-argmax coupling: ``argmax(log p + g)``
+    with ``g ~ Gumbel(key)`` is an exact draw from ``p``, and keying
+    ``g`` by ``(seed, absolute stream position)`` makes each position's
+    draw independent of chunking — verify accepts draft token ``i`` iff
+    the coupled draw at position ``i-1`` picks it (for the deterministic
+    draft proposers this IS the ``min(1, p/q)`` rejection-sampling
+    acceptance with the residual resample fused in: the emitted
+    next-pending token ``pick[accept-1]`` is the coupled draw at the
+    first disagreement), and the committed trajectory is bit-equal to
+    non-speculative sampling at the same key schedule, for every
+    acceptance length.
+
+    Signature: the base ``(params, state, pool, idx, fresh, xs, tok,
+    nv)`` plus ``(arenas, tbls)`` when paged plus ``(seed, pos0, temp)``
+    when sampling; returns the base 4-tuple plus ``new_arenas`` when
+    paged."""
+    rnn_raw = model._rnn_step_raw()
+    paged = block_size is not None
+
+    def spec_step(params, state, pool, idx, fresh, xs, tok, nv, *rest):
+        ri = 0
+        arenas = tbls = None
+        if paged:
+            arenas, tbls = rest[0], rest[1]
+            ri = 2
+        if sampling:
+            seed, pos0, temp = rest[ri], rest[ri + 1], rest[ri + 2]
+
+        def take(a):
+            g = a[idx]
+            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+            return g * (1.0 - f).astype(g.dtype)
+
+        c0 = tree_map(take, pool)
+        B, T = tok.shape
+        valid = jnp.arange(T)[None, :] < nv[:, None]          # [B, T]
+
+        def body(carry, inp):
+            c, ar = carry
+            xts, m_t = inp            # tuple of [B, C...], [B]
+            xts = tuple(x[:, None] for x in xts)              # [B, 1, C]
+            m = m_t[:, None].astype(jnp.float32)              # [B, 1]
+            tape = (seq_ops.PagedTape(block_size=block_size, arenas=ar,
+                                      tables=tbls, record_undo=True)
+                    if paged else None)
+            ctx = (seq_ops.paged_scope(tape) if paged
+                   else contextlib.nullcontext())
+            with ctx:
+                if is_graph:
+                    outs_t, c2 = rnn_raw(params, state, c, xts,
+                                         tuple(m for _ in xts))
+                    out_t = outs_t[0]
+                else:
+                    out_t, c2 = rnn_raw(params, state, c, xts[0], m)
+            ar2 = tape.collect() if paged else ar
+            undo = tape.collect_undo() if paged else ()
+            return (c2, ar2), (out_t[:, 0], c2, undo)
+
+        xs_seq = tuple(jnp.moveaxis(x, 1, 0) for x in xs)     # [T, B, C]
+        m_seq = jnp.moveaxis(valid, 1, 0)                     # [T, B]
+        (_, arenas_f), (outs, c_stack, undo_stack) = jax.lax.scan(
+            body, (c0, arenas if paged else ()), (xs_seq, m_seq))
+        outs = jnp.moveaxis(outs, 0, 1)                       # [B, T, C]
+        if sampling:
+            logits = jnp.log(jnp.maximum(outs.astype(jnp.float32), 1e-30))
+            logits = logits / jnp.maximum(
+                temp.astype(jnp.float32), 1e-6)[:, None, None]
+            C = logits.shape[-1]
+            if 0 < top_k < C:
+                kth = jnp.sort(logits, axis=-1)[..., C - top_k][..., None]
+                logits = jnp.where(logits >= kth, logits, -1e30)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            base = jax.vmap(lambda s: jax.random.fold_in(
+                jax.random.PRNGKey(0), s))(seed)
+            ppos = pos0[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            gum = jax.vmap(lambda kb, ps: jax.vmap(
+                lambda p: jax.random.gumbel(
+                    jax.random.fold_in(kb, p), (C,), jnp.float32))(ps))(
+                        base, ppos)                           # [B, T, C]
+            pick = jnp.argmax(logp + gum, axis=-1).astype(jnp.int32)
+        else:
+            pick = jnp.argmax(outs, axis=-1).astype(jnp.int32)
+        match = jnp.logical_and(pick[:, :-1] == tok[:, 1:],
+                                valid[:, 1:])                 # [B, T-1]
+        lead = jnp.cumprod(match.astype(jnp.int32), axis=1)
+        accept = jnp.minimum(1 + jnp.sum(lead, axis=1),
+                             jnp.maximum(nv, 1)).astype(jnp.int32)
+        bidx = jnp.arange(B)
+        sel = tree_map(lambda s: s[accept - 1, bidx], c_stack)
+        new_pool = tree_map(lambda p, c: p.at[idx].set(c.astype(p.dtype)),
+                            pool, sel)
+        if not paged:
+            return outs, pick, accept, new_pool
+        # arena rollback: the scan committed EVERY chunk token's K/V
+        # write into the shared arenas (they cannot be stacked per step
+        # like the per-slot carry) — restore the pre-write contents for
+        # each row's rejected steps (j >= accept).  Within one chunk
+        # every step writes a distinct ring slot (T <= w_eff), so one
+        # masked scatter per layer restores them exactly; kept steps
+        # write back their current contents (a no-op), and masked pad
+        # rows restore the untouched scratch block over itself.
+        jm = jnp.arange(T)[:, None] >= accept[None, :]        # [T, B]
+        fixed = []
+        for li, ar in enumerate(arenas_f):
+            u = undo_stack[li]
+            pb, o = u["pb"][:, 0], u["o"][:, 0]               # [T, B]
+            ar2 = dict(ar)
+            for key in ("k", "v"):
+                old = u[key][:, 0]                            # [T, B, H, D]
+                cur = ar2[key][pb, :, o, :]
+                ar2[key] = ar2[key].at[pb, :, o, :].set(
+                    jnp.where(jm[..., None, None], old, cur))
+            fixed.append(ar2)
+        return outs, pick, accept, new_pool, tuple(fixed)
+
+    return spec_step
+
+
 class DecodePool:
     """Device-resident slot-pool decode state for ONE model instance,
     with its continuous-batching dispatch thread.
@@ -451,7 +654,11 @@ class DecodePool:
     def __init__(self, model, name: str = "", max_slots: int = 32,
                  ttl_s: float = 600.0,
                  slot_ladder: Optional[Sequence[int]] = None,
-                 max_wait_ms: float = 2.0, min_batch: int = 1):
+                 max_wait_ms: float = 2.0, min_batch: int = 1,
+                 kv_paged: Optional[bool] = None,
+                 kv_block: Optional[int] = None,
+                 kv_arena_tokens: Optional[int] = None,
+                 kv_dtype=None):
         self.model = model
         self.name = name
         self.max_slots = max(1, int(max_slots))
@@ -459,6 +666,28 @@ class DecodePool:
         self._ladder = bucketing.warmup_ladder(slot_ladder, self.max_slots)
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.min_batch = max(1, min(int(min_batch), self.max_slots))
+        # paged KV arena knobs (ctor > env > default): kv_paged swaps
+        # the per-slot dense KV rings for one pool-shared block arena
+        # per attention layer; kv_arena_tokens sets the per-layer token
+        # capacity (default: max_slots x the widest effective window —
+        # dense-equivalent HBM; set LOWER to serve more short sessions
+        # in less memory); kv_dtype stores pages at e.g. bfloat16
+        # (attention still accumulates at f32)
+        if kv_paged is None:
+            kv_paged = os.environ.get("DL4J_KV_PAGED", "") == "1"
+        self.kv_paged = bool(kv_paged)
+        if kv_block is None:
+            kv_block = int(os.environ.get("DL4J_KV_BLOCK", "16") or 16)
+        self.kv_block = max(1, int(kv_block))
+        if kv_arena_tokens is None:
+            env = os.environ.get("DL4J_KV_ARENA_TOKENS", "")
+            kv_arena_tokens = int(env) if env else None
+        self.kv_arena_tokens = (None if kv_arena_tokens is None
+                                else max(1, int(kv_arena_tokens)))
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("DL4J_KV_DTYPE", "") or None
+        self._kv_dtype = (None if kv_dtype is None
+                          else jnp.dtype(kv_dtype))
         self._is_graph = hasattr(model, "_forward_all")
         self.n_inputs = (len(model.conf.network_inputs) if self._is_graph
                          else 1)
@@ -487,6 +716,13 @@ class DecodePool:
         self._step_jit = None
         self._spec_jit = None
         self._kv_summary: dict = {}
+        # paged-arena state: device arenas are batcher-thread-only like
+        # the pool; the allocator's free lists + per-layer specs are
+        # HOST state guarded by self._cond (admission runs under it)
+        self._arenas = None
+        self._arena_specs: Tuple[dict, ...] = ()
+        self._arena_blocks: Tuple[int, ...] = ()
+        self._kv_free: List[List[int]] = []
         self._thread = self._spawn_thread()
 
     # ------------------------------------------------------------------
@@ -536,6 +772,16 @@ class DecodePool:
         if s is None:
             return False
         self._free.append(s.slot)
+        if s.kv_blocks is not None:
+            # paged arena blocks return to the free lists EXACTLY once:
+            # popping the session above makes this unreachable twice,
+            # and the guard skips sessions outliving an arena reset
+            # (batcher death drops the whole arena with them)
+            for li, blks in enumerate(s.kv_blocks):
+                if li < len(self._kv_free):
+                    self._kv_free[li].extend(blks)
+            s.kv_blocks = None
+            self._update_arena_gauges_locked()
         stranded = [p for p in self._queue if p.session.sid == sid]
         self._queue = [p for p in self._queue if p.session.sid != sid]
         for p in stranded:
@@ -617,14 +863,21 @@ class DecodePool:
 
     def submit_spec_step(self, sid: str, xs, token_ids,
                          timeout_ms: Optional[float] = None,
-                         tenant: Optional[str] = None) -> Future:
+                         tenant: Optional[str] = None,
+                         sampling: Optional[dict] = None) -> Future:
         """Enqueue one fused speculative-verify step: ``xs`` carries the
         feature rows for the pending token plus K draft tokens,
         ``token_ids`` their ``[T]`` int ids.  The future resolves to
         ``(outs [T, C], greedy [T], accepted)`` — ``accepted`` tokens
         (>= 1: the pending token is known-greedy) were committed to the
         session's device carry in the ONE dispatch; the rest were
-        rolled back in-trace."""
+        rolled back in-trace.
+
+        ``sampling`` switches the verify from greedy argmax to exact
+        rejection-sampled acceptance: a dict of ``temperature`` (float),
+        ``top_k`` (int, 0 = full vocab), ``seed`` (int) and ``pos`` (the
+        session's absolute sampling position, keys the per-token PRNG so
+        trajectories are chunking-independent)."""
         tok = np.asarray(token_ids, np.int32).ravel()
         xs_n = self._normalize_inputs(xs)
         if any(a.ndim < 2 for a in xs_n):
@@ -634,10 +887,11 @@ class DecodePool:
             raise ValueError(
                 f"token_ids has {tok.shape[0]} entries but the feature "
                 f"chunk has {xs_n[0].shape[0]} timesteps")
-        return self._submit(sid, xs, None, timeout_ms, tenant, tok)
+        return self._submit(sid, xs, None, timeout_ms, tenant, tok,
+                            sampling=sampling)
 
     def _submit(self, sid, xs, masks, timeout_ms, tenant,
-                spec_tokens) -> Future:
+                spec_tokens, sampling=None) -> Future:
         xs = self._normalize_inputs(xs)
         masks = self._normalize_masks(masks, xs)
         deadline = (None if timeout_ms is None
@@ -666,7 +920,8 @@ class DecodePool:
             p = _PendingStep(s, xs, masks, fut, deadline,
                              tenant if tenant is not None else s.tenant,
                              ctx=events.current_context(),
-                             spec_tokens=spec_tokens)
+                             spec_tokens=spec_tokens,
+                             sampling=sampling)
             self._queue.append(p)
             self._cond.notify_all()
         if restarted:
@@ -683,11 +938,12 @@ class DecodePool:
     def spec_step(self, sid: str, xs, token_ids,
                   timeout: Optional[float] = 60.0,
                   timeout_ms: Optional[float] = None,
-                  tenant: Optional[str] = None):
+                  tenant: Optional[str] = None,
+                  sampling: Optional[dict] = None):
         """Blocking convenience wrapper around :meth:`submit_spec_step`."""
         return self.submit_spec_step(
             sid, xs, token_ids, timeout_ms=timeout_ms,
-            tenant=tenant).result(timeout)
+            tenant=tenant, sampling=sampling).result(timeout)
 
     def _normalize_inputs(self, xs) -> Tuple[np.ndarray, ...]:
         """Per-input ``[T, C]`` chunk arrays.  Single-input models take
@@ -769,6 +1025,19 @@ class DecodePool:
             free = len(self._free)
             queued = len(self._queue)
             draining = self._draining
+            arena = None
+            if self.kv_paged and self._arena_specs:
+                w_max = max(int(sp["window_eff"])
+                            for sp in self._arena_specs)
+                arena = {
+                    "block_size": int(self.kv_block),
+                    "blocks": int(sum(self._arena_blocks)),
+                    "blocks_free": int(sum(len(f) for f in self._kv_free)),
+                    "tokens_resident": int(sum(
+                        min(s.kv_pos, w_max)
+                        for s in self._sessions.values()
+                        if s.kv_blocks is not None)),
+                }
         out = {
             "slots": self.max_slots,
             "slots_free": free,
@@ -789,6 +1058,8 @@ class DecodePool:
             out["spec_programs"] = by_kind.get("spec_step", 0)
         if self._kv_summary:
             out["kv_cache"] = dict(self._kv_summary)
+        if arena is not None:
+            out["kv_arena"] = arena
         return out
 
     # ------------------------------------------------------------------
@@ -1028,6 +1299,12 @@ class DecodePool:
         }
         if s.started and self._pool is not None:
             slot_slice = tree_map(lambda a: a[s.slot], self._pool)
+            if self.kv_paged:
+                # de-page into the DENSE wire layout: the payload a
+                # paged pool exports is byte-compatible with what a
+                # dense-ring pool exports, so mixed fleets (paged and
+                # not-yet-upgraded replicas) migrate in both directions
+                slot_slice = self._depage_carry(slot_slice)
             leaves = jax.tree_util.tree_leaves(slot_slice)
             host = jax.device_get(leaves)
             # v2: base64-npy bytes per leaf — exact binary round trip
@@ -1038,6 +1315,155 @@ class DecodePool:
                 _encode_carry_leaf(a, binary) for a in host]}
             payload["feature_tails"] = [list(t) for t in self._tails]
         return payload
+
+    def _depage_carry(self, slot_slice):
+        """Replace every paged carry node ``{"aid","pos","tbl"}`` in one
+        slot's carry with the dense ``{"k","pos","v"}`` ring layout the
+        migration wire ships: gather the session's blocks out of the
+        arena and lay the live window out at its ring positions
+        (token ``p`` at index ``p % W`` — exactly where
+        ``kv_ring_init``/``attend_cached`` would hold it).  bf16 arenas
+        widen to f32 on the wire (npy/JSON-portable; a paged target
+        narrows back losslessly)."""
+        bs = int(self.kv_block)
+
+        def walk(node):
+            if isinstance(node, dict):
+                if set(node.keys()) == {"aid", "pos", "tbl"}:
+                    aid = int(node["aid"].shape[-1]) - 1
+                    spec = self._arena_specs[aid]
+                    H = int(spec["heads"])
+                    D = int(spec["head_dim"])
+                    W = int(spec["window"])
+                    w_eff = int(spec["window_eff"])
+                    pos = int(np.asarray(jax.device_get(node["pos"])))
+                    tbl = np.asarray(jax.device_get(node["tbl"]))
+                    ka = np.asarray(jax.device_get(
+                        self._arenas[aid]["k"][jnp.asarray(tbl)]),
+                        dtype=np.float32)   # [nbs, H, bs, D]
+                    va = np.asarray(jax.device_get(
+                        self._arenas[aid]["v"][jnp.asarray(tbl)]),
+                        dtype=np.float32)
+                    dk = np.zeros((H, W, D), np.float32)
+                    dv = np.zeros((H, W, D), np.float32)
+                    for p in range(max(0, pos - W), pos):
+                        sl = p % w_eff
+                        dk[:, p % W, :] = ka[sl // bs, :, sl % bs, :]
+                        dv[:, p % W, :] = va[sl // bs, :, sl % bs, :]
+                    return {"k": dk, "pos": np.int32(pos), "v": dv}
+                return {k: walk(v) for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                return type(node)(walk(v) for v in node)
+            return node
+
+        return walk(slot_slice)
+
+    def _do_import_paged(self, session: DecodeSession, carry: dict) -> dict:
+        """Paged half of import: consume the DENSE wire leaves in the
+        pool's flatten order, re-paging each ring's live window into
+        freshly allocated arena blocks.  Allocated blocks are recorded
+        on the session IMMEDIATELY (under the lock), so a mid-walk
+        failure frees them through the normal close path."""
+        in_leaves = carry["leaves"]
+        cursor = {"i": 0}
+        arenas = list(self._arenas)
+        bs = int(self.kv_block)
+
+        def take():
+            if cursor["i"] >= len(in_leaves):
+                raise ValueError(
+                    f"migrated carry has {len(in_leaves)} leaves — "
+                    "fewer than this pool's template needs (model "
+                    "architectures differ)")
+            a = _decode_carry_leaf(in_leaves[cursor["i"]])
+            cursor["i"] += 1
+            return a
+
+        with self._cond:
+            if session.kv_blocks is None:
+                session.kv_blocks = [[] for _ in self._arena_specs]
+
+        def walk(node):
+            if node is None:
+                return None
+            if isinstance(node, dict):
+                if set(node.keys()) == {"aid", "pos", "tbl"}:
+                    # the wire node is {"k","pos","v"} — three leaves in
+                    # sorted (flatten) order
+                    dk, pos_a, dv = take(), take(), take()
+                    aid = int(node["aid"].shape[-1]) - 1
+                    spec = self._arena_specs[aid]
+                    H = int(spec["heads"])
+                    D = int(spec["head_dim"])
+                    W = int(spec["window"])
+                    w_eff = int(spec["window_eff"])
+                    nbs = w_eff // bs
+                    pos = int(np.asarray(pos_a).reshape(()))
+                    if tuple(dk.shape) != (H, W, D):
+                        raise ValueError(
+                            f"migrated KV leaf shape {tuple(dk.shape)} "
+                            f"!= this pool's ring {(H, W, D)}")
+                    need = -(-min(pos, w_eff) // bs)
+                    with self._cond:
+                        held = session.kv_blocks[aid]
+                        while len(held) < need:
+                            if not self._kv_free[aid]:
+                                self.metrics.record_shed(
+                                    "kv_arena_exhausted")
+                                self.metrics.c_arena_failures.inc()
+                                raise OverloadedError(
+                                    "KV arena exhausted re-paging a "
+                                    "migrated session", retry_after_s=1.0)
+                            held.append(self._kv_free[aid].pop())
+                        blocks = list(held)
+                        self._update_arena_gauges_locked()
+                    adt = arenas[aid]["k"].dtype
+                    bk = np.zeros((max(need, 1), H, bs, D), np.float32)
+                    bv = np.zeros((max(need, 1), H, bs, D), np.float32)
+                    for p in range(max(0, pos - W), pos):
+                        sl = p % w_eff
+                        bk[sl // bs, :, sl % bs, :] = dk[:, p % W, :]
+                        bv[sl // bs, :, sl % bs, :] = dv[:, p % W, :]
+                    if need:
+                        bidx = jnp.asarray(np.asarray(blocks[:need],
+                                                      np.int32))
+                        ar = dict(arenas[aid])
+                        ar["k"] = ar["k"].at[bidx].set(
+                            jnp.asarray(bk[:need]).astype(adt))
+                        ar["v"] = ar["v"].at[bidx].set(
+                            jnp.asarray(bv[:need]).astype(adt))
+                        arenas[aid] = ar
+                    row = np.full((nbs,), self._arena_blocks[aid],
+                                  np.int32)
+                    row[:len(blocks)] = blocks
+                    session.kv_pos = pos
+                    return {
+                        "aid": node["aid"],
+                        "pos": node["pos"].at[session.slot].set(pos),
+                        "tbl": node["tbl"].at[session.slot].set(
+                            jnp.asarray(row)),
+                    }
+                return {k: walk(v) for k, v in sorted(node.items())}
+            if isinstance(node, (list, tuple)):
+                return type(node)(walk(v) for v in node)
+            # a plain [S+1, ...] pool leaf: one dense wire leaf
+            a = take()
+            if tuple(a.shape) != tuple(node.shape[1:]):
+                raise ValueError(
+                    f"migrated carry leaf shape {a.shape} != the pool "
+                    f"slot's {tuple(node.shape[1:])}")
+            return node.at[session.slot].set(
+                jnp.asarray(a).astype(node.dtype))
+
+        new_pool = walk(self._pool)
+        if cursor["i"] != len(in_leaves):
+            raise ValueError(
+                f"migrated carry has {len(in_leaves)} leaves, this "
+                f"pool consumed {cursor['i']} — model architectures "
+                "differ")
+        self._pool = new_pool  # dl4j: noqa[DL4J207] control-queue op: only the batcher thread (the pool's single owner) runs this
+        self._arenas = tuple(arenas)  # dl4j: noqa[DL4J207] same control-queue op — batcher-thread-only; the locked writes are the crash paths
+        return {"slot": session.slot, "leaves": cursor["i"]}
 
     def _do_import(self, session: DecodeSession, payload: dict) -> dict:
         """Batcher-thread half of import: materialize the pool's device
@@ -1057,6 +1483,8 @@ class DecodePool:
                 raise ValueError(
                     f"migrated carry feature shape {got} != the pool's "
                     f"{self._tails} (one pool serves one input layout)")
+        if self.kv_paged:
+            return self._do_import_paged(session, carry)
         pool_leaves, treedef = jax.tree_util.tree_flatten(self._pool)
         in_leaves = carry["leaves"]
         if len(in_leaves) != len(pool_leaves):
@@ -1214,6 +1642,13 @@ class DecodePool:
                     self._pool = None
                     self._step_jit = None
                     self._spec_jit = None
+                    # drop the arena WITH the pool: block tables in the
+                    # dropped pool are the only map into it, and closing
+                    # below must not free blocks into a stale free list
+                    self._arenas = None
+                    self._arena_specs = ()
+                    self._arena_blocks = ()
+                    self._kv_free = []
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="batcher_died")
             for _, _, fut in ctl:
@@ -1262,7 +1697,11 @@ class DecodePool:
                 # spec and normal steps are different compiled programs
                 # — never coalesced into one dispatch
                 key = (tuple(a.shape for a in p.xs),
-                       p.spec_tokens is not None)
+                       p.spec_tokens is not None,
+                       # top_k picks the compiled program; greedy rows
+                       # (sampling None) must not share a sampling trace
+                       None if p.sampling is None
+                       else int(p.sampling.get("top_k", 0)))
                 groups.setdefault(key, []).append(p)
             for group in groups.values():
                 with self._cond:
@@ -1331,15 +1770,28 @@ class DecodePool:
         if self._pool is not None:
             return
         n = self.max_slots + 1   # + scratch row for ladder padding
-        if self._is_graph:
-            tmpl = self.model.rnn_carry_template(
-                n, feature_tails=tails, dtype=dtype)
-        else:
-            tmpl = self.model.rnn_carry_template(
-                n, feature_tail=tails[0], dtype=dtype)
+        tape = (seq_ops.PagedTape(block_size=self.kv_block,
+                                  dtype=self._kv_dtype)
+                if self.kv_paged else None)
+        ctx = (seq_ops.paged_scope(tape) if tape is not None
+               else contextlib.nullcontext())
+        with ctx:
+            if self._is_graph:
+                tmpl = self.model.rnn_carry_template(
+                    n, feature_tails=tails, dtype=dtype)
+            else:
+                tmpl = self.model.rnn_carry_template(
+                    n, feature_tail=tails[0], dtype=dtype)
         self._pool = tmpl  # dl4j: noqa[DL4J207] batcher-thread-only write: the device pool has ONE owning thread; the locked writes are the crash paths
         self._tails = tuple(tuple(t[1:]) for t in tails)
         self._dtype = np.dtype(dtype)
+        if self.kv_paged:
+            self._materialize_arenas(tuple(tape.specs))
+            self._step_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool over a fixed is_graph, cached by the owning batcher thread for the pool's lifetime; locked writes are the crash paths
+                _paged_pool_step_raw(self.model, self._is_graph,
+                                     self.kv_block),
+                donate_argnums=(2, 7))
+            return
         self._step_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool over a fixed is_graph, cached by the owning batcher thread for the pool's lifetime; locked writes are the crash paths
             _pool_step_raw(self.model, self._is_graph),
             donate_argnums=(2,))
@@ -1349,12 +1801,65 @@ class DecodePool:
         self.metrics.g_kv_bytes.set(kv["bytes"])
         self.metrics.g_kv_window.set(kv["window"])
 
-    def _ensure_spec_jit(self):
+    def _materialize_arenas(self, specs: Tuple[dict, ...]) -> None:
+        """Build the per-layer block arenas + free lists from the specs
+        the template tape recorded.  Per-layer capacity is
+        ``kv_arena_tokens`` rounded up to whole blocks (default: the
+        dense-equivalent ``max_slots x w_eff``), never less than one
+        full window (a pool that cannot hold ONE session is a config
+        error, not a backpressure state); each arena carries one extra
+        scratch block (index ``n_blocks``) for unallocated table
+        entries."""
+        arenas, free, nblocks = [], [], []
+        nbytes = 0
+        widest = 0
+        for spec in specs:
+            we, nbs = spec["window_eff"], spec["blocks_per_slot"]
+            widest = max(widest, we)
+            tokens = (self.kv_arena_tokens if self.kv_arena_tokens
+                      else self.max_slots * we)
+            nb = max(nbs, -(-int(tokens) // self.kv_block))
+            dt = jnp.dtype(spec["dtype"])
+            shape = (nb + 1, spec["heads"], self.kv_block,
+                     spec["head_dim"])
+            buf = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            arenas.append(buf)
+            free.append(list(range(nb)))
+            nblocks.append(nb)
+            nbytes += int(buf["k"].nbytes + buf["v"].nbytes)
+        self._arenas = tuple(arenas)  # dl4j: noqa[DL4J207] batcher-thread-only write like _pool: the arena has ONE owning thread; the locked writes are the crash paths
+        with self._cond:
+            self._arena_specs = tuple(specs)
+            self._arena_blocks = tuple(nblocks)
+            self._kv_free = free
+            self._update_arena_gauges_locked()
+        self._kv_summary = {
+            "paged": True, "block_size": self.kv_block,
+            "layers": len(specs), "blocks": sum(nblocks),
+            "bytes": nbytes, "window": widest,
+            "dtype": specs[0]["dtype"] if specs else None}
+        self.metrics.g_kv_rings.set(len(specs))
+        self.metrics.g_kv_bytes.set(nbytes)
+        self.metrics.g_kv_window.set(widest)
+
+    def _ensure_spec_jit(self, sampling: bool = False, top_k: int = 0):
+        """Fused-verify programs, keyed by ``(sampling, top_k)`` —
+        ``top_k`` is a compile-time constant (its own sort/mask trace);
+        temperature/seed/position are dynamic inputs of the sampling
+        program."""
         if self._spec_jit is None:
-            self._spec_jit = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool like _step_jit: built once by the owning batcher thread, cached for the pool's lifetime
-                _spec_verify_raw(self.model, self._is_graph),
-                donate_argnums=(2,))
-        return self._spec_jit
+            self._spec_jit = {}  # dl4j: noqa[DL4J207] batcher-thread-only cache like _step_jit; the locked writes are the crash resets
+        key = (bool(sampling), int(top_k) if sampling else 0)
+        fn = self._spec_jit.get(key)
+        if fn is None:
+            fn = jax.jit(  # dl4j: noqa[DL4J104,DL4J207] one jit per pool per (sampling, top_k) like _step_jit: built once by the owning batcher thread, cached for the pool's lifetime
+                _spec_verify_raw(
+                    self.model, self._is_graph,
+                    block_size=self.kv_block if self.kv_paged else None,
+                    sampling=bool(sampling), top_k=int(top_k)),
+                donate_argnums=(2, 8) if self.kv_paged else (2,))
+            self._spec_jit[key] = fn  # dl4j: noqa[DL4J207] batcher-thread-only cache fill, single owner per pool
+        return fn
 
     def _base_state(self):
         st = self.model.net_state
@@ -1363,6 +1868,97 @@ class DecodePool:
                     for n, s in st.items()}
         return [{k: v for k, v in s.items() if k != "rnn_state"}
                 for s in st]
+
+    # ------------------------------------------------------------------
+    # Paged KV arena: allocation, admission, tables (kv_paged pools)
+    # ------------------------------------------------------------------
+    def _update_arena_gauges_locked(self) -> None:
+        if not self._arena_specs:
+            return
+        total = sum(self._arena_blocks)
+        free = sum(len(f) for f in self._kv_free)
+        widest = max(s["window_eff"] for s in self._arena_specs)
+        resident = sum(min(s.kv_pos, widest)
+                       for s in self._sessions.values()
+                       if s.kv_blocks is not None)
+        self.metrics.g_arena_blocks.set(total)
+        self.metrics.g_arena_free.set(free)
+        self.metrics.g_arena_tokens.set(resident)
+
+    def _kv_alloc_locked(self, s: DecodeSession, new_pos: int) -> bool:
+        """Grow ``s``'s block holdings so every layer covers ``new_pos``
+        resident tokens.  All-or-nothing: either every layer gets its
+        blocks or none does (a half-grown session would write into the
+        scratch block).  Caller holds ``self._cond``."""
+        if s.kv_blocks is None:
+            s.kv_blocks = [[] for _ in self._arena_specs]
+        need = []
+        for li, spec in enumerate(self._arena_specs):
+            want = min(int(new_pos), spec["window_eff"])
+            nblk = -(-want // self.kv_block) if want > 0 else 0
+            need.append(max(0, min(nblk, spec["blocks_per_slot"])
+                            - len(s.kv_blocks[li])))
+        if any(n > len(self._kv_free[li]) for li, n in enumerate(need)):
+            return False
+        for li, n in enumerate(need):
+            for _ in range(n):
+                s.kv_blocks[li].append(self._kv_free[li].pop())
+        return True
+
+    def _kv_admit(self, group: List[_PendingStep],
+                  t_tokens: int) -> List[_PendingStep]:
+        """Admission control before a paged dispatch: allocate each
+        row's worst-case block growth (``t_tokens`` more tokens) up
+        front; rows the arena cannot cover are shed RETRYABLE (the
+        client backs off and retries once blocks free — exactly the
+        slot-exhaustion contract, but denominated in tokens)."""
+        if not self.kv_paged or not self._arena_specs:
+            return group
+        kept: List[_PendingStep] = []
+        with self._cond:
+            for p in group:
+                s = p.session
+                if s.slot >= self.max_slots:
+                    kept.append(p)     # warmup scratch rows: no arena
+                    continue
+                base = s.kv_pos if s.started else 0
+                if self._kv_alloc_locked(s, base + int(t_tokens)):
+                    kept.append(p)
+                    continue
+                self.metrics.record_shed("kv_arena_exhausted")
+                self.metrics.c_arena_failures.inc()
+                events.emit("decode.arena_alloc_failed", severity="warn",
+                            model=self.name, session_id=s.sid,
+                            slot=s.slot, tenant=p.tenant,
+                            request_id=p.request_id,
+                            tokens=base + int(t_tokens))
+                if not p.future.done():
+                    p.future.set_exception(OverloadedError(
+                        "paged KV arena exhausted (no free blocks for "
+                        f"{base + int(t_tokens)} resident tokens)",
+                        retry_after_s=1.0))
+            self._update_arena_gauges_locked()
+        return kept
+
+    def _kv_tables(self, group: List[_PendingStep], kb: int) -> Tuple:
+        """Per-layer ``[Kb, n_blocks_per_slot]`` device block tables for
+        one dispatch, from the allocator's host-side ground truth
+        (logical block ``j`` = the ``j``-th block the session
+        allocated; unallocated tail entries point at the scratch
+        block).  Tables are rebuilt every dispatch — the gathered
+        carry's table is zeroed for fresh rows, so the device copy is
+        never authoritative."""
+        tbls = []
+        with self._cond:
+            for li, spec in enumerate(self._arena_specs):
+                nbs = spec["blocks_per_slot"]
+                t = np.full((kb, nbs), self._arena_blocks[li], np.int32)
+                for r, p in enumerate(group):
+                    blks = p.session.kv_blocks
+                    if blks is not None and blks[li]:
+                        t[r, :len(blks[li])] = blks[li]
+                tbls.append(jnp.asarray(t))
+        return tuple(tbls)
 
     def _dispatch(self, group: List[_PendingStep]) -> None:
         # the ONE compute dispatch is linked to the joined sessions'
@@ -1383,8 +1979,6 @@ class DecodePool:
         try:
             faults.check("decode.step")
             g = self.model.conf.global_conf
-            K = len(group)
-            Kb = bucketing.bucket_size(K, self._ladder)
             scratch = self.max_slots
             tails = [tuple(a.shape) for a in group[0].xs]
             feat_tails = tuple(tuple(t[1:]) for t in tails)
@@ -1394,6 +1988,14 @@ class DecodePool:
                     f"{self._tails} (one pool serves one input layout)")
             with monitor.span("serve/decode", phase="gather_pad"):
                 self._ensure_device_state(tails, group[0].xs[0].dtype)
+                # paged arenas admit by TOKENS: grow each row's block
+                # tables for the chunk's worst case before any array
+                # is built; rows that don't fit shed retryable here
+                group = self._kv_admit(group, int(tails[0][0]))
+                if not group:
+                    return
+                K = len(group)
+                Kb = bucketing.bucket_size(K, self._ladder)
                 idx = np.full((Kb,), scratch, np.int32)
                 # pad rows run fresh (zero carries): the scratch row's
                 # contents never feed a computation
@@ -1417,6 +2019,15 @@ class DecodePool:
                 for r, p in enumerate(group):
                     idx[r] = p.session.slot
                     fresh[r] = 0.0 if p.session.started else 1.0
+                    if self.kv_paged and p.session.slot >= self.max_slots:
+                        # warmup scratch rows own no arena blocks: run
+                        # them fresh AND fully masked so they never
+                        # write the shared scratch block (their purpose
+                        # is compiling the program, not its outputs)
+                        fresh[r] = 1.0
+                        for fm in fms_h:
+                            if fm is not None:
+                                fm[r] = 0.0
                 # explicit H2D before the guarded call (sanitizer
                 # transfer-guard contract)
                 idx_d = jnp.asarray(idx)
@@ -1424,6 +2035,8 @@ class DecodePool:
                 xs_d = tuple(jnp.asarray(x) for x in xs_h)
                 fms_d = tuple(None if m is None else jnp.asarray(m)
                               for m in fms_h)
+                tbls_d = (self._kv_tables(group, Kb) if self.kv_paged
+                          else None)
             tel = getattr(self.model, "compile_telemetry", None)
             compiling = False
             if tel is not None:
@@ -1433,9 +2046,15 @@ class DecodePool:
             compute_entered = True
             with monitor.span("serve/decode", phase="compute"), \
                     sanitizer.guard_step(compiling=compiling):
-                outs, self._pool = self._step_jit(
-                    self.model.net_params, self._base_state(), self._pool,
-                    idx_d, fresh_d, xs_d, fms_d)
+                if self.kv_paged:
+                    outs, self._pool, self._arenas = self._step_jit(
+                        self.model.net_params, self._base_state(),
+                        self._pool, idx_d, fresh_d, xs_d, fms_d,
+                        self._arenas, tbls_d)
+                else:
+                    outs, self._pool = self._step_jit(
+                        self.model.net_params, self._base_state(),
+                        self._pool, idx_d, fresh_d, xs_d, fms_d)
                 outs = tuple(np.asarray(jax.device_get(o)) for o in outs)
             t1 = time.perf_counter()
             T = next((t for t, _ in pairs), 1)
@@ -1452,6 +2071,13 @@ class DecodePool:
                 p.session.started = True
                 p.session.steps += 1
                 p.session.last_used = now
+                if self.kv_paged and p.session.slot < self.max_slots:
+                    # host mirror of the device write position: masked
+                    # pad steps advance nothing (allocation already
+                    # covered the chunk's worst case)
+                    m0 = p.masks[0] if p.masks else None
+                    p.session.kv_pos += (T if m0 is None
+                                         else int(np.sum(m0[:T] > 0)))
                 p.future.set_result(tuple(o[r] for o in sliced))
                 self.metrics.record_step(p.tenant, n_tokens=T)
                 self.metrics.h_queue.observe(t_dispatch - p.t_enqueue)
@@ -1477,6 +2103,10 @@ class DecodePool:
                     self._pool = None
                     self._step_jit = None
                     self._spec_jit = None
+                    self._arenas = None
+                    self._arena_specs = ()
+                    self._arena_blocks = ()
+                    self._kv_free = []
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="error")
 
@@ -1491,8 +2121,6 @@ class DecodePool:
         try:
             faults.check("decode.step")
             g = self.model.conf.global_conf
-            K = len(group)
-            Kb = bucketing.bucket_size(K, self._ladder)
             scratch = self.max_slots
             tails = [tuple(a.shape) for a in group[0].xs]
             if any(len(t) < 2 for t in tails):
@@ -1503,15 +2131,31 @@ class DecodePool:
                 raise ValueError(
                     f"decode feature shape {feat_tails} != the pool's "
                     f"{self._tails} (one pool serves one input layout)")
+            sampling = group[0].sampling is not None
+            top_k = (int(group[0].sampling.get("top_k", 0))
+                     if sampling else 0)
             with monitor.span("serve/decode", phase="gather_pad"):
                 self._ensure_device_state(tails, group[0].xs[0].dtype)
-                self._ensure_spec_jit()
                 T = int(tails[0][0])
+                # worst-case admission: the verify may commit the whole
+                # chunk; kv_pos advances by the ACTUAL acceptance after
+                # the dispatch (over-allocated blocks stay held for the
+                # stream's future growth — never re-freed mid-stream)
+                group = self._kv_admit(group, T)
+                if not group:
+                    return
+                spec_fn = self._ensure_spec_jit(sampling=sampling,
+                                                top_k=top_k)
+                K = len(group)
+                Kb = bucketing.bucket_size(K, self._ladder)
                 Tb = bucketing.bucket_size(T, g.bucket_time_sizes)
                 idx = np.full((Kb,), scratch, np.int32)
                 fresh = np.ones((Kb,), np.float32)
                 nv = np.zeros((Kb,), np.int32)
                 tok = np.zeros((Kb, Tb), np.int32)
+                seed = np.zeros((Kb,), np.int32)
+                pos0 = np.zeros((Kb,), np.int32)
+                temp = np.ones((Kb,), np.float32)
                 xs_h = []
                 for i, tail in enumerate(tails):
                     x = np.zeros((Kb, Tb) + tuple(tail[1:]), np.float32)
@@ -1523,23 +2167,41 @@ class DecodePool:
                     fresh[r] = 0.0 if p.session.started else 1.0
                     nv[r] = T
                     tok[r, :T] = p.spec_tokens
+                    if sampling:
+                        seed[r] = int(p.sampling.get("seed", 0))
+                        pos0[r] = int(p.sampling.get("pos", 0))
+                        temp[r] = float(p.sampling.get("temperature",
+                                                       1.0) or 1.0)
+                    if self.kv_paged and p.session.slot >= self.max_slots:
+                        # warmup scratch rows: fully masked, no arena
+                        # writes (see _dispatch_traced)
+                        fresh[r] = 1.0
+                        nv[r] = 0
                 idx_d = jnp.asarray(idx)
                 fresh_d = jnp.asarray(fresh)
                 xs_d = tuple(jnp.asarray(x) for x in xs_h)
                 tok_d = jnp.asarray(tok)
                 nv_d = jnp.asarray(nv)
+                args = (idx_d, fresh_d, xs_d, tok_d, nv_d)
+                if self.kv_paged:
+                    args += (self._arenas, self._kv_tables(group, Kb))
+                if sampling:
+                    args += (jnp.asarray(seed), jnp.asarray(pos0),
+                             jnp.asarray(temp))
             tel = getattr(self.model, "compile_telemetry", None)
             compiling = False
             if tel is not None:
-                compiling = tel.record(
-                    "spec_step", (idx_d, fresh_d, xs_d, tok_d, nv_d))
+                compiling = tel.record("spec_step", args)
             t0 = time.perf_counter()
             compute_entered = True
             with monitor.span("serve/decode", phase="compute"), \
                     sanitizer.guard_step(compiling=compiling):
-                outs, greedy, accept, self._pool = self._spec_jit(
-                    self.model.net_params, self._base_state(), self._pool,
-                    idx_d, fresh_d, xs_d, tok_d, nv_d)
+                res = spec_fn(self.model.net_params, self._base_state(),
+                              self._pool, *args)
+                if self.kv_paged:
+                    outs, greedy, accept, self._pool, self._arenas = res
+                else:
+                    outs, greedy, accept, self._pool = res
                 outs = np.asarray(jax.device_get(outs))
                 greedy = np.asarray(jax.device_get(greedy))
                 accept = np.asarray(jax.device_get(accept))
@@ -1550,6 +2212,10 @@ class DecodePool:
                 p.session.started = True
                 p.session.steps += 1
                 p.session.last_used = now
+                if self.kv_paged and p.session.slot < self.max_slots:
+                    # rejected tokens were rolled back in-trace, so the
+                    # device write position advanced by acc only
+                    p.session.kv_pos += acc
                 p.future.set_result((outs[r, :T], greedy[r, :T], acc))
                 # tokens counted at the step = tokens COMMITTED (the
                 # session's stream advanced by `acc`, not by the chunk)
@@ -1575,6 +2241,10 @@ class DecodePool:
                     self._pool = None
                     self._step_jit = None
                     self._spec_jit = None
+                    self._arenas = None
+                    self._arena_specs = ()
+                    self._arena_blocks = ()
+                    self._kv_free = []
                     for sid in list(self._sessions):
                         self._close_locked(sid, reason="error")
 
@@ -1597,13 +2267,23 @@ class DecodeManager:
 
     def __init__(self, model_cache, max_slots: int = 32,
                  ttl_s: float = 600.0, max_wait_ms: float = 2.0,
-                 min_batch: int = 1, retry_after_s: float = 1.0):
+                 min_batch: int = 1, retry_after_s: float = 1.0,
+                 kv_paged: Optional[bool] = None,
+                 kv_block: Optional[int] = None,
+                 kv_arena_tokens: Optional[int] = None,
+                 kv_dtype=None):
         self.model_cache = model_cache
         self.max_slots = max(1, int(max_slots))
         self.ttl_s = float(ttl_s)
         self.max_wait_ms = float(max_wait_ms)
         self.min_batch = int(min_batch)
         self.retry_after_s = float(retry_after_s)
+        # paged-KV knobs, forwarded verbatim to every pool (None defers
+        # to the DL4J_KV_* env defaults resolved in DecodePool.__init__)
+        self.kv_paged = kv_paged
+        self.kv_block = kv_block
+        self.kv_arena_tokens = kv_arena_tokens
+        self.kv_dtype = kv_dtype
         self._lock = threading.Lock()
         #: model path -> carry-layout fingerprint -> pool
         self._pools: Dict[str, Dict[str, DecodePool]] = {}
@@ -1655,7 +2335,10 @@ class DecodeManager:
                 pool = DecodePool(
                     model, name=os.path.basename(key),
                     max_slots=self.max_slots, ttl_s=self.ttl_s,
-                    max_wait_ms=self.max_wait_ms, min_batch=self.min_batch)
+                    max_wait_ms=self.max_wait_ms, min_batch=self.min_batch,
+                    kv_paged=self.kv_paged, kv_block=self.kv_block,
+                    kv_arena_tokens=self.kv_arena_tokens,
+                    kv_dtype=self.kv_dtype)
                 by_layout[layout] = pool
             # retire fully-drained pools of OTHER layouts whose model
             # is no longer cache-current (the changed-layout rollout's
@@ -1721,14 +2404,15 @@ class DecodeManager:
     def spec_step(self, session_id: str, xs, token_ids,
                   timeout_ms: Optional[float] = None,
                   tenant: Optional[str] = None,
-                  timeout: Optional[float] = 60.0):
+                  timeout: Optional[float] = 60.0,
+                  sampling: Optional[dict] = None):
         """One fused speculative-verify step for a session (see
         :meth:`DecodePool.spec_step`)."""
         pool = self._pool_of(session_id)
         try:
             return pool.spec_step(session_id, xs, token_ids,
                                   timeout=timeout, timeout_ms=timeout_ms,
-                                  tenant=tenant)
+                                  tenant=tenant, sampling=sampling)
         except KeyError:
             with self._lock:
                 self._by_sid.pop(session_id, None)

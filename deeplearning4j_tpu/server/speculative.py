@@ -19,6 +19,15 @@ Draft quality only affects SPEED (acceptance length), never output:
 a perfect draft commits K+1 tokens per dispatch, a useless one commits
 1 (the known-greedy pending token) — the plain decode rate.
 
+**Sampling mode** (``temperature=``/``top_k=``/``seed=``) extends the
+same contract beyond greedy: the verify program samples each position
+from the target distribution via a position-keyed Gumbel-argmax draw
+(equivalent to ``min(1, p/q)`` rejection sampling against the
+deterministic draft, with the residual resample built in), so the
+emitted trajectory is EXACTLY the one the non-speculative sampling
+loop would emit at the same seed — acceptance length changes only the
+dispatch count, never the tokens.
+
 Proposers are pluggable (:class:`DraftProposer`): :class:`NGramDraft`
 is the self-drafting default (suffix lookup over the session's own
 emitted history — "prompt lookup" drafting: free, and exact-K on
@@ -187,7 +196,8 @@ class SpecSession:
     migration — it does NOT ride the carry payload) only cold-starts
     drafting, never correctness."""
 
-    __slots__ = ("draft", "history", "dispatches", "proposed", "accepted")
+    __slots__ = ("draft", "history", "dispatches", "proposed", "accepted",
+                 "pos")
 
     def __init__(self, draft: DraftProposer):
         self.draft = draft
@@ -195,6 +205,9 @@ class SpecSession:
         self.dispatches = 0
         self.proposed = 0
         self.accepted = 0
+        # absolute sampling position: keys the per-token PRNG so the
+        # trajectory is independent of how tokens group into dispatches
+        self.pos = 0
 
 
 class SpeculativeDecoder:
@@ -203,13 +216,21 @@ class SpeculativeDecoder:
     ``spec_step(sid, feats, token_ids, ...)``)."""
 
     def __init__(self, stepper, vocab: int, k: int = 4,
-                 draft=None, token_to_features: Optional[Callable] = None):
+                 draft=None, token_to_features: Optional[Callable] = None,
+                 temperature: Optional[float] = None, top_k: int = 0,
+                 seed: Optional[int] = None):
         self.stepper = stepper
         self.vocab = int(vocab)
         self.k = max(0, int(k))
         self._draft_spec = draft
         self._to_feat = token_to_features or (
             lambda toks: one_hot(toks, self.vocab))
+        # sampling mode: either knob switches the verify from greedy
+        # argmax to the seeded rejection-sampled acceptance program
+        self.temperature = temperature
+        self.top_k = max(0, int(top_k))
+        self.seed = seed
+        self.sampling_on = temperature is not None or seed is not None
         self._lock = threading.Lock()
         self._sessions: Dict[str, SpecSession] = {}
 
@@ -243,8 +264,19 @@ class SpeculativeDecoder:
                       s.draft.propose(s.history + [pending], k)][:k]
             chunk = [pending] + drafts
             feats = self._to_feat(chunk)
+            kw = {}
+            if self.sampling_on:
+                kw["sampling"] = {
+                    "temperature": float(
+                        1.0 if self.temperature is None
+                        else self.temperature),
+                    "top_k": self.top_k,
+                    "seed": int(self.seed or 0),
+                    "pos": s.pos,
+                }
             _, greedy, acc = self.stepper.spec_step(
-                sid, feats, chunk, timeout_ms=timeout_ms, tenant=tenant)
+                sid, feats, chunk, timeout_ms=timeout_ms, tenant=tenant,
+                **kw)
             acc = max(1, min(int(acc), budget))
             committed = chunk[:acc]
             out.extend(committed)
@@ -252,6 +284,7 @@ class SpeculativeDecoder:
             s.draft.observe(committed)
             dispatches += 1
             proposed += len(drafts)
+            s.pos += acc
             pending = int(greedy[acc - 1])
         s.dispatches += dispatches
         s.proposed += proposed

@@ -548,3 +548,125 @@ def test_kv_ring_watch_flags_violations():
         s.exported = False
     finally:
         pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sampling-mode speculative decode (ISSUE 16): position-keyed coupling
+# ---------------------------------------------------------------------------
+def _sampled_trajectory(pool, n, *, k, draft=None, **sampling):
+    """Generate ``n`` tokens (the literal seed token 1, then sampled)."""
+    sid = pool.open_session()
+    kw = dict(vocab=V, k=k, **sampling)
+    if draft is not None:
+        kw["draft"] = draft
+    res = SpeculativeDecoder(pool, **kw).generate(sid, 1, n)
+    pool.close_session(sid)
+    return res
+
+
+@pytest.mark.parametrize("top_k", [0, 4])
+def test_sampling_spec_trajectory_parity_vs_nonspec(top_k):
+    """Seeded speculative sampling emits EXACTLY the trajectory plain
+    one-token-per-dispatch sampling emits at matched PRNG state: every
+    stream position draws with a key derived from (seed, position), so
+    the accepted prefix + first resample is chunking-independent."""
+    net = _vocab_mln(seed=13)
+    N = 16
+    for paged in (False, True):
+        pool = DecodePool(net, name=f"sm{int(paged)}{top_k}", max_slots=4,
+                          max_wait_ms=0.5, kv_paged=paged, kv_block=4)
+        try:
+            base = _sampled_trajectory(pool, N, k=0, draft="none",
+                                       temperature=0.8, top_k=top_k,
+                                       seed=123)
+            assert base["dispatches"] == N
+            spec = _sampled_trajectory(pool, N, k=3,
+                                       draft=NGramDraft(order=3),
+                                       temperature=0.8, top_k=top_k,
+                                       seed=123)
+            assert spec["tokens"] == base["tokens"], (paged, top_k)
+            # a different seed is a genuinely different trajectory —
+            # the parity above isn't vacuous determinism
+            other = _sampled_trajectory(pool, N, k=0, draft="none",
+                                        temperature=0.8, top_k=top_k,
+                                        seed=124)
+            assert other["tokens"] != base["tokens"]
+        finally:
+            pool.stop()
+
+
+def test_sampling_spec_acceptance_lengths_0_to_k_parity():
+    """Scripted drafts force every acceptance length 0..K; the emitted
+    trajectory never moves (the resample at the first rejection IS the
+    token the non-speculative run would have drawn there)."""
+    net = _vocab_mln(seed=13)
+    N, K = 14, 3
+    pool = DecodePool(net, name="smacc", max_slots=4, max_wait_ms=0.5)
+    try:
+        ref = _sampled_trajectory(pool, N, k=0, draft="none",
+                                  temperature=0.8, seed=5)["tokens"]
+        for corrupt_at in range(K + 1):
+            # draft the true continuation but corrupt index corrupt_at,
+            # pinning acceptance at exactly corrupt_at draft tokens
+            props, i = [], 1
+            while i < N:
+                p = list(ref[i:i + K])
+                if corrupt_at < len(p):
+                    p[corrupt_at] = (p[corrupt_at] + 1) % V
+                props.append(p)
+                i += max(1, min(corrupt_at + 1, len(p) + 1))
+            res = _sampled_trajectory(pool, N, k=K,
+                                      draft=ScriptedDraft(props),
+                                      temperature=0.8, seed=5)
+            assert res["tokens"] == ref, (corrupt_at, res["tokens"])
+    finally:
+        pool.stop()
+
+
+@pytest.mark.slow
+def test_sampling_spec_chi_square_matches_model_distribution():
+    """10k+ tokens sampled through the fused verify program follow the
+    model's temperature-scaled distribution (ISSUE 16): with the output
+    layer's weights zeroed the softmax head emits softmax(b) at every
+    position, so sampling at temperature t must draw iid from
+    softmax(b/t) — chi-square at alpha=0.001; top-k additionally
+    renormalizes over the k best logits and NEVER emits the rest."""
+    temp = 0.7
+    bias = np.array([0.8, -0.4, 0.2, 1.1, -0.9, 0.0], np.float32)
+    net = _vocab_mln(seed=5, window=16)
+    net.set_param("1_W", np.zeros((H, V), np.float32))
+    net.set_param("1_b", bias)
+
+    def chi2(tokens, p):
+        n = len(tokens)
+        counts = np.bincount(tokens, minlength=V).astype(np.float64)
+        exp = p * n
+        live = exp > 0
+        assert counts[~live].sum() == 0, "token outside the support"
+        return float(((counts[live] - exp[live]) ** 2 / exp[live]).sum())
+
+    pool = DecodePool(net, name="smchi", max_slots=2, max_wait_ms=0.5)
+    try:
+        # full-vocab sampling: dof = V-1 = 5, chi2(0.001) = 20.515
+        res = _sampled_trajectory(pool, 10_001, k=3,
+                                  draft=NGramDraft(order=3),
+                                  temperature=temp, seed=99)
+        toks = np.asarray(res["tokens"][1:])    # drop the literal seed
+        assert len(toks) >= 10_000
+        p = np.exp(bias / temp) / np.exp(bias / temp).sum()
+        stat = chi2(toks, p)
+        assert stat < 20.515, f"chi2={stat:.2f} vs softmax(b/t)"
+        # top-k=4: dof = 3, chi2(0.001) = 16.266; the 2 masked tokens
+        # must never appear
+        res = _sampled_trajectory(pool, 3_001, k=3,
+                                  draft=NGramDraft(order=3),
+                                  temperature=temp, top_k=4, seed=7)
+        toks = np.asarray(res["tokens"][1:])
+        keep = np.argsort(bias)[-4:]
+        pk = np.zeros(V)
+        pk[keep] = np.exp(bias[keep] / temp)
+        pk /= pk.sum()
+        stat = chi2(toks, pk)
+        assert stat < 16.266, f"chi2={stat:.2f} vs top-k renorm"
+    finally:
+        pool.stop()
