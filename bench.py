@@ -141,7 +141,7 @@ def gate_regressions(result, history_dir):
             "near_miss_threshold_pct": int(NEAR_MISS_THRESHOLD * 100),
             "keep_n": keep_n, "disabled": disabled, "checked": 0,
             "regressions": [], "margins": [], "near_misses": [],
-            "failed": False}
+            "threshold_overrides": {}, "failed": False}
     fp_key = _fingerprint_key(result.get("machine", {}))
     try:
         os.makedirs(history_dir, exist_ok=True)
@@ -158,6 +158,16 @@ def gate_regressions(result, history_dir):
                         hist = json.load(f)
                 except Exception:
                     hist = {"entries": {}}   # corrupt history never blocks
+            # per-config threshold override: a noisy config (CPU
+            # fallback legs, allocation-bound micro-benches) can carry
+            # its own gate line as top-level metadata in its history
+            # file — {"threshold_pct": 25, "entries": {...}} — tuned
+            # from the recorded pct_vs_best margin distribution
+            threshold = GATE_THRESHOLD
+            t_over = hist.get("threshold_pct")
+            if isinstance(t_over, (int, float)) and 0 < t_over < 100:
+                threshold = float(t_over) / 100.0
+                gate["threshold_overrides"][name] = float(t_over)
             entry = hist["entries"].get(fp_key)
             if entry is not None and entry.get("unit") == unit \
                     and entry.get("values"):
@@ -172,14 +182,16 @@ def gate_regressions(result, history_dir):
                     "config": name, "value": value, "unit": unit,
                     "baseline_best_of_n": baseline,
                     "pct_vs_best": pct_vs_best,
+                    "threshold_pct": int(round(threshold * 100)),
                     "history_len": len(entry["values"]),
                     "fingerprint": fp_key,
                 })
-                if value < baseline * (1.0 - GATE_THRESHOLD):
+                if value < baseline * (1.0 - threshold):
                     gate["regressions"].append({
                         "config": name, "value": value,
                         "baseline_best_of_n": baseline, "unit": unit,
                         "drop_pct": round((1 - value / baseline) * 100, 1),
+                        "threshold_pct": int(round(threshold * 100)),
                         "fingerprint": fp_key,
                     })
                 elif value < baseline * (1.0 - NEAR_MISS_THRESHOLD):
@@ -189,7 +201,7 @@ def gate_regressions(result, history_dir):
                         "config": name,
                         "drop_pct": round((1 - value / baseline) * 100, 1),
                         "gate_headroom_pct": round(
-                            GATE_THRESHOLD * 100
+                            threshold * 100
                             - (1 - value / baseline) * 100, 1),
                     })
             elif entry is not None and entry.get("unit") != unit:
@@ -207,6 +219,20 @@ def gate_regressions(result, history_dir):
             os.replace(tmp, path)
     except Exception as e:   # the gate must never kill the record itself
         gate["error"] = f"{type(e).__name__}: {e}"
+    # compact pct_vs_best roll-up: the record's headline noise picture
+    # (what the threshold tuning reads) without digging through the
+    # full per-config margin entries
+    pcts = sorted(m["pct_vs_best"] for m in gate["margins"])
+    if pcts:
+        gate["margin_summary"] = {
+            "checked": len(pcts),
+            "worst_pct_vs_best": pcts[0],
+            "median_pct_vs_best": pcts[len(pcts) // 2],
+            "best_pct_vs_best": pcts[-1],
+            "by_config": {m["config"]: m["pct_vs_best"]
+                          for m in gate["margins"]},
+        }
+        result["margins"] = gate["margin_summary"]
     gate["failed"] = bool(gate["regressions"]) and not disabled
     result["bench_gate"] = gate
     if gate["regressions"]:
@@ -1328,10 +1354,26 @@ def bench_serving():
                 leg["rows_per_batch_mean"] = s["rows_per_batch_mean"]
                 leg["requests_per_batch_mean"] = s["requests_per_batch_mean"]
                 leg["batch_size_hist"] = s["batch_size_hist"]
+        qs = getattr(model, "_q_stats", None)
+        if qs:
+            # the int8 leg's resident-weight story, from the engine's
+            # own quantization stats (ops/quantize)
+            leg["weight_bytes_quantized"] = qs["quantized_bytes"]
+            leg["weight_bytes_dense"] = qs["dense_bytes"]
         ep.close()
         return leg
 
     legs = {"per_request": run_leg(False), "coalesced": run_leg(True)}
+    # precision-tier A/B: the coalesced workload served from int8
+    # weight-only quantized params (DL4J_SERVE_QUANT routes through
+    # ModelCache → quantize_inference; dequant fuses into the traced
+    # output), vs the dense leg above.  Records the throughput ratio
+    # and the ~4x resident-weight reduction.
+    os.environ["DL4J_SERVE_QUANT"] = "int8"
+    try:
+        legs["coalesced_int8"] = run_leg(True)
+    finally:
+        os.environ.pop("DL4J_SERVE_QUANT", None)
     # instrumentation-overhead A/Bs: the coalesced workload with (a)
     # span timing and (b) the event journal hard-disabled (the
     # DL4J_SPANS=0 / DL4J_JOURNAL=0 kill-switch paths — journal emits
@@ -1448,6 +1490,8 @@ def bench_decode():
     steady-state tokens/sec with window variance, the speedup at T=256,
     and the compiled-program count, which the slot/bucket ladder must
     bound."""
+    import jax
+
     from deeplearning4j_tpu.nn.conf import layers as L
     from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -1526,7 +1570,40 @@ def bench_decode():
     stats = window_stats(times, K, 32)
     decode_programs = pool.stats().get("decode_programs", 0)
     ladder = list(pool._ladder)
+    carry_bytes_f32 = sum(int(leaf.nbytes) for leaf in
+                          jax.tree_util.tree_leaves(pool._pool))
     pool.stop()
+
+    # --- leg C: bf16 resident carry (precision tier).  Same stateful
+    # workload but the pool keeps non-KV carry leaves in bfloat16 and
+    # upcasts to f32 at the gather, so step compute is unchanged while
+    # resident carry bytes halve.  Reports the byte ratio and the
+    # steady-state throughput ratio vs the f32 pool above.
+    pool16 = DecodePool(net, name="bench16", max_slots=K, max_wait_ms=5.0,
+                        min_batch=K, carry_dtype="bfloat16")
+    sids = [pool16.open_session() for _ in range(K)]
+    tok["t"] = 0
+
+    def step_round16():
+        t = tok["t"]
+        futs = [pool16.submit_step(sid, x[i, t:t + 1])
+                for i, sid in enumerate(sids)]
+        for f in futs:
+            f.result(timeout=120)
+        tok["t"] += 1
+
+    step_round16()  # compile off-clock
+    times16 = []
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(32):
+            step_round16()
+        times16.append(time.perf_counter() - t0)
+    stats16 = window_stats(times16, K, 32)
+    carry_bytes_bf16 = sum(int(leaf.nbytes) for leaf in
+                           jax.tree_util.tree_leaves(pool16._pool))
+    pool16.stop()
+    bf16_tps = stats16["items_per_sec_median"]
 
     per_tok = [bins[str(p)]["per_token_ms"] for p in CHECKPOINTS]
     flat = max(per_tok) / max(min(per_tok), 1e-9)
@@ -1548,6 +1625,14 @@ def bench_decode():
         "decode_programs": decode_programs,
         "slot_ladder": ladder,
         "retraces_bounded_by_ladder": decode_programs <= max(1, len(ladder)),
+        "bf16_carry": {
+            "tokens_per_sec": round(bf16_tps, 1),
+            "tps_ratio_vs_f32": round(bf16_tps / max(decode_tps, 1e-9), 3),
+            "carry_bytes_f32": carry_bytes_f32,
+            "carry_bytes_bf16": carry_bytes_bf16,
+            "carry_bytes_ratio": round(
+                carry_bytes_f32 / max(carry_bytes_bf16, 1), 3),
+        },
         **stats,
     }
 
@@ -1953,11 +2038,13 @@ def bench_elastic():
     STEPS = 8
     LEASE_MS = 250.0
 
-    def make_net(dist):
+    def make_net(dist, quant=None):
         b = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.01)
              .updater("adam"))
         if dist:
             b.distributed(processes=2, heartbeat_ms=50, lease_ms=LEASE_MS)
+        if quant:
+            b.precision(grad_allreduce=quant)
         conf = (b.list()
                 .layer(L.DenseLayer(n_in=FEAT, n_out=HID,
                                     activation="relu"))
@@ -1987,10 +2074,20 @@ def bench_elastic():
     single = window_stats(single_times, ROWS, STEPS)
 
     # -- leg 2: 2-worker cluster steady state -------------------------
+    from deeplearning4j_tpu import monitor
+
+    def _grad_bytes(dtype):
+        fam = monitor.get_registry().get("dl4j_precision_grad_bytes_total")
+        if fam is None:
+            return 0.0
+        return sum(s["value"] for s in fam.samples()
+                   if s["labels"].get("dtype") == dtype)
+
     faults_mod.reset()
     co = Coordinator(expected=2, lease_ms=LEASE_MS)
     cluster_times = []
     errors = []
+    f32_bytes0 = _grad_bytes("float32")
 
     def steady_worker(wid):
         try:
@@ -2016,6 +2113,54 @@ def bench_elastic():
         t.join(600)
     assert not errors, errors
     cluster = window_stats(cluster_times, ROWS, STEPS)
+    f32_bytes = _grad_bytes("float32") - f32_bytes0
+
+    # -- leg 4 (run before the chaos leg so counters stay clean):
+    # quantized-gradient cluster (precision tier).  Same 2-worker
+    # steady state, but every barrier contribution ships int8 codes +
+    # per-block scales with persistent error feedback
+    # (conf.precision(grad_allreduce="int8")).  Measures bytes-per-step
+    # through the engine's own dl4j_precision_grad_bytes_total counter
+    # — the ACTUAL wire payload sizes, not an estimate — plus the
+    # step-time ratio and cross-worker bit-identity of final params.
+    faults_mod.reset()
+    co4 = Coordinator(expected=2, lease_ms=LEASE_MS)
+    quant_times = []
+    qerrors = []
+    qparams = {}
+
+    def quant_worker(wid):
+        try:
+            wnet = make_net(dist=True, quant="int8")
+            sess = DistSession(co4, wid, heartbeat_ms=50)
+            sess.connect()
+            wnet._dist_session = sess
+            wnet.fit(ListDataSetIterator(batches(2)))   # warm
+            for ws in window_sets:
+                t0 = time.perf_counter()
+                wnet.fit(ListDataSetIterator(list(ws)))
+                if wid == "q0":
+                    quant_times.append(time.perf_counter() - t0)
+            qparams[wid] = np.ascontiguousarray(
+                np.asarray(wnet.params()), np.float32)
+            sess.close()
+        except BaseException as e:  # noqa: BLE001
+            qerrors.append(f"{wid}: {type(e).__name__}: {e}")
+
+    int8_bytes0 = _grad_bytes("int8")
+    threads = [threading.Thread(target=quant_worker, args=(f"q{i}",))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    assert not qerrors, qerrors
+    int8_bytes = _grad_bytes("int8") - int8_bytes0
+    quant = window_stats(quant_times, ROWS, STEPS)
+    # both legs run the identical step structure (2 warm + WINDOWS*STEPS
+    # per worker), so per-step bytes divide by the same count
+    barrier_steps = 2 * (2 + WINDOWS * STEPS)
+    bytes_reduction = f32_bytes / max(int8_bytes, 1e-9)
 
     # -- leg 3: time-to-recover from a killed worker ------------------
     faults_mod.reset()
@@ -2081,6 +2226,20 @@ def bench_elastic():
                                        round(steady_ms, 1)],
         "lease_ms": LEASE_MS,
         "generations": co2.status()["generation"],
+        "grad_quant": {
+            "quant_active": int8_bytes > 0,
+            "bytes_per_step_fp32": round(f32_bytes / barrier_steps, 1),
+            "bytes_per_step_int8": round(int8_bytes / barrier_steps, 1),
+            "bytes_reduction_x": round(bytes_reduction, 3),
+            "meets_3_5x_target": int8_bytes > 0 and bytes_reduction >= 3.5,
+            "step_time_ratio_vs_fp32": round(
+                quant["step_time_ms_median"]
+                / max(cluster["step_time_ms_median"], 1e-9), 3),
+            "cluster_2w_int8": quant,
+            "workers_bit_identical": bool(
+                len(qparams) == 2
+                and np.array_equal(qparams["q0"], qparams["q1"])),
+        },
         **{k: v for k, v in cluster.items()
            if k.startswith("items_per_sec") or k in (
                "window_rel_spread", "best_of", "window_sec",
@@ -2470,6 +2629,16 @@ def _run_configs(result):
         if os.environ.get("DL4J_BENCH_SCAN") == "1":
             config_list.insert(2, ("lenet_scan", bench_lenet_scan))
     if dry_run:
+        # the precision A/B legs (int8 serving, bf16 decode carry,
+        # quantized gradient all-reduce) ride bench_serving /
+        # bench_decode / bench_elastic — those configs must stay
+        # registered whichever order branch (TPU-first insertions or
+        # the CPU-fallback sort) built the final list
+        names = [n for n, _ in config_list]
+        for cfg in ("bench_serving", "bench_decode", "bench_elastic"):
+            assert cfg in names, (cfg, names)
+        result["precision_ab_configs"] = [
+            "bench_serving", "bench_decode", "bench_elastic"]
         # the lint gate rides the dry-run smoke: a rule regression (or a
         # new unsuppressed finding) fails tier-1 loudly, next to the
         # record-plumbing checks this path already covers

@@ -354,3 +354,101 @@ class RetraceRisk(Rule):
                             "static_argnums: a different value silently "
                             "retraces — key the jit cache on it or mark "
                             "it static")
+
+
+#: numpy constructors whose DEFAULT dtype is float64
+_NP_F64_CTORS = {"zeros", "ones", "full", "empty", "arange", "eye",
+                 "linspace", "identity"}
+_NP_MODS = {"np", "numpy", "onp"}
+_F64_TOKENS = {"float64", "double", "f8"}
+_DTYPE_LEAF_PREFIXES = ("float", "int", "uint", "bfloat", "bool",
+                        "complex", "dtype")
+
+
+def _is_f64_token(node: ast.AST) -> bool:
+    """``np.float64`` / ``jnp.float64`` / ``"float64"`` / bare
+    ``float`` used as a dtype (numpy resolves it to float64)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, str) and node.value in _F64_TOKENS
+    if isinstance(node, ast.Name):
+        return node.id == "float"
+    chain = _attr_chain(node) or ""
+    return chain.split(".")[-1] in _F64_TOKENS
+
+
+def _passes_dtype(call: ast.Call) -> bool:
+    """Whether the constructor call pins a dtype (kwarg, or an obvious
+    dtype-looking positional like ``np.zeros((2, 2), np.float32)``)."""
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    for a in call.args:
+        if isinstance(a, ast.Name) and a.id in ("float", "int", "bool"):
+            return True
+        chain = _attr_chain(a) or ""
+        if chain.split(".")[-1].startswith(_DTYPE_LEAF_PREFIXES):
+            return True
+    return False
+
+
+@register
+class Fp64PromotionInJit(Rule):
+    id = "DL4J106"
+    name = "tracer-fp64-promotion"
+    severity = WARNING
+    doc = ("Implicit fp64 in jit-reachable functions: explicit "
+           "float64/double dtype tokens (dtype=np.float64, "
+           ".astype('float64'), np.float64(x)) and dtype-less numpy "
+           "constructors (np.zeros/ones/full/empty/arange/eye/linspace/"
+           "identity default to float64).  Under the default "
+           "x64-disabled config the value silently demotes at the next "
+           "jnp op; with x64 enabled it silently promotes the whole "
+           "step to fp64 — either way the precision tier the conf "
+           "selected is not what actually runs.  Pin dtype=np.float32 "
+           "or use the jnp constructors (float32 by default).")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        for info in project.jit_reachable():
+            for node in _scan_nodes(info):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = _attr_chain(node.func) or ""
+                parts = chain.split(".")
+                leaf = parts[-1]
+                # (a) dtype-less numpy constructor → float64 default
+                if len(parts) == 2 and parts[0] in _NP_MODS \
+                        and leaf in _NP_F64_CTORS \
+                        and not _passes_dtype(node):
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{chain}() without dtype inside jit-reachable "
+                        f"`{info.name}` materializes float64 (numpy's "
+                        "default) — pin dtype=np.float32 or use jnp."
+                        f"{leaf}")
+                    continue
+                # (b) explicit float64 scalar/array construction
+                if leaf in _F64_TOKENS and len(parts) >= 2:
+                    yield self.finding(
+                        project, node, info.path,
+                        f"{chain}() inside jit-reachable `{info.name}` "
+                        "forces fp64 — traced compute should stay in "
+                        "the conf-selected precision tier")
+                    continue
+                # (c) .astype(float64-ish) on anything
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args \
+                        and _is_f64_token(node.args[0]):
+                    yield self.finding(
+                        project, node, info.path,
+                        f".astype(float64) inside jit-reachable "
+                        f"`{info.name}` promotes to fp64 — cast to the "
+                        "tier dtype (float32/bfloat16) instead")
+                    continue
+                # (d) explicit dtype=float64 kwarg on any call
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_f64_token(kw.value):
+                        yield self.finding(
+                            project, node, info.path,
+                            f"dtype=float64 on {chain or leaf}() inside "
+                            f"jit-reachable `{info.name}` — traced "
+                            "buffers should use the tier dtype")
+                        break

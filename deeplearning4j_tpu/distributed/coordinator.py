@@ -345,18 +345,38 @@ class Coordinator:
         active = self._active_locked()
         return bool(active) and active[0].id == m.id
 
+    @staticmethod
+    def _contribution_f64(vec, scales) -> np.ndarray:
+        """A contribution as the float64 vector the rank-order reduce
+        accumulates.  A quantized contribution arrives as int8 block
+        codes in ``vec`` plus ``scales`` = [raw f32 score, per-block
+        scales...] (the ops/quantize wire shape); dequantization is
+        exact (int8 × f32 is representable in f32), so int8 and f32
+        contributors share one bit-stable accumulation order — mixed
+        fleets interoperate, the npy wire dtype says which is which."""
+        arr = np.asarray(vec)
+        if scales is None or arr.dtype != np.int8:
+            return np.asarray(vec, np.float64).ravel()
+        from deeplearning4j_tpu.ops import quantize as qz
+        s = np.asarray(scales, np.float32).ravel()
+        grads = qz.dequantize_blocks(arr.ravel(), s[1:])
+        return np.concatenate([s[:1].astype(np.float64),
+                               grads.astype(np.float64)])
+
     def allreduce(self, worker_id: str, generation: int, step: int,
-                  weight: float, vec) -> dict:
+                  weight: float, vec, scales=None) -> dict:
         """One worker's contribution to global step ``step`` (must be
         the next uncommitted step).  Blocks until every active member of
         the CURRENT generation has contributed, then returns the
         weighted mean (float64 accumulation in rank order — bit-stable
-        across runs).  If the generation rolls while waiting (a peer
-        died, a peer was absorbed), returns ``{"rolled": True}`` with
-        the fresh placement and the caller recomputes its shard under
-        the new world."""
+        across runs).  ``scales`` marks an int8-quantized contribution
+        (see :meth:`_contribution_f64`); it is dequantized here, at
+        admission, so the barrier and reduce below never see dtypes.
+        If the generation rolls while waiting (a peer died, a peer was
+        absorbed), returns ``{"rolled": True}`` with the fresh placement
+        and the caller recomputes its shard under the new world."""
         t0 = time.perf_counter()
-        vec64 = np.asarray(vec, np.float64).ravel()
+        vec64 = self._contribution_f64(vec, scales)
         with self._lock:
             self._sweep_locked()
             m = self._members.get(worker_id)

@@ -32,11 +32,18 @@ class CoordinatorUnavailableError(TransientError):
 
 
 def encode_vec(vec) -> Optional[str]:
-    """float32 vector → base64 ``.npy`` (bit-exact round trip)."""
+    """vector → base64 ``.npy`` (bit-exact round trip).  The npy header
+    carries the dtype on the wire, which is what lets mixed fleets
+    interoperate: int8 arrays (quantized gradient codes — see
+    ops/quantize) ship as-is at 1/4 the bytes, every other dtype is
+    coerced to float32 exactly as before."""
     if vec is None:
         return None
+    arr = np.asarray(vec)
+    if arr.dtype != np.int8:
+        arr = arr.astype(np.float32)
     buf = io.BytesIO()
-    np.save(buf, np.asarray(vec, np.float32), allow_pickle=False)
+    np.save(buf, arr, allow_pickle=False)
     return base64.b64encode(buf.getvalue()).decode("ascii")
 
 
@@ -48,7 +55,8 @@ def decode_vec(blob: Optional[str]):
 
 
 #: request/response fields carried as binary npy instead of JSON lists
-_VEC_FIELDS = ("vec", "params", "updater")
+#: ("scales" = the quantized contribution's [score, per-block scales])
+_VEC_FIELDS = ("vec", "params", "updater", "scales")
 
 
 def _pack(doc: dict) -> dict:
@@ -199,10 +207,11 @@ class CoordinatorClient:
     def placement(self, worker_id=None):
         return self._call("placement", worker_id=worker_id)
 
-    def allreduce(self, worker_id, generation, step, weight, vec):
+    def allreduce(self, worker_id, generation, step, weight, vec,
+                  scales=None):
         return self._call("allreduce", worker_id=worker_id,
                           generation=generation, step=step,
-                          weight=weight, vec=vec)
+                          weight=weight, vec=vec, scales=scales)
 
     def put_snapshot(self, worker_id, step, params, updater, meta=None):
         return self._call("put_snapshot", worker_id=worker_id, step=step,

@@ -48,6 +48,8 @@ import numpy as np
 
 from deeplearning4j_tpu import monitor
 from deeplearning4j_tpu.monitor import events
+from deeplearning4j_tpu.ops import helpers as prec_helpers
+from deeplearning4j_tpu.ops import quantize as qz
 from deeplearning4j_tpu.resilience import faults
 from deeplearning4j_tpu.resilience.errors import TransientError
 
@@ -97,6 +99,10 @@ class DistSession:
         self.rejoin = bool(rejoin)
         self.closed = False
         self.pending_skip = 0
+        #: persistent error-feedback residual for the quantized-gradient
+        #: tier (ops/quantize.ErrorFeedback) — lives on the session so
+        #: it survives across steps but dies with the membership
+        self.grad_ef: Optional[qz.ErrorFeedback] = None
         self._generation = 0
         self._rank = -1
         self._world = 0
@@ -212,16 +218,19 @@ class DistSession:
                 f"worker {self.worker_id} evicted (lease lapsed) at "
                 f"iteration {iteration}")
 
-    def allreduce_step(self, step: int, vec, weight: float) -> dict:
+    def allreduce_step(self, step: int, vec, weight: float,
+                       scales=None) -> dict:
         """Contribute to global step ``step`` and block for the reduced
-        result.  Raises :class:`GenerationRolled` when membership
-        changed mid-barrier (recompute), :class:`WorkerEvictedError`
-        when this worker was fenced out for good."""
+        result.  ``scales`` marks ``vec`` as int8 block codes (the
+        quantized-gradient tier); dense f32 contributions leave it None.
+        Raises :class:`GenerationRolled` when membership changed
+        mid-barrier (recompute), :class:`WorkerEvictedError` when this
+        worker was fenced out for good."""
         while True:
             try:
                 resp = self.coordinator.allreduce(
                     self.worker_id, self._generation, step,
-                    float(weight), vec)
+                    float(weight), vec, scales)
             except TransientError:
                 time.sleep(0.05)
                 continue
@@ -479,12 +488,31 @@ def fit_batch(model, ds, sess: DistSession, is_graph: bool) -> None:
             sess.upload_snapshot(model)
 
 
+def _grad_quant_on(model) -> bool:
+    """Whether this worker's barrier contribution goes int8.  Conf
+    opt-in (``dist_grad_quant``) composes with the precision-tier
+    registry: ``DL4J_DIST_QUANT=0`` kills it fleet-wide, ``=1`` forces
+    it on, and the warm self-test must pass once per process (a failure
+    disables the tier and the worker falls back to dense f32 — the
+    coordinator accepts both, so a partial rollout still trains)."""
+    mode = getattr(model.conf.global_conf, "dist_grad_quant", None)
+    return bool(prec_helpers.precision_enabled("grad_quant", mode)
+                and prec_helpers.ensure_precision_validated("grad_quant"))
+
+
 def _barrier_step(model, ds, sess: DistSession, is_graph: bool,
                   fns: dict, step_target: int, n: int):
     """Shard-compute + barrier for ONE global step, retrying across
     generation rolls and resyncing across evictions.  Returns
     ``(reduce response, local new_states)`` — or ``(None, None)`` when
-    the batch was consumed by a replay-skip after a resync."""
+    the batch was consumed by a replay-skip after a resync.
+
+    Under the quantized-gradient tier the contribution is int8 block
+    codes + per-block scales with a persistent error-feedback residual:
+    the residual is folded in BEFORE quantizing, committed only once the
+    barrier ACCEPTS the contribution, and reset whenever the generation
+    rolls or this worker resyncs (the shard it compensated for no longer
+    exists).  The raw f32 score rides as ``scales[0]`` — unquantized."""
     while True:
         try:
             with monitor.span("fit/step", phase="dist_barrier"):
@@ -498,15 +526,38 @@ def _barrier_step(model, ds, sess: DistSession, is_graph: bool,
                     model.net_params, model.net_state, xs, ys, fms, lms,
                     sub)
                 flat = _flatten_leaves(grads)
-            payload = np.concatenate(
-                [np.asarray([score], np.float32), flat])
+            quant = _grad_quant_on(model)
+            if quant:
+                if sess.grad_ef is None:
+                    sess.grad_ef = qz.ErrorFeedback()
+                comp, codes, bscales = sess.grad_ef.compensate(flat)
+                payload = codes
+                wire_scales = np.concatenate(
+                    [np.asarray([score], np.float32), bscales])
+            else:
+                payload = np.concatenate(
+                    [np.asarray([score], np.float32), flat])
+                wire_scales = None
             with monitor.span("fit/step", phase="dist_barrier"):
                 resp = sess.allreduce_step(step_target, payload,
-                                           weight=hi - lo)
+                                           weight=hi - lo,
+                                           scales=wire_scales)
+            if quant:
+                # the barrier accepted this contribution: the residual
+                # becomes what the quantizer dropped this step
+                sess.grad_ef.commit(comp, codes, bscales)
+                qz.record_grad_bytes(
+                    "int8", payload.nbytes + wire_scales.nbytes)
+            else:
+                qz.record_grad_bytes("float32", payload.nbytes)
             return resp, new_states
         except GenerationRolled:
+            if sess.grad_ef is not None:
+                sess.grad_ef.reset("generation_rolled")
             continue     # same step, new shard bounds
         except WorkerEvictedError:
+            if sess.grad_ef is not None:
+                sess.grad_ef.reset("evicted")
             if not sess.rejoin:
                 raise
             before = model.iteration

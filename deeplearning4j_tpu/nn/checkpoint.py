@@ -140,6 +140,32 @@ def _dist_meta(model):
         return None
 
 
+def _precision_meta(model):
+    """The precision tiers active at save time (None when everything is
+    dense fp32 — which is also what manifests from before this field
+    implied).  The coefficients in the zip are ALWAYS the fp32 master
+    vector, so a checkpoint restores under any tier; configuration.json
+    carries the conf knobs, so a cross-load restore re-activates the
+    same tiers.  This records the tier for the manifest reader — which
+    precision regime trained the weights."""
+    try:
+        import numpy as np
+        g = model.conf.global_conf
+        from deeplearning4j_tpu.ops import dtypes as dtype_ops
+        pol = dtype_ops.resolve(getattr(g, "precision", None))
+        tiers = {
+            "compute": np.dtype(pol.compute_dtype).name,
+            "infer_quant": getattr(g, "precision_infer_quant", None),
+            "grad_quant": getattr(g, "dist_grad_quant", None),
+        }
+        if tiers["compute"] == "float32" and not tiers["infer_quant"] \
+                and not tiers["grad_quant"]:
+            return None
+        return tiers
+    except Exception:
+        return None
+
+
 def _count_fallback() -> None:
     try:
         from deeplearning4j_tpu import monitor
@@ -224,7 +250,12 @@ class CheckpointListener(TrainingListener):
                 # cluster placement at save time (None outside
                 # distributed training) — restores work across process
                 # counts; this is provenance, not a constraint
-                "dist": _dist_meta(model)}
+                "dist": _dist_meta(model),
+                # precision tiers active at save time (None = dense
+                # fp32); the coefficients stay the fp32 masters, so a
+                # checkpoint restores under any tier — the conf inside
+                # the zip re-activates the same tiers on cross-load
+                "precision": _precision_meta(model)}
         self._update_manifest(meta)
         # legacy single-entry index, kept for older readers
         _atomic_write_text(self.dir / "checkpoint_index.json",
@@ -296,12 +327,12 @@ def _checkpoint_meta(directory, path: Path) -> dict:
     meta = {"file": path.name,
             "iteration": int(m.group(1)) if m else 0,
             "epoch": None, "iteration_in_epoch": None, "sharding": None,
-            "dist": None}
+            "dist": None, "precision": None}
     for e in read_manifest(directory):
         if e.get("file") == path.name:
             meta.update({k: e.get(k, meta.get(k)) for k in
                          ("epoch", "iteration_in_epoch", "model_class",
-                          "sharding", "dist")})
+                          "sharding", "dist", "precision")})
             return meta
     idx = Path(directory) / "checkpoint_index.json"
     if idx.exists():

@@ -59,6 +59,13 @@ class GlobalConf:
     # compute on TPU, f32 elsewhere); 'float32' | 'bfloat16' | 'float64'.
     # Master params/updater state stay float32 either way (ops/dtypes.py).
     precision: Optional[str] = None
+    # Weight-only quantized inference (ops/quantize.py): 'int8' | 'fp8'
+    # quantizes every ndim>=2 float param per-output-channel once on the
+    # host and dequantizes in-trace, so output()/serving hold ~4x
+    # smaller resident weights.  None = dense serving, byte-identical
+    # to the pre-tier path.  Selection goes through the precision-tier
+    # registry (ops/helpers.py): DL4J_PRECISION_{INT8,FP8}=0 kills it.
+    precision_infer_quant: Optional[str] = None
     # Rematerialization: recompute each layer's forward during backward
     # instead of keeping its activations in HBM (jax.checkpoint per
     # layer/vertex) — the FLOPs-for-memory trade for deep nets on TPU.
@@ -120,6 +127,13 @@ class GlobalConf:
     dist_coordinator: Optional[str] = None
     dist_heartbeat_ms: float = 250.0
     dist_lease_ms: float = 2000.0
+    # Quantized gradient all-reduce (ops/quantize.py): 'int8' makes the
+    # worker's barrier contribution int8 codes + per-block scales with a
+    # persistent error-feedback residual (~4x fewer cross-host bytes;
+    # the coordinator dequantizes per contribution before its rank-order
+    # accumulation, so mixed fleets interoperate).  None = fp32 wire,
+    # byte-identical to the pre-tier path.  DL4J_DIST_QUANT=0 kills it.
+    dist_grad_quant: Optional[str] = None
 
 
 _MERGE_FIELDS = [
@@ -299,10 +313,29 @@ class Builder:
         self._g.gradient_normalization_threshold = float(threshold)
         return self
 
-    def precision(self, p: Optional[str]):
-        """Mixed-precision policy: 'bfloat16' (TPU fast path), 'float32',
-        'float64', or None/'auto' (bf16 on TPU, f32 elsewhere)."""
-        self._g.precision = p
+    _UNSET = object()
+
+    def precision(self, p=_UNSET, *, compute: Optional[str] = None,
+                  infer_quant=_UNSET, grad_allreduce=_UNSET):
+        """Precision tiers (docs/PERFORMANCE.md "Precision tiers").
+
+        ``compute`` (or the positional ``p``): mixed-precision policy
+        for the compiled step — 'bfloat16' (TPU fast path: bf16
+        activations/matmuls, f32 master weights, f32 accumulation),
+        'float32', 'float64', or None/'auto' (bf16 on TPU, f32
+        elsewhere).  ``infer_quant``: 'int8' | 'fp8' weight-only
+        quantized serving (dequant-in-trace, ~4x smaller resident
+        weights).  ``grad_allreduce``: 'int8' block-quantized
+        error-feedback gradient collectives for distributed fit.
+        Every tier is byte-identical to the dense path when unset."""
+        if compute is not None:
+            self._g.precision = compute
+        elif p is not Builder._UNSET:
+            self._g.precision = p
+        if infer_quant is not Builder._UNSET:
+            self._g.precision_infer_quant = infer_quant
+        if grad_allreduce is not Builder._UNSET:
+            self._g.dist_grad_quant = grad_allreduce
         return self
 
     def gradient_checkpointing(self, on: bool = True):

@@ -622,7 +622,8 @@ class ComputationGraph:
         tok = (seq_ops.cache_token(),
                dtype_ops.resolve(self.conf.global_conf.precision),
                self.conf.global_conf.gradient_checkpointing,
-               fsdp.conf_key(self.conf.global_conf))
+               fsdp.conf_key(self.conf.global_conf),
+               getattr(self, "_infer_quant", None))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -910,6 +911,51 @@ class ComputationGraph:
         self._strip_rnn_state()
 
     # ------------------------------------------------------------------
+    def quantize_inference(self, mode: str = "int8"):
+        """Weight-only quantized serving — see
+        MultiLayerNetwork.quantize_inference (same tier registry,
+        kill switches and lazy re-quantization over the vertex-dict
+        param pytree)."""
+        from deeplearning4j_tpu.ops import helpers as pallas_helpers
+        if mode is None:
+            self._infer_quant = None
+            self._q_params = None
+            self._check_trace_token()
+            return self
+        if self.net_params is None:
+            self.init()
+        self._ensure_sharding()
+        mode = str(mode).lower()
+        if mode not in ("int8", "fp8"):
+            raise ValueError(f"unknown inference quantization '{mode}' "
+                             "(known: int8, fp8)")
+        if getattr(self, "_sharding_plan", None) is not None:
+            return self  # sharded serving keeps the dense fsdp layout
+        tier = f"{mode}_infer"
+        if not (pallas_helpers.precision_enabled(tier, True)
+                and pallas_helpers.ensure_precision_validated(tier)):
+            self._infer_quant = None
+            self._q_params = None
+            self._check_trace_token()
+            return self
+        self._infer_quant = mode
+        self._q_params = None
+        self._check_trace_token()
+        return self
+
+    def _infer_params(self):
+        """See MultiLayerNetwork._infer_params."""
+        quant = getattr(self, "_infer_quant", None)
+        if quant is None:
+            return self.net_params
+        if getattr(self, "_q_params", None) is None \
+                or getattr(self, "_q_iteration", -1) != self.iteration:
+            from deeplearning4j_tpu.ops import quantize as qz
+            self._q_params, self._q_stats = qz.quantize_params(
+                self.net_params, quant)
+            self._q_iteration = self.iteration
+        return self._q_params
+
     def output(self, *inputs, train: bool = False):
         """Multi-output inference in topological order
         (ref: ComputationGraph feedForward/outputs)."""
@@ -919,8 +965,14 @@ class ComputationGraph:
         self._ensure_sharding()
         if self._output_fn is None:
             policy = dtype_ops.resolve(self.conf.global_conf.precision)
+            quant = getattr(self, "_infer_quant", None)
 
             def out_fn(params, state, xs, ms):
+                if quant is not None:
+                    # dequant-in-trace: int8/fp8 codes + per-channel
+                    # scales expand inside the compiled program
+                    from deeplearning4j_tpu.ops import quantize as qz
+                    params = qz.dequantize_params(params)
                 pc, xs_c, ms_c = policy.cast_to_compute((params, xs, ms))
                 ins = dict(zip(self.conf.network_inputs, xs_c))
                 masks = ({n: m for n, m in zip(self.conf.network_inputs,
@@ -975,7 +1027,7 @@ class ComputationGraph:
                           for m in ms_p)
         xs = tuple(jnp.asarray(x) for x in inputs)
         self.compile_telemetry.record("output", (xs, masks), bucket=bucket)
-        outs = self._output_fn(self.net_params, state, xs, masks)
+        outs = self._output_fn(self._infer_params(), state, xs, masks)
         if unpad is not None:
             n, pairs = unpad
             outs = tuple(self._unpad_graph_output(o, n, pairs)

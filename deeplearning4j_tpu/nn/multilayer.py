@@ -237,7 +237,8 @@ class MultiLayerNetwork:
         tok = (seq_ops.cache_token(),
                dtype_ops.resolve(self.conf.global_conf.precision),
                self.conf.global_conf.gradient_checkpointing,
-               fsdp.conf_key(self.conf.global_conf))
+               fsdp.conf_key(self.conf.global_conf),
+               getattr(self, "_infer_quant", None))
         if tok != getattr(self, "_trace_token", None):
             self._trace_token = tok
             self._step_fn = self._score_fn = self._output_fn = None
@@ -451,8 +452,15 @@ class MultiLayerNetwork:
 
     def _build_output_fn(self):
         policy = dtype_ops.resolve(self.conf.global_conf.precision)
+        quant = getattr(self, "_infer_quant", None)
 
         def output_fn(params, state, x, fmask):
+            if quant is not None:
+                # weight-only quantized serving: params arrive as int8/
+                # fp8 codes + per-channel scales; the expand fuses into
+                # the first consumer matmul (ops/quantize.py)
+                from deeplearning4j_tpu.ops import quantize as qz
+                params = qz.dequantize_params(params)
             pc, xc, fmc = policy.cast_to_compute((params, x, fmask))
             out, _, _ = self._forward(pc, state, xc, fmc, False,
                                       jax.random.PRNGKey(0))
@@ -977,6 +985,61 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # Inference API
     # ------------------------------------------------------------------
+    def quantize_inference(self, mode: str = "int8"):
+        """Serve from weight-only quantized params (docs/PERFORMANCE.md
+        "Precision tiers"): every ndim>=2 float param becomes int8 (or
+        fp8) codes + per-channel f32 scales, dequantized IN-TRACE, so
+        ``output()``/the micro-batcher/warmup hold ~4x-smaller resident
+        weights.  Selection goes through the precision-tier registry
+        (ops/helpers.py): the tier's parity self-test runs first, and a
+        kill switch (``DL4J_PRECISION_{INT8,FP8}=0``) or failed
+        self-test degrades to dense serving.  ``mode=None`` restores
+        dense serving.  Inert under a sharding plan (sharded serving
+        keeps the fsdp layout).  Training is untouched — fit() keeps
+        the fp32 master params, and the codes refresh from them lazily
+        after further training."""
+        from deeplearning4j_tpu.ops import helpers as pallas_helpers
+        if mode is None:
+            self._infer_quant = None
+            self._q_params = None
+            self._check_trace_token()
+            return self
+        if self.net_params is None:
+            self.init()
+        self._ensure_sharding()
+        mode = str(mode).lower()
+        if mode not in ("int8", "fp8"):
+            raise ValueError(f"unknown inference quantization '{mode}' "
+                             "(known: int8, fp8)")
+        if getattr(self, "_sharding_plan", None) is not None:
+            return self  # sharded serving keeps the dense fsdp layout
+        tier = f"{mode}_infer"
+        if not (pallas_helpers.precision_enabled(tier, True)
+                and pallas_helpers.ensure_precision_validated(tier)):
+            self._infer_quant = None
+            self._q_params = None
+            self._check_trace_token()
+            return self
+        self._infer_quant = mode
+        self._q_params = None  # re-quantized lazily by _infer_params
+        self._check_trace_token()
+        return self
+
+    def _infer_params(self):
+        """Params for the serving path: the quantized codes when the
+        int8/fp8 tier is on (refreshed when training moved the masters
+        since the last quantization), else the dense params."""
+        quant = getattr(self, "_infer_quant", None)
+        if quant is None:
+            return self.net_params
+        if getattr(self, "_q_params", None) is None \
+                or getattr(self, "_q_iteration", -1) != self.iteration:
+            from deeplearning4j_tpu.ops import quantize as qz
+            self._q_params, self._q_stats = qz.quantize_params(
+                self.net_params, quant)
+            self._q_iteration = self.iteration
+        return self._q_params
+
     def output(self, x, train: bool = False, mask=None):
         """(ref: MultiLayerNetwork.output :1668)"""
         if self.net_params is None:
@@ -1000,7 +1063,7 @@ class MultiLayerNetwork:
             if n_real is not None and unpad is None:
                 unpad = (n_real, None, None)
         self.compile_telemetry.record("output", (x, mask), bucket=bucket)
-        out = self._output_fn(self.net_params,
+        out = self._output_fn(self._infer_params(),
                               [{k: v for k, v in s.items() if k != "rnn_state"}
                                for s in self.net_state],
                               jnp.asarray(x),

@@ -120,9 +120,19 @@ def resolve(name: str | None) -> Policy:
     """Map a config string ('float32' | 'bfloat16' | 'float64' | None=auto)
     to a Policy.  The engine calls this at trace-build time."""
     if name is None or name == "auto":
-        return default_policy()
-    try:
-        return _NAMED[name.lower()]
-    except KeyError:
-        raise ValueError(f"Unknown precision '{name}'. "
-                         f"Known: {sorted(_NAMED)} or 'auto'") from None
+        policy = default_policy()
+    else:
+        try:
+            policy = _NAMED[name.lower()]
+        except KeyError:
+            raise ValueError(f"Unknown precision '{name}'. "
+                             f"Known: {sorted(_NAMED)} or 'auto'") from None
+    if policy.is_mixed:
+        # the bf16_train precision tier gates HERE, the single boundary
+        # every engine resolves policies through: DL4J_PRECISION=0 /
+        # DL4J_PRECISION_BF16=0 force the f32 path byte-identically to
+        # an untiered conf (explicit bf16 AND the TPU auto default)
+        from deeplearning4j_tpu.ops import helpers as _prec_helpers
+        if not _prec_helpers.precision_enabled("bf16_train", True):
+            return FLOAT32
+    return policy

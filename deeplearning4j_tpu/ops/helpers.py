@@ -393,3 +393,102 @@ def reset_validation() -> None:
     with _WARM_LOCK:
         _WARM["done"] = False
         _WARM["result"] = None
+
+
+# ---------------------------------------------------------------------------
+# Precision tiers (ISSUE 19) — reduced-precision compute/wire paths
+# behind the SAME selection contract as the kernel tiers: conf opts in,
+# env kill switches override, a failed parity self-test flips a runtime
+# kill, and every decision is metered.  Call sites ask this registry
+# (``precision_enabled``) instead of reading conf/env themselves.
+# ---------------------------------------------------------------------------
+
+class PrecisionTier(NamedTuple):
+    tier: str                       # registry key
+    env: str                        # kill-switch env var
+    self_test: Callable[[], None]   # bounded-ε parity validation
+
+
+def _precision_tiers() -> Dict[str, "PrecisionTier"]:
+    from deeplearning4j_tpu.ops import quantize as q
+    return {
+        "bf16_train": PrecisionTier("bf16_train", "DL4J_PRECISION_BF16",
+                                    lambda: None),  # ops/dtypes casts; no
+        # quantization parity to validate — tests pin the ε-bound
+        "int8_infer": PrecisionTier("int8_infer", "DL4J_PRECISION_INT8",
+                                    q._selftest_int8_weights),
+        "fp8_infer": PrecisionTier("fp8_infer", "DL4J_PRECISION_FP8",
+                                   q._selftest_fp8_weights),
+        "grad_quant": PrecisionTier("grad_quant", "DL4J_DIST_QUANT",
+                                    q._selftest_grad_blocks),
+    }
+
+
+PRECISION_TIERS = ("bf16_train", "int8_infer", "fp8_infer", "grad_quant")
+
+
+def precision_enabled(tier: str, configured: bool) -> bool:
+    """Trace-time tier selection: does ``tier`` engage for a call site
+    whose conf asks for ``configured``?  Order mirrors :func:`available`:
+    global kill → runtime (self-test) kill → per-tier env (0 forces off,
+    1 forces on) → the conf's word.  The decision is metered under
+    ``dl4j_precision_selected_total{tier,on}``."""
+    from deeplearning4j_tpu.ops import quantize as q
+    tiers = _precision_tiers()
+    if tier not in tiers:
+        raise KeyError(f"unknown precision tier '{tier}' "
+                       f"(known: {PRECISION_TIERS})")
+    if os.environ.get("DL4J_PRECISION") == "0":  # dl4j: noqa[DL4J103] env kill switch read at trace time by design (fixed per process)
+        on = False
+    elif q.tier_disabled(tier):
+        on = False
+    else:
+        env = os.environ.get(tiers[tier].env)  # dl4j: noqa[DL4J103] env kill switch read at trace time by design (fixed per process)
+        if env is not None and env.lower() in ("0", "off", "false"):
+            on = False
+        elif env is not None and env.lower() in ("1", "on", "true"):
+            on = True
+        else:
+            on = bool(configured)
+    q.record_tier(tier, on)
+    return on
+
+
+_PRECISION_WARM: dict = {}
+
+
+def ensure_precision_validated(tier: str) -> bool:
+    """Once-per-process parity validation for one precision tier,
+    called the first time that tier would engage: the tier's bounded-ε
+    self-test runs, and a failure flips the runtime kill (the call site
+    silently serves the fp32 path) instead of corrupting numerics.
+    Returns True when the tier is usable."""
+    from deeplearning4j_tpu.ops import quantize as q
+    with _WARM_LOCK:
+        if tier in _PRECISION_WARM:
+            return _PRECISION_WARM[tier]
+    info = _precision_tiers()[tier]
+    ok = True
+    try:
+        info.self_test()
+    except Exception as e:
+        ok = False
+        q.disable_tier(tier, f"self-test failed: {type(e).__name__}: {e}")
+    try:
+        _registry().gauge(
+            "dl4j_precision_selftest_ok",
+            "last precision-tier self-test verdict (1 ok, 0 failed)",
+            labels=("tier",)).labels(tier=tier).set(1 if ok else 0)
+    except Exception:
+        pass
+    with _WARM_LOCK:
+        _PRECISION_WARM[tier] = ok
+    return ok
+
+
+def reset_precision_validation() -> None:
+    """Tests only: forget cached tier verdicts and runtime kills."""
+    from deeplearning4j_tpu.ops import quantize as q
+    with _WARM_LOCK:
+        _PRECISION_WARM.clear()
+    q.reset_disabled()

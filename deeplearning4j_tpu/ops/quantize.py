@@ -1,0 +1,365 @@
+"""Reduced-precision tiers: weight-only int8/fp8 quantization and
+int8 block-quantized gradient collectives with error feedback.
+
+Three tiers share this module (selection lives in ops/helpers.py, the
+same seam the Pallas kernel tiers use, so no call site changes):
+
+* ``bf16_train`` — ops/dtypes.Policy already implements the compute
+  side; this module only meters it.
+* ``int8_infer`` / ``fp8_infer`` — weight-only quantization with
+  per-output-channel symmetric scales.  Quantization happens ONCE on
+  the host (numpy); dequantization happens IN-TRACE (`q.astype(f32) *
+  scale` fuses into the first consumer matmul), so the device-resident
+  weights are the ~4x-smaller codes.  Biases and 1-D leaves stay fp32:
+  they are a rounding-error fraction of the bytes and quantizing them
+  costs disproportionate accuracy.
+* ``grad_quant`` — the distributed barrier contribution goes int8 with
+  per-block scales plus a persistent error-feedback residual
+  (:class:`ErrorFeedback`): what one step's quantization loses, the
+  next step's contribution carries.  The cuDNN playbook (arXiv
+  1410.0759) motivates the compute tiers; arXiv 2112.01075's
+  redistribution cost model motivates the wire tier — cross-host
+  bytes, not FLOPs, dominate the elastic step.
+
+Every tier honors the Pallas-tier contract: byte-identical when off
+(the fp32 paths are untouched), bounded-ε parity when on (pinned by
+tests/test_precision.py and the self-tests helpers.py warm-runs), and
+metered under ``dl4j_precision_*``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: gradient-quantization block length: long enough to amortize the f32
+#: scale (0.2% overhead), short enough that one outlier only inflates
+#: the quantization step of its own 2048 neighbours
+GRAD_BLOCK = 2048
+
+#: int8 symmetric code range (−127..127; −128 unused keeps zero exact
+#: and the code symmetric)
+_INT8_MAX = 127.0
+#: float8_e4m3 finite max
+_FP8_MAX = 448.0
+
+# runtime kill switches, flipped by a failed self-test (mirrors
+# pallas_kernels._disabled): tier -> reason
+_disabled: Dict[str, str] = {}
+_DISABLED_LOCK = threading.Lock()
+
+
+def disable_tier(tier: str, reason: str) -> None:
+    """Runtime per-tier kill: a failed parity self-test degrades that
+    tier to the fp32 path without taking down the healthy ones."""
+    with _DISABLED_LOCK:
+        _disabled[tier] = reason
+
+
+def tier_disabled(tier: str) -> Optional[str]:
+    return _disabled.get(tier)
+
+
+def reset_disabled() -> None:
+    """Tests only."""
+    with _DISABLED_LOCK:
+        _disabled.clear()
+
+
+def _registry():
+    from deeplearning4j_tpu import monitor
+    return monitor.get_registry()
+
+
+def record_tier(tier: str, on: bool) -> None:
+    """Meter one trace-time tier selection (same contract as
+    helpers.record_selection: counts move on traces, not steps)."""
+    try:
+        c = _registry().counter(
+            "dl4j_precision_selected_total",
+            "precision-tier selection decisions at trace time",
+            labels=("tier", "on"))
+        c.labels(tier=tier, on="1" if on else "0").inc()
+    except Exception:
+        pass  # metering must never break a build
+
+
+def record_grad_bytes(dtype: str, nbytes: int) -> None:
+    """Meter one barrier contribution's wire payload size by dtype —
+    the A/B the ≥3.5x byte-cut acceptance reads."""
+    try:
+        _registry().counter(
+            "dl4j_precision_grad_bytes_total",
+            "cross-host gradient bytes contributed to the barrier "
+            "all-reduce, by wire dtype", labels=("dtype",)
+        ).labels(dtype=dtype).inc(int(nbytes))
+    except Exception:
+        pass
+
+
+def record_weight_bytes(tier: str, quantized: int, dense: int) -> None:
+    """Resident-weight footprint after weight-only quantization."""
+    try:
+        g = _registry().gauge(
+            "dl4j_precision_weight_bytes",
+            "device-resident weight bytes after quantization, vs the "
+            "dense fp32 footprint", labels=("tier", "kind"))
+        g.labels(tier=tier, kind="quantized").set(int(quantized))
+        g.labels(tier=tier, kind="dense").set(int(dense))
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# fp8 capability probe
+# ---------------------------------------------------------------------------
+
+def fp8_dtype():
+    """The backend's fp8 storage dtype, or None when the installed
+    jax/XLA has no float8 support."""
+    import jax.numpy as jnp
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_supported() -> bool:
+    """Can this backend round-trip float8_e4m3?  Probed once per
+    process (a cast either works everywhere or raises immediately)."""
+    global _FP8_OK
+    if _FP8_OK is None:
+        dt = fp8_dtype()
+        if dt is None:
+            _FP8_OK = False
+        else:
+            try:
+                import jax.numpy as jnp
+                x = jnp.asarray([1.0, -2.5], jnp.float32).astype(dt)
+                _FP8_OK = bool(np.isfinite(
+                    np.asarray(x.astype(jnp.float32))).all())
+            except Exception:
+                _FP8_OK = False
+    return _FP8_OK
+
+
+_FP8_OK: Optional[bool] = None
+
+
+# ---------------------------------------------------------------------------
+# Weight-only quantization (per-output-channel scales)
+# ---------------------------------------------------------------------------
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == {"q", "s"}
+
+
+def quantize_weight(w, mode: str = "int8") -> dict:
+    """One weight leaf -> ``{"q": codes, "s": f32 scales}`` with
+    symmetric per-output-channel scales (channels = last axis, the
+    out-features axis of this codebase's ``(in, out)`` dense kernels
+    and the innermost axis XLA contracts against)."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = np.abs(w).max(axis=reduce_axes, keepdims=True) if w.ndim > 1 \
+        else np.abs(w).max(keepdims=True)
+    amax = np.maximum(amax, 1e-12).astype(np.float32)
+    if mode == "int8":
+        s = (amax / _INT8_MAX).astype(np.float32)
+        q = np.clip(np.rint(w / s), -_INT8_MAX, _INT8_MAX).astype(np.int8)
+    elif mode == "fp8":
+        dt = fp8_dtype()
+        if dt is None:
+            raise ValueError("fp8 requested but this backend has no "
+                             "float8_e4m3 support")
+        import jax.numpy as jnp
+        s = (amax / _FP8_MAX).astype(np.float32)
+        q = np.asarray(jnp.asarray(w / s, jnp.float32).astype(dt))
+    else:
+        raise ValueError(f"unknown weight-quantization mode '{mode}' "
+                         "(known: int8, fp8)")
+    return {"q": q, "s": s}
+
+
+def quantize_params(tree, mode: str = "int8") -> Tuple[object, dict]:
+    """Weight-only quantization of a param pytree: float leaves with
+    ndim>=2 become ``{"q", "s"}`` records; biases, 1-D and integer
+    leaves pass through untouched.  Returns ``(qtree, stats)`` where
+    stats carries the quantized/dense byte footprints."""
+    import jax
+    stats = {"n_quantized": 0, "n_passthrough": 0,
+             "quantized_bytes": 0, "dense_bytes": 0}
+
+    def one(x):
+        a = np.asarray(x)
+        stats["dense_bytes"] += a.size * 4 if np.issubdtype(
+            a.dtype, np.floating) else a.nbytes
+        if a.ndim >= 2 and np.issubdtype(a.dtype, np.floating):
+            rec = quantize_weight(a, mode)
+            stats["n_quantized"] += 1
+            stats["quantized_bytes"] += rec["q"].nbytes + rec["s"].nbytes
+            return rec
+        stats["n_passthrough"] += 1
+        stats["quantized_bytes"] += a.nbytes
+        return x
+
+    qtree = jax.tree_util.tree_map(one, tree)
+    record_weight_bytes(f"{mode}_infer", stats["quantized_bytes"],
+                        stats["dense_bytes"])
+    return qtree, stats
+
+
+def dequantize_params(qtree, dtype=None):
+    """In-trace dequantization: ``{"q", "s"}`` records become
+    ``q.astype(f32) * s`` (XLA fuses the expand into the consumer
+    matmul); everything else passes through.  Works on host numpy
+    trees too (the parity tests)."""
+    import jax
+    import jax.numpy as jnp
+    out_dtype = dtype or jnp.float32
+
+    def deq(x):
+        if _is_qleaf(x):
+            return (x["q"].astype(out_dtype) * x["s"]).astype(out_dtype)
+        return x
+
+    return jax.tree_util.tree_map(deq, qtree, is_leaf=_is_qleaf)
+
+
+# ---------------------------------------------------------------------------
+# Gradient block quantization + error feedback
+# ---------------------------------------------------------------------------
+
+def quantize_blocks(vec, block: int = GRAD_BLOCK
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-block int8 quantization of a flat f32 vector:
+    ``(codes int8 [n], scales f32 [ceil(n/block)])``."""
+    v = np.asarray(vec, np.float32).ravel()
+    n = v.size
+    nb = max(1, -(-n // block))
+    pad = nb * block - n
+    vp = np.pad(v, (0, pad)).reshape(nb, block) if pad else \
+        v.reshape(nb, block)
+    amax = np.abs(vp).max(axis=1)
+    scales = np.where(amax > 0, amax / _INT8_MAX, 1.0).astype(np.float32)
+    codes = np.clip(np.rint(vp / scales[:, None]),
+                    -_INT8_MAX, _INT8_MAX).astype(np.int8)
+    return codes.reshape(-1)[:n].copy(), scales
+
+
+def dequantize_blocks(codes, scales, block: int = GRAD_BLOCK
+                      ) -> np.ndarray:
+    """Inverse of :func:`quantize_blocks` (exact: int8 code × f32 scale
+    is representable, so every receiver reconstructs the SAME f32
+    vector — what keeps the coordinator's rank-order accumulation
+    bit-stable across a mixed fleet)."""
+    c = np.asarray(codes).ravel().astype(np.float32)
+    s = np.asarray(scales, np.float32).ravel()
+    n = c.size
+    nb = s.size
+    pad = nb * block - n
+    if pad < 0 or pad >= block:
+        raise ValueError(f"codes length {n} inconsistent with "
+                         f"{nb} scale blocks of {block}")
+    cp = np.pad(c, (0, pad)).reshape(nb, block) if pad else \
+        c.reshape(nb, block)
+    return (cp * s[:, None]).reshape(-1)[:n].astype(np.float32)
+
+
+class ErrorFeedback:
+    """Persistent error-feedback residual for quantized gradient
+    collectives: each contribution quantizes ``grad + residual`` and
+    keeps ``(grad + residual) - dequant`` for the next step, so the
+    quantization error is carried, not dropped — the convergence
+    guarantee behind 1-bit/int8 SGD compression.
+
+    ``commit`` only runs after the barrier ACCEPTS the contribution: a
+    generation roll re-runs the same batch, and committing the residual
+    for a contribution the cluster never reduced would double-count its
+    error.  Rolls call :meth:`reset` instead — survivors of a resize
+    restart from a synchronized snapshot, and a stale residual from the
+    old population would leak pre-roll error into the new one."""
+
+    def __init__(self, block: int = GRAD_BLOCK):
+        self.block = int(block)
+        self.residual: Optional[np.ndarray] = None
+
+    def compensate(self, vec: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """``(compensated, codes, scales)`` for one contribution."""
+        v = np.asarray(vec, np.float32).ravel()
+        if self.residual is None or self.residual.size != v.size:
+            self.residual = np.zeros_like(v)
+        comp = v + self.residual
+        codes, scales = quantize_blocks(comp, self.block)
+        return comp, codes, scales
+
+    def commit(self, comp: np.ndarray, codes: np.ndarray,
+               scales: np.ndarray) -> None:
+        """Persist the quantization error of an ACCEPTED contribution."""
+        self.residual = comp - dequantize_blocks(codes, scales, self.block)
+
+    def reset(self, why: str = "") -> None:
+        """Drop the residual (generation roll / rejoin / resize)."""
+        self.residual = None
+        try:
+            _registry().counter(
+                "dl4j_precision_ef_resets_total",
+                "error-feedback residuals dropped (generation rolls, "
+                "rejoins, gradient-size changes)").inc()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Parity self-tests (wired into helpers.ensure_precision_validated)
+# ---------------------------------------------------------------------------
+
+def _selftest_int8_weights() -> None:
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32) * 3.0
+    rec = quantize_weight(w, "int8")
+    back = np.asarray(rec["q"], np.float32) * rec["s"]
+    step = np.abs(w).max(axis=0) / _INT8_MAX  # per-channel code step
+    err = np.abs(back - w).max(axis=0)
+    if not (err <= 0.5 * step + 1e-7).all():
+        raise FloatingPointError("int8 weight round-trip exceeded the "
+                                 "half-step error bound")
+
+
+def _selftest_fp8_weights() -> None:
+    if not fp8_supported():
+        raise RuntimeError("no float8_e4m3 support on this backend")
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(32, 16)).astype(np.float32)
+    rec = quantize_weight(w, "fp8")
+    import jax.numpy as jnp
+    back = np.asarray(jnp.asarray(rec["q"]).astype(jnp.float32)) * rec["s"]
+    rel = np.abs(back - w).max() / max(np.abs(w).max(), 1e-12)
+    if not rel < 0.1:  # e4m3 has a ~6% max relative step
+        raise FloatingPointError(f"fp8 weight round-trip error {rel}")
+
+
+def _selftest_grad_blocks() -> None:
+    rng = np.random.default_rng(2)
+    g = (rng.normal(size=5000) * 0.01).astype(np.float32)
+    codes, scales = quantize_blocks(g)
+    back = dequantize_blocks(codes, scales)
+    bound = np.repeat(scales, GRAD_BLOCK)[:g.size] * 0.5 + 1e-9
+    if not (np.abs(back - g) <= bound).all():
+        raise FloatingPointError("block quantization exceeded the "
+                                 "half-step error bound")
+    # error feedback: the accumulated transmitted signal tracks the
+    # accumulated true signal (residual stays bounded by one code step)
+    ef = ErrorFeedback()
+    sent = np.zeros_like(g)
+    total = np.zeros_like(g)
+    for _ in range(8):
+        comp, codes, scales = ef.compensate(g)
+        ef.commit(comp, codes, scales)
+        sent += dequantize_blocks(codes, scales)
+        total += g
+    drift = np.abs(sent - total).max()
+    step = scales.max() * 0.5 + 1e-9
+    if not drift <= step * 2:
+        raise FloatingPointError(
+            f"error-feedback drift {drift} exceeds one code step {step}")

@@ -344,7 +344,14 @@ def _decode_carry_leaf(spec: dict) -> np.ndarray:
         a = np.asarray(spec["data"], dtype=np.dtype(spec["dtype"]))
     a = a.reshape(tuple(spec["shape"]))
     if str(a.dtype) != spec["dtype"]:
-        a = a.astype(np.dtype(spec["dtype"]))
+        want = np.dtype(spec["dtype"])
+        if a.dtype.kind == "V" and a.dtype.itemsize == want.itemsize:
+            # ml_dtypes leaves (bfloat16 carries, fp8) come back from
+            # npy as raw void bytes: reinterpret, never cast — the
+            # migration hop stays bit-exact
+            a = a.view(want)
+        else:
+            a = a.astype(want)
     return a
 
 
@@ -372,6 +379,47 @@ def _kv_ring_summary(tree) -> dict:
     return out
 
 
+def _cast_carry(tree, dtype):
+    """Store a carry template's non-KV float32 leaves at ``dtype``
+    (bf16 halves the resident carry HBM; the step still computes at
+    f32 — see :func:`_gather_slots`).  KV rings keep their own storage
+    knob (``kv_dtype`` for paged arenas) and are left untouched, as
+    are integer/bool leaves (positions, counters: must stay exact)."""
+    def is_kv(node):
+        return (isinstance(node, dict)
+                and set(node.keys()) == {"k", "v", "pos"}
+                and getattr(node.get("k"), "ndim", 0) == 4)
+
+    def cast(node):
+        if is_kv(node):
+            return node
+        if getattr(node, "dtype", None) == jnp.float32:
+            return node.astype(dtype)
+        return node
+
+    return tree_map(cast, tree, is_leaf=is_kv)
+
+
+def _gather_slots(pool, idx, fresh):
+    """Gather the active slots' carries out of the pool, zeroing fresh
+    rows in-trace (a slot newly claimed by a session must not inherit
+    the previous tenant's state).  Sub-f32 float storage (a bf16 carry
+    pool — ``carry_dtype``) is upcast to float32 HERE, so the step
+    always computes at f32 regardless of how the carry is stored; the
+    scatter side casts back to each pool leaf's dtype.  With an f32
+    pool every branch below is a no-op and the trace is byte-identical
+    to the pre-knob program."""
+    def take(a):
+        g = a[idx]
+        if jnp.issubdtype(g.dtype, jnp.floating) \
+                and jnp.dtype(g.dtype).itemsize < 4:
+            g = g.astype(jnp.float32)
+        f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
+        return g * (1.0 - f).astype(g.dtype)
+
+    return tree_map(take, pool)
+
+
 def _pool_step_raw(model, is_graph: bool):
     """The ONE compiled decode program: gather the active slots' carries,
     run the engines' carried step, scatter the carries back.  ``fresh``
@@ -381,12 +429,7 @@ def _pool_step_raw(model, is_graph: bool):
     rnn_raw = model._rnn_step_raw()
 
     def pool_step(params, state, pool, idx, fresh, xs, fms):
-        def take(a):
-            g = a[idx]
-            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
-            return g * (1.0 - f).astype(g.dtype)
-
-        carries = tree_map(take, pool)
+        carries = _gather_slots(pool, idx, fresh)
         if is_graph:
             outs, new_c = rnn_raw(params, state, carries, xs, fms)
         else:
@@ -411,12 +454,7 @@ def _paged_pool_step_raw(model, is_graph: bool, block_size: int):
     rnn_raw = model._rnn_step_raw()
 
     def pool_step(params, state, pool, idx, fresh, xs, fms, arenas, tbls):
-        def take(a):
-            g = a[idx]
-            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
-            return g * (1.0 - f).astype(g.dtype)
-
-        carries = tree_map(take, pool)
+        carries = _gather_slots(pool, idx, fresh)
         tape = seq_ops.PagedTape(block_size=block_size, arenas=arenas,
                                  tables=tbls)
         with seq_ops.paged_scope(tape):
@@ -460,12 +498,7 @@ def _spec_verify_raw(model, is_graph: bool, *, block_size: Optional[int] = None,
     rnn_raw = model._rnn_step_raw()
 
     def spec_step(params, state, pool, idx, fresh, xs, tok, nv):
-        def take(a):
-            g = a[idx]
-            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
-            return g * (1.0 - f).astype(g.dtype)
-
-        c0 = tree_map(take, pool)
+        c0 = _gather_slots(pool, idx, fresh)
         B, T = tok.shape
         valid = jnp.arange(T)[None, :] < nv[:, None]          # [B, T]
 
@@ -546,12 +579,7 @@ def _spec_verify_general(model, is_graph: bool, *,
         if sampling:
             seed, pos0, temp = rest[ri], rest[ri + 1], rest[ri + 2]
 
-        def take(a):
-            g = a[idx]
-            f = fresh.reshape((-1,) + (1,) * (g.ndim - 1))
-            return g * (1.0 - f).astype(g.dtype)
-
-        c0 = tree_map(take, pool)
+        c0 = _gather_slots(pool, idx, fresh)
         B, T = tok.shape
         valid = jnp.arange(T)[None, :] < nv[:, None]          # [B, T]
 
@@ -658,7 +686,7 @@ class DecodePool:
                  kv_paged: Optional[bool] = None,
                  kv_block: Optional[int] = None,
                  kv_arena_tokens: Optional[int] = None,
-                 kv_dtype=None):
+                 kv_dtype=None, carry_dtype=None):
         self.model = model
         self.name = name
         self.max_slots = max(1, int(max_slots))
@@ -688,6 +716,15 @@ class DecodePool:
             kv_dtype = os.environ.get("DL4J_KV_DTYPE", "") or None
         self._kv_dtype = (None if kv_dtype is None
                           else jnp.dtype(kv_dtype))
+        # carry_dtype extends the bf16 storage story from KV pages to
+        # the WHOLE per-slot carry: non-KV f32 leaves are stored at
+        # this dtype and upcast to f32 at the gather (_gather_slots),
+        # so the step computes exactly as before at half the resident
+        # carry bytes
+        if carry_dtype is None:
+            carry_dtype = os.environ.get("DL4J_CARRY_DTYPE", "") or None
+        self._carry_dtype = (None if carry_dtype is None
+                             else jnp.dtype(carry_dtype))
         self._is_graph = hasattr(model, "_forward_all")
         self.n_inputs = (len(model.conf.network_inputs) if self._is_graph
                          else 1)
@@ -1782,6 +1819,8 @@ class DecodePool:
             else:
                 tmpl = self.model.rnn_carry_template(
                     n, feature_tail=tails[0], dtype=dtype)
+        if self._carry_dtype is not None:
+            tmpl = _cast_carry(tmpl, self._carry_dtype)
         self._pool = tmpl  # dl4j: noqa[DL4J207] batcher-thread-only write: the device pool has ONE owning thread; the locked writes are the crash paths
         self._tails = tuple(tuple(t[1:]) for t in tails)
         self._dtype = np.dtype(dtype)
@@ -2271,7 +2310,7 @@ class DecodeManager:
                  kv_paged: Optional[bool] = None,
                  kv_block: Optional[int] = None,
                  kv_arena_tokens: Optional[int] = None,
-                 kv_dtype=None):
+                 kv_dtype=None, carry_dtype=None):
         self.model_cache = model_cache
         self.max_slots = max(1, int(max_slots))
         self.ttl_s = float(ttl_s)
@@ -2284,6 +2323,7 @@ class DecodeManager:
         self.kv_block = kv_block
         self.kv_arena_tokens = kv_arena_tokens
         self.kv_dtype = kv_dtype
+        self.carry_dtype = carry_dtype
         self._lock = threading.Lock()
         #: model path -> carry-layout fingerprint -> pool
         self._pools: Dict[str, Dict[str, DecodePool]] = {}
@@ -2338,7 +2378,8 @@ class DecodeManager:
                     max_wait_ms=self.max_wait_ms, min_batch=self.min_batch,
                     kv_paged=self.kv_paged, kv_block=self.kv_block,
                     kv_arena_tokens=self.kv_arena_tokens,
-                    kv_dtype=self.kv_dtype)
+                    kv_dtype=self.kv_dtype,
+                    carry_dtype=self.carry_dtype)
                 by_layout[layout] = pool
             # retire fully-drained pools of OTHER layouts whose model
             # is no longer cache-current (the changed-layout rollout's

@@ -108,8 +108,31 @@ class ModelCache:
         setattr(self, what, getattr(self, what) + 1)
         self._counters[what].inc()
 
+    @staticmethod
+    def _apply_quant(model, quantize) -> None:
+        """Activate weight-only quantized serving on a freshly loaded
+        model.  Precedence: explicit ``quantize`` argument ('off' wins
+        over everything and forces dense) > ``DL4J_SERVE_QUANT`` env >
+        the checkpoint conf's ``precision_infer_quant``.  Engines
+        without the tier (e.g. word2vec wrappers) are skipped; the
+        registry's kill switches/self-test still gate the actual
+        engagement inside quantize_inference."""
+        if quantize is None:
+            quantize = os.environ.get("DL4J_SERVE_QUANT")
+        if quantize is None and hasattr(model, "conf"):
+            quantize = getattr(model.conf.global_conf,
+                               "precision_infer_quant", None)
+        if quantize is None:
+            return
+        mode = str(quantize).lower()
+        if mode in ("", "0", "off", "none", "false"):
+            mode = None
+        if hasattr(model, "quantize_inference"):
+            model.quantize_inference(mode)
+
     def get(self, path, shape_bucketing: Optional[bool] = None,
-            warmup_dims=None, max_batch: int = 32):
+            warmup_dims=None, max_batch: int = 32,
+            quantize: Optional[str] = None):
         """The cached model for ``path``, loading (and bucket-warming)
         on first use or when the file changed on disk.
 
@@ -118,7 +141,11 @@ class ModelCache:
         ``warmup_dims`` — the per-example feature shape — pre-compiles
         the inference bucket ladder up to ``max_batch`` rows; passing it
         on a hit warms lazily if the entry was loaded by a path (fit /
-        evaluate) that didn't know the serving shape yet."""
+        evaluate) that didn't know the serving shape yet.
+        ``quantize`` ('int8' | 'fp8', default ``DL4J_SERVE_QUANT`` or
+        the checkpoint conf's ``precision_infer_quant``) serves from
+        weight-only quantized params — the ~4x-smaller resident
+        weights the precision tiers buy (docs/PERFORMANCE.md)."""
         key = os.path.abspath(str(path))
         mtime = os.stat(key).st_mtime_ns
         with self._lock:
@@ -128,7 +155,8 @@ class ModelCache:
                     # rollout: OLD keeps serving; the new version loads
                     # and warms on a background thread and flips when
                     # ready (idempotent while one warm is in flight)
-                    self._start_rollout_locked(key, mtime, shape_bucketing)
+                    self._start_rollout_locked(key, mtime, shape_bucketing,
+                                               quantize)
                 else:
                     self._count("stale_reloads")
                     del self._entries[key]
@@ -142,6 +170,7 @@ class ModelCache:
                 if shape_bucketing is not None:
                     model.conf.global_conf.shape_bucketing = \
                         bool(shape_bucketing)
+                self._apply_quant(model, quantize)
                 e = {"mtime": mtime, "model": model, "warmup": None,
                      "loaded_at": time.time()}
                 self._entries[key] = e
@@ -162,7 +191,7 @@ class ModelCache:
             return e["model"]
 
     def _start_rollout_locked(self, key: str, mtime: int,
-                              shape_bucketing) -> None:
+                              shape_bucketing, quantize=None) -> None:
         roll = self._rollouts.get(key)
         if roll is not None and roll.get("mtime") == mtime:
             return   # this version is already warming
@@ -174,10 +203,12 @@ class ModelCache:
         t = threading.Thread(
             target=self._rollout, daemon=True,
             name=f"model-rollout:{os.path.basename(key)}",
-            args=(key, mtime, shape_bucketing, warm_dims, warm_mb))
+            args=(key, mtime, shape_bucketing, warm_dims, warm_mb,
+                  quantize))
         t.start()
 
-    def _rollout(self, key, mtime, shape_bucketing, warm_dims, warm_mb):
+    def _rollout(self, key, mtime, shape_bucketing, warm_dims, warm_mb,
+                 quantize=None):
         """Background leg of a blue/green flip: load + warm OUTSIDE the
         cache lock (requests keep hitting the old entry), then swap the
         entry atomically.  Failure keeps the old version serving and
@@ -187,6 +218,7 @@ class ModelCache:
             if shape_bucketing is not None:
                 model.conf.global_conf.shape_bucketing = \
                     bool(shape_bucketing)
+            self._apply_quant(model, quantize)
             warm = None
             if warm_dims is not None and hasattr(model, "warmup_inference"):
                 warm = model.warmup_inference(warm_dims, max_batch=warm_mb)

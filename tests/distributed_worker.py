@@ -34,6 +34,9 @@ Test knobs (env):
                        tests drive this
     DL4J_FAULT_PLAN    standard fault-plan JSON (a dist.worker kill
                        here preempts THIS worker mid-epoch)
+    DL4J_TEST_GRAD_QUANT
+                       'int8': contribute quantized gradients (the
+                       precision tier's error-feedback wire path)
 """
 
 import base64
@@ -92,6 +95,10 @@ builder = (NeuralNetConfiguration.builder().seed(99).learning_rate(0.05)
            .updater("adam")
            .distributed(processes=expected, heartbeat_ms=80,
                         lease_ms=600))
+if os.environ.get("DL4J_TEST_GRAD_QUANT"):
+    # quantized-gradient tier: int8 barrier contributions with
+    # error feedback (tests/test_precision.py parity suite)
+    builder.precision(grad_allreduce=os.environ["DL4J_TEST_GRAD_QUANT"])
 if fsdp > 1:
     # route the cluster step through the local FSDP/ZeRO path: params
     # and updater state shard over this worker's own device mesh

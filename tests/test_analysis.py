@@ -153,6 +153,32 @@ def test_hot_span_transfer_positive_and_negative(tmp_path):
     assert [f.line for f in findings] == [8]
 
 
+def test_fp64_promotion_positive_and_negative(tmp_path):
+    findings, _ = run_lint(tmp_path, {"m.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def step(p, x):
+            m = np.zeros((4, 4))                    # positive: f64 default
+            w = np.ones(4, dtype=np.float32)        # negative: pinned
+            g = jnp.zeros((4, 4))                   # negative: jnp is f32
+            h = x.astype(np.float64)                # positive
+            s = np.float64(0.0)                     # positive
+            a = jnp.asarray(x, dtype=jnp.float64)   # positive: dtype kwarg
+            e = np.eye(3, dtype="float32")          # negative
+            b = np.zeros((2, 2), np.float32)        # negative: positional
+            return p + m + w + g + h + s + a + e + b
+
+        fast = jax.jit(step)
+
+        def host_side(n):
+            return np.zeros(n)                      # negative: host-side
+    """}, rules=["DL4J106"])
+    assert [f.line for f in findings] == [7, 10, 11, 12]
+    assert all(f.rule == "DL4J106" for f in findings)
+
+
 # ----------------------------------------------------------------------
 # Concurrency rules
 # ----------------------------------------------------------------------

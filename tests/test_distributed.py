@@ -92,6 +92,32 @@ def test_two_process_elastic_cluster_parity():
         result.coordinator_status
 
 
+def test_two_process_quantized_gradient_parity():
+    """The precision tier's quantized collective at PROCESS level
+    (DL4J_TEST_GRAD_QUANT=int8): int8 codes + per-block scales ride the
+    npy wire (the codec self-describes dtype), the coordinator
+    dequantizes at admission, and the persistent error-feedback
+    residual carries the quantization error.  Workers stay BIT-identical
+    to each other (every process applies the same reduced update), and
+    final params land within the documented ε=2e-2 of the uninterrupted
+    dense single-host twin (Adam's sign-normalized steps amplify the
+    per-element quantization noise; the LOSS-level parity bound of 1e-2
+    is asserted by tests/test_precision.py's thread-mode twin)."""
+    result = launch_cluster(
+        [sys.executable, WORKER], processes=2, respawn=False,
+        env_extra={"DL4J_TEST_GRAD_QUANT": "int8"}, timeout_s=300)
+    assert result.ok, result.describe_failures()
+    digests, params, scores, _ = _parse(result.all_stdout())
+    assert set(digests) == {"w0", "w1"}, digests
+    assert digests["w0"] == digests["w1"], digests
+    assert scores["w0"] == scores["w1"]
+    ref = _reference_params()
+    np.testing.assert_allclose(params["w0"], ref, atol=2e-2)
+    # quantization really happened: the trajectory must NOT be
+    # bit-identical to the dense run (else the knob was a no-op)
+    assert not np.array_equal(params["w0"], ref)
+
+
 def test_elastic_preemption_respawn_2_1_2():
     """The acceptance path at PROCESS level: a ``DL4J_FAULT_PLAN`` kill
     preempts worker w1 mid-epoch; the survivor is NOT restarted, rolls

@@ -224,6 +224,62 @@ def test_binary_carry_payload_exact_round_trip(model_path):
         poolB.stop()
 
 
+def test_bf16_carry_pool_and_bit_exact_migration(model_path):
+    """Precision tier (docs/PERFORMANCE.md "Precision tiers"): a pool
+    with ``carry_dtype='bfloat16'`` keeps non-KV carry leaves resident
+    in bf16 (half the bytes), steps stay close to the f32 pool (compute
+    upcasts at the gather), and migration to another bf16 pool is
+    BIT-exact — the npy wire round-trips ml_dtypes leaves that numpy
+    deserializes as void bytes."""
+    from deeplearning4j_tpu.server.decode import _decode_carry_leaf
+    import jax
+    netF, netA, netB = (load_model(model_path) for _ in range(3))
+    poolF = DecodePool(netF, name="carryF", max_slots=2, max_wait_ms=0.5)
+    poolA = DecodePool(netA, name="carryA", max_slots=2, max_wait_ms=0.5,
+                       carry_dtype="bfloat16")
+    poolB = DecodePool(netB, name="carryB", max_slots=2, max_wait_ms=0.5,
+                       carry_dtype="bfloat16")
+    try:
+        x = _seq(1, 6, seed=3)
+        sf, sa = poolF.open_session(), poolA.open_session()
+        outF, outA = [], []
+        for t in range(4):
+            (o,) = poolF.step(sf, x[0, t:t + 1])
+            outF.append(o)
+            (o,) = poolA.step(sa, x[0, t:t + 1])
+            outA.append(o)
+        # the carry really lives in bf16, at fewer resident bytes
+        dts = {str(l.dtype) for l in jax.tree_util.tree_leaves(poolA._pool)}
+        assert "bfloat16" in dts, dts
+        bytes_f32 = sum(l.nbytes
+                        for l in jax.tree_util.tree_leaves(poolF._pool))
+        bytes_bf16 = sum(l.nbytes
+                         for l in jax.tree_util.tree_leaves(poolA._pool))
+        assert bytes_bf16 < bytes_f32
+        np.testing.assert_allclose(np.concatenate(outA),
+                                   np.concatenate(outF), atol=5e-2)
+        payload = poolA.export_session(sa)
+        wire = json.loads(json.dumps(payload))     # the router hop
+        assert any(spec["dtype"] == "bfloat16"
+                   for spec in wire["carry"]["leaves"]), \
+            [spec["dtype"] for spec in wire["carry"]["leaves"]]
+        assert poolB.import_session(wire) == sa
+        poolA.finish_export(sa, ok=True)
+        slot = poolB._sessions[sa].slot
+        imported = jax.device_get(jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda a: a[slot], poolB._pool)))
+        for leaf, spec in zip(imported, wire["carry"]["leaves"]):
+            back = _decode_carry_leaf(spec)
+            assert np.asarray(leaf).dtype == back.dtype
+            np.testing.assert_array_equal(np.asarray(leaf), back)
+        (o,) = poolB.step(sa, x[0, 4:5])
+        assert np.all(np.isfinite(o))
+    finally:
+        poolF.stop()
+        poolA.stop()
+        poolB.stop()
+
+
 def test_export_limbo_excluded_from_stats_and_reinstates(model_path):
     """Satellite: exported slots leave stats()/active counts while the
     migration is pending; an aborted export reinstates the session with
